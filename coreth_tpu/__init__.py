@@ -1,0 +1,32 @@
+"""coreth-tpu: a TPU-native execution engine with the capabilities of coreth
+(the Avalanche C-Chain VM, /root/reference).
+
+Architecture (tpu-first, not a port):
+
+- ``crypto``     host cryptography: keccak-256, secp256k1 ECDSA recover
+                 (pure-Python reference + C++ native fast path via ctypes).
+- ``ops``        device kernels: batched keccak-f[1600] on uint32 lanes
+                 (jnp + Pallas), 256-bit limb arithmetic, bloom filters.
+- ``rlp``        RLP codec (reference: geth rlp, used throughout coreth).
+- ``types``      transactions / headers / receipts / logs with the Avalanche
+                 extras (ExtDataHash, BlockGasCost, ExtDataGasUsed — see
+                 reference core/types/block.go + block_ext.go).
+- ``mpt``        Merkle-Patricia trie with level-synchronous batched rehash
+                 (reference: trie/).
+- ``state``      journaled world state + device-resident flat state
+                 (reference: core/state/).
+- ``evm``        the EVM as a jitted, vmapped step machine
+                 (reference: core/vm/).
+- ``processor``  state-transition + block-processing rules, bit-identical to
+                 reference core/state_transition.go + core/state_processor.go.
+- ``consensus``  dummy-engine twin: header gas verification + Avalanche
+                 dynamic fee algorithm (reference: consensus/dummy/).
+- ``chain``      chain orchestration, genesis, chain-maker fixtures
+                 (reference: core/blockchain.go, core/chain_makers.go).
+- ``replay``     the north-star batched block-replay engine: dependency
+                 scheduling + lockstep vmapped execution.
+- ``parallel``   jax.sharding meshes, shard_map replay sharding, ICI
+                 collectives for the Merkle frontier reduction.
+"""
+
+__version__ = "0.1.0"

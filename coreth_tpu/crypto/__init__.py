@@ -1,0 +1,16 @@
+"""Host cryptography for coreth-tpu.
+
+Pure-Python reference implementations with a C++ native fast path (built from
+native/, loaded via ctypes).  Device-batched variants live in coreth_tpu.ops.
+"""
+
+from coreth_tpu.crypto.keccak import keccak256, keccak256_py, EMPTY_KECCAK
+
+# Try to activate the native fast path; harmless if the library isn't built.
+try:  # pragma: no cover - exercised when native lib present
+    from coreth_tpu.crypto import native as _native
+    _native.install()
+except Exception:  # noqa: BLE001 - any failure leaves the pure-py path active
+    pass
+
+__all__ = ["keccak256", "keccak256_py", "EMPTY_KECCAK"]

@@ -1,0 +1,97 @@
+"""ctypes bridge to the C++ host runtime (native/libcoreth_native.so).
+
+The native library supplies the fast paths that the reference gets from
+asm/cgo dependencies (SURVEY.md section 2.7): keccak-256 and batched
+secp256k1 recovery.  Built lazily with ``make -C native`` on first import if
+g++ is available; every caller keeps working on the pure-Python path when
+the build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libcoreth_native.so")
+
+_lib = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def load():
+    """Load (building if necessary) the native library, or return None."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.coreth_keccak256.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.coreth_keccak256.restype = None
+    lib.coreth_ecrecover.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_char_p]
+    lib.coreth_ecrecover.restype = ctypes.c_int
+    lib.coreth_ecrecover_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p]
+    lib.coreth_ecrecover_batch.restype = None
+    _lib = lib
+    return _lib
+
+
+def _require() -> ctypes.CDLL:
+    lib = load()
+    if lib is None:
+        raise RuntimeError(
+            "coreth native library unavailable (build failed or g++ missing); "
+            "use the pure-python entry points in coreth_tpu.crypto")
+    return lib
+
+
+def keccak256_native(data: bytes) -> bytes:
+    out = ctypes.create_string_buffer(32)
+    _require().coreth_keccak256(data, len(data), out)
+    return out.raw
+
+
+def recover_address_native(msg_hash: bytes, r: int, s: int, recid: int) -> bytes:
+    out = ctypes.create_string_buffer(20)
+    ok = _require().coreth_ecrecover(
+        msg_hash, r.to_bytes(32, "big"), s.to_bytes(32, "big"), recid, out)
+    if not ok:
+        raise ValueError("invalid signature values")
+    return out.raw
+
+
+def recover_addresses_batch(hashes: bytes, rs: bytes, ss: bytes,
+                            recids: bytes):
+    """Batched recovery over packed buffers.  Returns (addresses, ok) bytes."""
+    n = len(recids)
+    out = ctypes.create_string_buffer(20 * n)
+    ok = ctypes.create_string_buffer(n)
+    _require().coreth_ecrecover_batch(hashes, rs, ss, recids, n, out, ok)
+    return out.raw, ok.raw
+
+
+def install() -> bool:
+    """Activate native fast paths on the pure-python entry points."""
+    if load() is None:
+        return False
+    from coreth_tpu.crypto import keccak as _k
+    from coreth_tpu.crypto import secp256k1 as _s
+    _k.set_impl(keccak256_native)
+    _s.set_recover_impl(recover_address_native)
+    return True

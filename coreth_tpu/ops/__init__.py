@@ -1,0 +1,7 @@
+"""Device kernels (jnp/XLA + Pallas) for the coreth-tpu hot path.
+
+These replace the native/asm dependencies of the reference's hot loops
+(SURVEY.md section 2.7): batched keccak-f[1600] (trie hashing, SHA3 opcode,
+DeriveSha), 256-bit limb arithmetic for the EVM (uint256), and bloom-filter
+construction.
+"""

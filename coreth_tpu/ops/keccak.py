@@ -1,0 +1,217 @@
+"""Batched keccak-256 on device — uint32 lane pairs, jnp/XLA.
+
+The reference's hot path leans on asm-optimized keccak everywhere: trie node
+hashing (reference trie/hasher.go:69,195), tx/receipt roots (core/types/
+hashing.go:97), secure-trie keys, the SHA3 opcode (core/vm/instructions.go),
+and CREATE2.  On TPU there is no 64-bit integer datapath worth using, so
+lanes are represented as (lo, hi) uint32 pairs and the permutation is
+expressed with 32-bit XOR/AND/shift — all VPU-friendly element-wise ops that
+vectorize across the batch dimension.
+
+Layout: state arrays have shape (..., 25, 2) uint32, last axis = (lo, hi).
+All rotation amounts are static Python ints (the rho schedule), so every
+shift lowers to a constant-shift VPU op; the 24 rounds are unrolled at trace
+time with round constants baked in as literals.
+
+Entry points:
+  - keccak_f1600(state): the permutation, batched over leading dims.
+  - keccak256_fixed(words, nbytes): single-block messages (<=135 bytes) of a
+    length fixed at trace time — the EVM mapping-slot path (64 bytes) and
+    most trie leaf/short nodes.
+  - keccak256_blocks(blocks, nblocks): variable-block messages, padded on
+    host; masked absorb keeps finished items' states frozen.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# --- static schedule (derived, not transcribed) ----------------------------
+
+_MASK64 = (1 << 64) - 1
+
+
+def _derive_schedule():
+    # Round constants via the LFSR, as in the host reference implementation.
+    rc = []
+    r = 1
+    for _ in range(24):
+        v = 0
+        for j in range(7):
+            r = ((r << 1) ^ ((r >> 7) * 0x71)) % 256
+            if r & 2:
+                v ^= 1 << ((1 << j) - 1)
+        rc.append(v)
+    # rho rotation per lane index (x + 5*y) and the pi permutation:
+    # dest_index[src] after the rho+pi step.
+    rho = [0] * 25
+    pi_dest = list(range(25))
+    x, y = 1, 0
+    for t in range(24):
+        # rotation amount belongs to the SOURCE lane of walk step t
+        rho[x + 5 * y] = ((t + 1) * (t + 2) // 2) % 64
+        x, y = y, (2 * x + 3 * y) % 5
+    # pi: A'[y, (2x+3y)%5] = A[x, y]  (in (x, y) coords; index = x + 5*y)
+    for xx in range(5):
+        for yy in range(5):
+            pi_dest[xx + 5 * yy] = yy + 5 * ((2 * xx + 3 * yy) % 5)
+    return rc, rho, pi_dest
+
+
+_RC, _RHO, _PI_DEST = _derive_schedule()
+# src lane feeding each destination after rho+pi
+_PI_SRC = [0] * 25
+for _s, _d in enumerate(_PI_DEST):
+    _PI_SRC[_d] = _s
+
+
+def _rotl64(lo, hi, r: int):
+    """Rotate a (lo, hi) uint32 pair left by static r."""
+    r &= 63
+    if r == 0:
+        return lo, hi
+    if r == 32:
+        return hi, lo
+    if r < 32:
+        nlo = (lo << r) | (hi >> (32 - r))
+        nhi = (hi << r) | (lo >> (32 - r))
+        return nlo, nhi
+    r -= 32
+    nlo = (hi << r) | (lo >> (32 - r))
+    nhi = (lo << r) | (hi >> (32 - r))
+    return nlo, nhi
+
+
+def keccak_f1600(state):
+    """Apply the keccak-f[1600] permutation.
+
+    state: uint32 array (..., 25, 2); returns the same shape.
+    Rounds are unrolled; all control flow is static.
+    """
+    lanes = [(state[..., i, 0], state[..., i, 1]) for i in range(25)]
+    for rnd in range(24):
+        # theta
+        C = []
+        for xx in range(5):
+            clo = lanes[xx][0]
+            chi = lanes[xx][1]
+            for yy in range(1, 5):
+                clo = clo ^ lanes[xx + 5 * yy][0]
+                chi = chi ^ lanes[xx + 5 * yy][1]
+            C.append((clo, chi))
+        for xx in range(5):
+            rl, rh = _rotl64(*C[(xx + 1) % 5], 1)
+            dlo = C[(xx + 4) % 5][0] ^ rl
+            dhi = C[(xx + 4) % 5][1] ^ rh
+            for yy in range(5):
+                i = xx + 5 * yy
+                lanes[i] = (lanes[i][0] ^ dlo, lanes[i][1] ^ dhi)
+        # rho + pi
+        moved = [None] * 25
+        for d in range(25):
+            s = _PI_SRC[d]
+            moved[d] = _rotl64(lanes[s][0], lanes[s][1], _RHO[s])
+        # chi
+        new = [None] * 25
+        for yy in range(5):
+            for xx in range(5):
+                i = xx + 5 * yy
+                a1 = moved[(xx + 1) % 5 + 5 * yy]
+                a2 = moved[(xx + 2) % 5 + 5 * yy]
+                new[i] = (moved[i][0] ^ (~a1[0] & a2[0]),
+                          moved[i][1] ^ (~a1[1] & a2[1]))
+        lanes = new
+        # iota
+        rc = _RC[rnd]
+        lanes[0] = (lanes[0][0] ^ np.uint32(rc & 0xFFFFFFFF),
+                    lanes[0][1] ^ np.uint32(rc >> 32))
+    return jnp.stack(
+        [jnp.stack([lo, hi], axis=-1) for lo, hi in lanes], axis=-2)
+
+
+_RATE_WORDS = 34  # 136 bytes / 4
+
+
+def _absorb_words(state, words):
+    """XOR 34 uint32 words (one rate block) into lanes 0..16 and permute."""
+    # words: (..., 34) uint32 -> pairs (..., 17, 2)
+    pairs = words.reshape(words.shape[:-1] + (17, 2))
+    pad = jnp.zeros(words.shape[:-1] + (8, 2), dtype=jnp.uint32)
+    full = jnp.concatenate([pairs, pad], axis=-2)
+    return keccak_f1600(state ^ full)
+
+
+def keccak256_fixed(words, nbytes: int):
+    """keccak-256 of single-block messages with trace-time-static length.
+
+    words: uint32 array (..., 34) — the message bytes as little-endian
+    uint32 words, zero-padded.  nbytes must be <= 135.  Returns (..., 8)
+    uint32 digest words (little-endian).
+    """
+    assert nbytes <= 135
+    # keccak pad10*1: suffix 0x01 at nbytes, 0x80 at byte 135.
+    w = words
+    suffix = np.zeros(34, dtype=np.uint32)
+    suffix[nbytes // 4] ^= np.uint32(0x01) << (8 * (nbytes % 4))
+    suffix[33] ^= np.uint32(0x80) << 24
+    w = w ^ jnp.asarray(suffix)
+    state = jnp.zeros(w.shape[:-1] + (25, 2), dtype=jnp.uint32)
+    state = _absorb_words(state, w)
+    return state[..., :4, :].reshape(state.shape[:-2] + (8,))
+
+
+def keccak256_blocks(blocks, nblocks):
+    """keccak-256 of host-padded multi-block messages.
+
+    blocks: uint32 (batch, max_blocks, 34) — keccak padding already applied
+    on host (suffix 0x01 / 0x80 in the final real block).
+    nblocks: int32 (batch,) — real block count per item (>= 1).
+    Returns (batch, 8) uint32 digest words.
+    """
+    blocks = jnp.asarray(blocks, dtype=jnp.uint32)
+    nblocks = jnp.asarray(nblocks, dtype=jnp.int32)
+    batch = blocks.shape[0]
+    max_blocks = blocks.shape[1]
+    state = jnp.zeros((batch, 25, 2), dtype=jnp.uint32)
+
+    def body(i, st):
+        absorbed = _absorb_words(st, blocks[:, i, :])
+        keep = (i < nblocks)[:, None, None]
+        return jnp.where(keep, absorbed, st)
+
+    state = jax.lax.fori_loop(0, max_blocks, body, state)
+    return state[:, :4, :].reshape(batch, 8)
+
+
+# --- host-side packing helpers ---------------------------------------------
+
+
+def pack_fixed(msgs: list[bytes], nbytes: int) -> np.ndarray:
+    """Pack equal-length messages for keccak256_fixed."""
+    buf = np.zeros((len(msgs), 136), dtype=np.uint8)
+    for i, m in enumerate(msgs):
+        assert len(m) == nbytes
+        buf[i, :nbytes] = np.frombuffer(m, dtype=np.uint8)
+    return buf.view(np.uint32).reshape(len(msgs), 34)
+
+
+def pack_blocks(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """Pack variable-length messages (keccak padding applied) for
+    keccak256_blocks."""
+    nblocks = np.array([len(m) // 136 + 1 for m in msgs], dtype=np.int32)
+    max_blocks = int(nblocks.max()) if len(msgs) else 1
+    buf = np.zeros((len(msgs), max_blocks * 136), dtype=np.uint8)
+    for i, m in enumerate(msgs):
+        buf[i, :len(m)] = np.frombuffer(m, dtype=np.uint8)
+        end = nblocks[i] * 136
+        buf[i, len(m)] ^= 0x01
+        buf[i, end - 1] ^= 0x80
+    return (buf.view(np.uint32).reshape(len(msgs), max_blocks, 34), nblocks)
+
+
+def digest_words_to_bytes(words: np.ndarray) -> list[bytes]:
+    """Convert (batch, 8) uint32 LE digest words to 32-byte digests."""
+    w = np.asarray(words, dtype=np.uint32)
+    return [w[i].tobytes() for i in range(w.shape[0])]

@@ -1,0 +1,110 @@
+// Native keccak-256 for the coreth-tpu host runtime.
+//
+// Mirrors the role of the asm-optimized golang.org/x/crypto/sha3 the
+// reference hot path uses (see reference trie/hasher.go:195,
+// core/types/hashing.go).  Exposed via a plain C ABI consumed through
+// ctypes (coreth_tpu/crypto/native.py).  Round constants are derived with
+// the rc LFSR at startup (same approach as the Keccak team's compact
+// reference code) instead of being transcribed.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+uint64_t RC[24];
+
+struct Init {
+  Init() {
+    // Round constants via the degree-8 LFSR.  The rho/pi schedule is
+    // re-derived inline by the walk in keccak_f1600.
+    uint32_t r = 1;
+    for (int rnd = 0; rnd < 24; ++rnd) {
+      uint64_t rc = 0;
+      for (int j = 0; j < 7; ++j) {
+        r = ((r << 1) ^ ((r >> 7) * 0x71)) & 0xff;
+        if (r & 2) rc ^= 1ULL << ((1 << j) - 1);
+      }
+      RC[rnd] = rc;
+    }
+  }
+} init_;
+
+inline uint64_t rol(uint64_t v, int n) {
+  n &= 63;
+  return n ? (v << n) | (v >> (64 - n)) : v;
+}
+
+void keccak_f1600(uint64_t a[25]) {
+  for (int rnd = 0; rnd < 24; ++rnd) {
+    // theta
+    uint64_t c[5], d[5];
+    for (int x = 0; x < 5; ++x)
+      c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+    for (int x = 0; x < 5; ++x)
+      d[x] = c[(x + 4) % 5] ^ rol(c[(x + 1) % 5], 1);
+    for (int i = 0; i < 25; ++i) a[i] ^= d[i % 5];
+    // rho + pi (walk, same as reference python)
+    int x = 1, y = 0;
+    uint64_t current = a[x + 5 * y];
+    for (int t = 0; t < 24; ++t) {
+      int nx = y, ny = (2 * x + 3 * y) % 5;
+      x = nx; y = ny;
+      uint64_t tmp = a[x + 5 * y];
+      a[x + 5 * y] = rol(current, ((t + 1) * (t + 2) / 2) % 64);
+      current = tmp;
+    }
+    // chi
+    for (int yy = 0; yy < 5; ++yy) {
+      uint64_t row[5];
+      for (int xx = 0; xx < 5; ++xx) row[xx] = a[xx + 5 * yy];
+      for (int xx = 0; xx < 5; ++xx)
+        a[xx + 5 * yy] = row[xx] ^ (~row[(xx + 1) % 5] & row[(xx + 2) % 5]);
+    }
+    // iota
+    a[0] ^= RC[rnd];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// keccak-256: rate 136, delimited suffix 0x01.
+void coreth_keccak256(const uint8_t* data, uint64_t len, uint8_t* out32) {
+  uint64_t st[25];
+  std::memset(st, 0, sizeof(st));
+  const uint64_t rate = 136;
+  while (len >= rate) {
+    for (int i = 0; i < 17; ++i) {
+      uint64_t lane;
+      std::memcpy(&lane, data + 8 * i, 8);  // little-endian hosts only
+      st[i] ^= lane;
+    }
+    keccak_f1600(st);
+    data += rate;
+    len -= rate;
+  }
+  uint8_t block[136];
+  std::memset(block, 0, sizeof(block));
+  std::memcpy(block, data, len);
+  block[len] = 0x01;
+  block[135] ^= 0x80;
+  for (int i = 0; i < 17; ++i) {
+    uint64_t lane;
+    std::memcpy(&lane, block + 8 * i, 8);
+    st[i] ^= lane;
+  }
+  keccak_f1600(st);
+  std::memcpy(out32, st, 32);
+}
+
+// Batched fixed-stride hashing: n items, each `stride` bytes apart with
+// `lens[i]` valid bytes; outputs packed 32-byte digests.
+void coreth_keccak256_batch(const uint8_t* data, const uint64_t* lens,
+                            uint64_t stride, uint64_t n, uint8_t* out) {
+  for (uint64_t i = 0; i < n; ++i)
+    coreth_keccak256(data + i * stride, lens[i], out + 32 * i);
+}
+
+}  // extern "C"

@@ -1,0 +1,449 @@
+// Native secp256k1 ECDSA public-key recovery for the coreth-tpu host runtime.
+//
+// Role parity with the reference's cgo libsecp256k1 binding (geth
+// crypto/secp256k1), which coreth drives in parallel for every block via
+// core/sender_cacher.go.  This implementation: 4x64-bit limbs with __int128
+// products, fast reduction mod p = 2^256 - 0x1000003D1, Jacobian points,
+// Shamir double-scalar multiplication for u1*G + u2*R, Fermat inversion.
+// Keccak for the address derivation comes from keccak.cc.
+//
+// Correctness is anchored by the test suite: cross-checked against the
+// pure-Python implementation, which is itself anchored by the well-known
+// privkey=1 -> 0x7E5F4552091A69125d5DfCb7b8C2659029395Bdf vector.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" void coreth_keccak256(const uint8_t*, uint64_t, uint8_t*);
+
+namespace {
+
+typedef unsigned __int128 u128;
+
+struct U256 {
+  uint64_t v[4];  // little-endian limbs
+};
+
+const U256 ZERO = {{0, 0, 0, 0}};
+const U256 ONE = {{1, 0, 0, 0}};
+
+// p = 2^256 - 2^32 - 977
+const U256 PRIME = {{0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+                     0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL}};
+const uint64_t P_C = 0x1000003D1ULL;  // 2^256 - p
+
+// group order n
+const U256 ORDER = {{0xBFD25E8CD0364141ULL, 0xBAAEDCE6AF48A03BULL,
+                     0xFFFFFFFFFFFFFFFEULL, 0xFFFFFFFFFFFFFFFFULL}};
+
+const U256 GX = {{0x59F2815B16F81798ULL, 0x029BFCDB2DCE28D9ULL,
+                  0x55A06295CE870B07ULL, 0x79BE667EF9DCBBACULL}};
+const U256 GY = {{0x9C47D08FFB10D4B8ULL, 0xFD17B448A6855419ULL,
+                  0x5DA4FBFC0E1108A8ULL, 0x483ADA7726A3C465ULL}};
+
+inline bool is_zero(const U256& a) {
+  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+inline int cmp(const U256& a, const U256& b) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.v[i] < b.v[i]) return -1;
+    if (a.v[i] > b.v[i]) return 1;
+  }
+  return 0;
+}
+
+// returns carry out
+inline uint64_t add_raw(U256& r, const U256& a, const U256& b) {
+  u128 c = 0;
+  for (int i = 0; i < 4; ++i) {
+    c += (u128)a.v[i] + b.v[i];
+    r.v[i] = (uint64_t)c;
+    c >>= 64;
+  }
+  return (uint64_t)c;
+}
+
+// returns borrow out
+inline uint64_t sub_raw(U256& r, const U256& a, const U256& b) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - b.v[i] - borrow;
+    r.v[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;  // two's complement: top bit set iff underflow
+  }
+  return (uint64_t)borrow;
+}
+
+inline void mod_add(U256& r, const U256& a, const U256& b, const U256& m) {
+  uint64_t carry = add_raw(r, a, b);
+  if (carry || cmp(r, m) >= 0) {
+    U256 t;
+    sub_raw(t, r, m);
+    r = t;
+  }
+}
+
+inline void mod_sub(U256& r, const U256& a, const U256& b, const U256& m) {
+  U256 t;
+  if (sub_raw(t, a, b)) {
+    U256 t2;
+    add_raw(t2, t, m);  // wraps back into range
+    r = t2;
+  } else {
+    r = t;
+  }
+}
+
+// ---- field arithmetic mod p (fast reduction using p = 2^256 - P_C) ----
+
+void fe_mul(U256& r, const U256& a, const U256& b) {
+  uint64_t w[8] = {0};
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)a.v[i] * b.v[j] + w[i + j] + carry;
+      w[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    w[i + 4] += (uint64_t)carry;
+  }
+  // fold hi*2^256 -> hi*P_C twice
+  U256 lo = {{w[0], w[1], w[2], w[3]}};
+  U256 hi = {{w[4], w[5], w[6], w[7]}};
+  // acc = lo + hi * P_C  (result fits in 256 + ~33 bits)
+  uint64_t w2[5] = {0};
+  {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)hi.v[j] * P_C + lo.v[j] + carry;
+      w2[j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    w2[4] = (uint64_t)carry;
+  }
+  // fold again: w2[4] * P_C.  A carry can still ripple out of limb 3
+  // (acc + w2[4]*P_C may reach 2^256); the dropped 2^256 == P_C (mod p),
+  // so a third conditional fold is required.
+  U256 acc = {{w2[0], w2[1], w2[2], w2[3]}};
+  {
+    u128 cur = (u128)w2[4] * P_C + acc.v[0];
+    acc.v[0] = (uint64_t)cur;
+    uint64_t carry = (uint64_t)(cur >> 64);
+    for (int j = 1; j < 4; ++j) {
+      u128 c2 = (u128)acc.v[j] + carry;
+      acc.v[j] = (uint64_t)c2;
+      carry = (uint64_t)(c2 >> 64);
+    }
+    if (carry) {  // acc wrapped to a tiny value; adding P_C cannot overflow
+      u128 c3 = (u128)acc.v[0] + P_C;
+      acc.v[0] = (uint64_t)c3;
+      uint64_t c = (uint64_t)(c3 >> 64);
+      for (int j = 1; j < 4 && c; ++j) {
+        u128 c4 = (u128)acc.v[j] + c;
+        acc.v[j] = (uint64_t)c4;
+        c = (uint64_t)(c4 >> 64);
+      }
+    }
+  }
+  while (cmp(acc, PRIME) >= 0) {
+    U256 t;
+    sub_raw(t, acc, PRIME);
+    acc = t;
+  }
+  r = acc;
+}
+
+inline void fe_sqr(U256& r, const U256& a) { fe_mul(r, a, a); }
+
+void fe_pow(U256& r, const U256& a, const U256& e) {
+  U256 acc = ONE, base = a;
+  for (int i = 0; i < 256; ++i) {
+    if ((e.v[i / 64] >> (i % 64)) & 1) {
+      U256 t;
+      fe_mul(t, acc, base);
+      acc = t;
+    }
+    U256 t;
+    fe_sqr(t, base);
+    base = t;
+  }
+  r = acc;
+}
+
+void fe_inv(U256& r, const U256& a) {
+  U256 e;
+  sub_raw(e, PRIME, {{2, 0, 0, 0}});
+  fe_pow(r, a, e);
+}
+
+// ---- scalar arithmetic mod n (shift-and-add; cold path) ----
+
+void sc_mul(U256& r, const U256& a, const U256& b, const U256& m) {
+  U256 acc = ZERO;
+  for (int i = 255; i >= 0; --i) {
+    // acc = 2*acc mod m
+    U256 t;
+    uint64_t carry = add_raw(t, acc, acc);
+    if (carry || cmp(t, m) >= 0) {
+      U256 t2;
+      sub_raw(t2, t, m);
+      t = t2;
+    }
+    acc = t;
+    if ((b.v[i / 64] >> (i % 64)) & 1) mod_add(acc, acc, a, m);
+  }
+  r = acc;
+}
+
+void sc_pow(U256& r, const U256& a, const U256& e, const U256& m) {
+  U256 acc = ONE, base = a;
+  for (int i = 0; i < 256; ++i) {
+    if ((e.v[i / 64] >> (i % 64)) & 1) {
+      U256 t;
+      sc_mul(t, acc, base, m);
+      acc = t;
+    }
+    U256 t;
+    sc_mul(t, base, base, m);
+    base = t;
+  }
+  r = acc;
+}
+
+void sc_inv(U256& r, const U256& a) {
+  U256 e;
+  sub_raw(e, ORDER, {{2, 0, 0, 0}});
+  sc_pow(r, a, e, ORDER);
+}
+
+// ---- Jacobian point arithmetic over fe ----
+
+struct Point {
+  U256 x, y, z;  // z == 0 => infinity
+};
+
+inline bool pt_is_inf(const Point& p) { return is_zero(p.z); }
+
+void pt_double(Point& r, const Point& p) {
+  if (pt_is_inf(p) || is_zero(p.y)) {
+    r = {ZERO, ONE, ZERO};
+    return;
+  }
+  U256 ysq, s, m, t;
+  fe_sqr(ysq, p.y);
+  fe_mul(s, p.x, ysq);
+  mod_add(s, s, s, PRIME);
+  mod_add(s, s, s, PRIME);  // s = 4*x*y^2
+  fe_sqr(m, p.x);
+  U256 m3;
+  mod_add(m3, m, m, PRIME);
+  mod_add(m, m3, m, PRIME);  // m = 3*x^2
+  U256 nx;
+  fe_sqr(nx, m);
+  mod_sub(nx, nx, s, PRIME);
+  mod_sub(nx, nx, s, PRIME);
+  U256 ysq2, y4;
+  fe_sqr(ysq2, ysq);  // y^4
+  // 8*y^4
+  mod_add(y4, ysq2, ysq2, PRIME);
+  mod_add(y4, y4, y4, PRIME);
+  mod_add(y4, y4, y4, PRIME);
+  U256 ny;
+  mod_sub(t, s, nx, PRIME);
+  fe_mul(ny, m, t);
+  mod_sub(ny, ny, y4, PRIME);
+  U256 nz;
+  fe_mul(nz, p.y, p.z);
+  mod_add(nz, nz, nz, PRIME);
+  r.x = nx;
+  r.y = ny;
+  r.z = nz;
+}
+
+void pt_add(Point& r, const Point& p1, const Point& p2) {
+  if (pt_is_inf(p1)) {
+    r = p2;
+    return;
+  }
+  if (pt_is_inf(p2)) {
+    r = p1;
+    return;
+  }
+  U256 z1sq, z2sq, u1, u2, s1, s2, t;
+  fe_sqr(z1sq, p1.z);
+  fe_sqr(z2sq, p2.z);
+  fe_mul(u1, p1.x, z2sq);
+  fe_mul(u2, p2.x, z1sq);
+  fe_mul(t, z2sq, p2.z);
+  fe_mul(s1, p1.y, t);
+  fe_mul(t, z1sq, p1.z);
+  fe_mul(s2, p2.y, t);
+  if (cmp(u1, u2) == 0) {
+    if (cmp(s1, s2) != 0) {
+      r = {ZERO, ONE, ZERO};
+      return;
+    }
+    pt_double(r, p1);
+    return;
+  }
+  U256 h, rr, hsq, hcu, v;
+  mod_sub(h, u2, u1, PRIME);
+  mod_sub(rr, s2, s1, PRIME);
+  fe_sqr(hsq, h);
+  fe_mul(hcu, hsq, h);
+  fe_mul(v, u1, hsq);
+  U256 nx;
+  fe_sqr(nx, rr);
+  mod_sub(nx, nx, hcu, PRIME);
+  mod_sub(nx, nx, v, PRIME);
+  mod_sub(nx, nx, v, PRIME);
+  U256 ny;
+  mod_sub(t, v, nx, PRIME);
+  fe_mul(ny, rr, t);
+  U256 s1h;
+  fe_mul(s1h, s1, hcu);
+  mod_sub(ny, ny, s1h, PRIME);
+  U256 nz;
+  fe_mul(t, p1.z, p2.z);
+  fe_mul(nz, t, h);
+  r.x = nx;
+  r.y = ny;
+  r.z = nz;
+}
+
+// Shamir: k1*G + k2*Q in one double-and-add ladder.
+void pt_shamir(Point& r, const U256& k1, const U256& k2, const Point& q) {
+  Point g = {GX, GY, ONE};
+  Point gq;
+  pt_add(gq, g, q);
+  Point acc = {ZERO, ONE, ZERO};
+  for (int i = 255; i >= 0; --i) {
+    Point t;
+    pt_double(t, acc);
+    acc = t;
+    int b1 = (k1.v[i / 64] >> (i % 64)) & 1;
+    int b2 = (k2.v[i / 64] >> (i % 64)) & 1;
+    if (b1 && b2)
+      pt_add(t, acc, gq);
+    else if (b1)
+      pt_add(t, acc, g);
+    else if (b2)
+      pt_add(t, acc, q);
+    else
+      continue;
+    acc = t;
+  }
+  r = acc;
+}
+
+void load_be(U256& r, const uint8_t* p) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t limb = 0;
+    for (int j = 0; j < 8; ++j) limb = (limb << 8) | p[(3 - i) * 8 + j];
+    r.v[i] = limb;
+  }
+}
+
+void store_be(uint8_t* p, const U256& a) {
+  for (int i = 0; i < 4; ++i)
+    for (int j = 0; j < 8; ++j)
+      p[(3 - i) * 8 + j] = (uint8_t)(a.v[i] >> (56 - 8 * j));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Recover the 20-byte address from (msg_hash, r, s, recid).
+// Returns 1 on success, 0 on invalid signature.
+int coreth_ecrecover(const uint8_t* hash32, const uint8_t* r32,
+                     const uint8_t* s32, int recid, uint8_t* out20) {
+  if (recid < 0 || recid > 3) return 0;
+  U256 r, s, z;
+  load_be(r, r32);
+  load_be(s, s32);
+  load_be(z, hash32);
+  if (is_zero(r) || is_zero(s)) return 0;
+  if (cmp(r, ORDER) >= 0 || cmp(s, ORDER) >= 0) return 0;
+  // x = r (+ n when recid & 2)
+  U256 x = r;
+  if (recid & 2) {
+    if (add_raw(x, r, ORDER)) return 0;
+    if (cmp(x, PRIME) >= 0) return 0;
+  }
+  // y^2 = x^3 + 7
+  U256 xsq, ysq, seven = {{7, 0, 0, 0}};
+  fe_sqr(xsq, x);
+  fe_mul(ysq, xsq, x);
+  mod_add(ysq, ysq, seven, PRIME);
+  // y = ysq^((p+1)/4)
+  U256 e = PRIME;
+  {  // (p+1)/4: p+1 overflows 256 bits? p < 2^256-1 so p+1 fits.
+    U256 p1;
+    add_raw(p1, PRIME, ONE);
+    // shift right by 2
+    for (int i = 0; i < 4; ++i) {
+      uint64_t hi = (i < 3) ? p1.v[i + 1] : 0;
+      e.v[i] = (p1.v[i] >> 2) | (hi << 62);
+    }
+  }
+  U256 y;
+  fe_pow(y, ysq, e);
+  U256 chk;
+  fe_sqr(chk, y);
+  if (cmp(chk, ysq) != 0) return 0;  // non-residue: invalid r
+  if ((y.v[0] & 1) != (uint64_t)(recid & 1)) mod_sub(y, PRIME, y, PRIME);
+  // u1 = -z/r mod n ; u2 = s/r mod n
+  U256 rinv, u1, u2, zmod = z;
+  while (cmp(zmod, ORDER) >= 0) {
+    U256 t;
+    sub_raw(t, zmod, ORDER);
+    zmod = t;
+  }
+  sc_inv(rinv, r);
+  sc_mul(u1, zmod, rinv, ORDER);
+  if (!is_zero(u1)) mod_sub(u1, ORDER, u1, ORDER);
+  sc_mul(u2, s, rinv, ORDER);
+  Point q = {x, y, ONE}, res;
+  pt_shamir(res, u1, u2, q);
+  if (pt_is_inf(res)) return 0;
+  // to affine
+  U256 zinv, zinv2, ax, ay, t;
+  fe_inv(zinv, res.z);
+  fe_sqr(zinv2, zinv);
+  fe_mul(ax, res.x, zinv2);
+  fe_mul(t, zinv2, zinv);
+  fe_mul(ay, res.y, t);
+  uint8_t pub[64];
+  store_be(pub, ax);
+  store_be(pub + 32, ay);
+  uint8_t digest[32];
+  coreth_keccak256(pub, 64, digest);
+  std::memcpy(out20, digest + 12, 20);
+  return 1;
+}
+
+// Test hook: field multiplication mod p over big-endian 32-byte operands.
+// Exists so the carry-fold edge cases of fe_mul stay regression-tested from
+// Python (see tests/test_crypto.py).
+void coreth_test_fe_mul(const uint8_t* a32, const uint8_t* b32,
+                        uint8_t* out32) {
+  U256 a, b, r;
+  load_be(a, a32);
+  load_be(b, b32);
+  fe_mul(r, a, b);
+  store_be(out32, r);
+}
+
+// Batched recovery: packed 32-byte hashes / r / s, recid bytes.
+// out: packed 20-byte addresses; ok[i] = 1 on success.
+void coreth_ecrecover_batch(const uint8_t* hashes, const uint8_t* rs,
+                            const uint8_t* ss, const uint8_t* recids,
+                            uint64_t n, uint8_t* out, uint8_t* ok) {
+  for (uint64_t i = 0; i < n; ++i)
+    ok[i] = (uint8_t)coreth_ecrecover(hashes + 32 * i, rs + 32 * i,
+                                      ss + 32 * i, recids[i], out + 20 * i);
+}
+
+}  // extern "C"

@@ -1,0 +1,141 @@
+"""Crypto foundation tests: keccak (py / native / device) and secp256k1.
+
+Anchored on well-known public vectors:
+  - keccak256("")    = c5d246...5a470 (the EVM empty-code hash / empty trie leaf)
+  - keccak256("abc") = 4e0365...d6c45
+  - privkey 1 -> address 0x7E5F4552091A69125d5DfCb7b8C2659029395Bdf
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from coreth_tpu.crypto import keccak as K
+from coreth_tpu.crypto import secp256k1 as S
+
+V_EMPTY = bytes.fromhex(
+    "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+V_ABC = bytes.fromhex(
+    "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45")
+
+
+def test_keccak_known_vectors():
+    assert K.keccak256_py(b"") == V_EMPTY
+    assert K.keccak256_py(b"abc") == V_ABC
+
+
+def test_keccak_multiblock():
+    # exercise rate-block boundaries; digests must be 32B and all distinct
+    seen = set()
+    for n in (0, 1, 55, 56, 135, 136, 137, 272, 300):
+        d = K.keccak256_py(bytes([i % 256 for i in range(n)]))
+        assert len(d) == 32
+        seen.add(d)
+    assert len(seen) == 9
+
+
+def test_keccak_native_matches_python():
+    from coreth_tpu.crypto import native
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    for n in (0, 1, 31, 32, 64, 135, 136, 137, 500):
+        msg = bytes([(i * 7 + 3) % 256 for i in range(n)])
+        assert native.keccak256_native(msg) == K.keccak256_py(msg)
+
+
+def test_keccak_device_fixed():
+    from coreth_tpu.ops import keccak as DK
+    msgs = [bytes([(i + j) % 256 for i in range(64)]) for j in range(5)]
+    words = DK.pack_fixed(msgs, 64)
+    out = np.asarray(DK.keccak256_fixed(words, 64))
+    got = DK.digest_words_to_bytes(out)
+    for m, d in zip(msgs, got):
+        assert d == K.keccak256_py(m)
+
+
+def test_keccak_device_blocks_variable_length():
+    from coreth_tpu.ops import keccak as DK
+    msgs = [b"", b"abc", bytes(136), bytes([i % 256 for i in range(137)]),
+            bytes([i % 251 for i in range(400)])]
+    blocks, nblocks = DK.pack_blocks(msgs)
+    out = np.asarray(DK.keccak256_blocks(blocks, nblocks))
+    got = DK.digest_words_to_bytes(out)
+    for m, d in zip(msgs, got):
+        assert d == K.keccak256_py(m)
+    assert got[0] == V_EMPTY
+
+
+def test_secp256k1_known_address():
+    assert S.priv_to_address(1).hex() == "7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+
+
+def test_secp256k1_curve_sanity():
+    assert S._on_curve(S.Gx, S.Gy)
+    # n*G == infinity
+    assert S._jac_mul((S.Gx, S.Gy, 1), S.N) is None
+
+
+def test_sign_recover_roundtrip():
+    for priv in (1, 2, 0xDEADBEEF, S.N - 2):
+        for msg in (b"\x01" * 32, K.keccak256_py(b"hello")):
+            r, s, recid = S.sign(msg, priv)
+            assert s <= S.N // 2
+            addr = S.recover_address_py(msg, r, s, recid)
+            assert addr == S.priv_to_address(priv)
+
+
+def test_recover_rejects_invalid():
+    with pytest.raises(ValueError):
+        S.recover_pubkey(b"\x00" * 32, 0, 1, 0)
+    with pytest.raises(ValueError):
+        S.recover_pubkey(b"\x00" * 32, S.N, 1, 0)
+
+
+def test_native_fe_mul_carry_band():
+    """Regression: fe_mul's second reduction fold can carry out of limb 3;
+    the dropped 2^256 must be folded back in as P_C (mod p)."""
+    import ctypes
+    from coreth_tpu.crypto import native
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    lib = native.load()
+    lib.coreth_test_fe_mul.argtypes = [ctypes.c_char_p] * 3
+    cases = [
+        (0x200000000000000000000000000000000000000000000000000000003,
+         0xDEBC32AB94B43FABCB3D33BEF15F01B6BB5DC8A5F93BB2A187AAE89CD3297E01),
+        (S.P - 1, S.P - 1),
+        (S.P - 1, 2),
+        (2**255, 2**255),
+        (0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF, 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF),
+    ]
+    for a, b in cases:
+        out = ctypes.create_string_buffer(32)
+        lib.coreth_test_fe_mul(a.to_bytes(32, "big"), b.to_bytes(32, "big"), out)
+        assert int.from_bytes(out.raw, "big") == (a * b) % S.P, (hex(a), hex(b))
+
+
+def test_native_recover_matches_python():
+    from coreth_tpu.crypto import native
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    for priv in (1, 2, 12345, 0xDEADBEEF):
+        msg = K.keccak256_py(priv.to_bytes(32, "big"))
+        r, s, recid = S.sign(msg, priv)
+        assert native.recover_address_native(msg, r, s, recid) == \
+            S.priv_to_address(priv)
+    # batch path
+    n = 8
+    hashes = b"".join(K.keccak256_py(bytes([i])) for i in range(n))
+    rs, ss, recids = b"", b"", b""
+    privs = [i + 1 for i in range(n)]
+    for i in range(n):
+        h = hashes[32 * i:32 * i + 32]
+        r, s, recid = S.sign(h, privs[i])
+        rs += r.to_bytes(32, "big")
+        ss += s.to_bytes(32, "big")
+        recids += bytes([recid])
+    addrs, ok = native.recover_addresses_batch(hashes, rs, ss, recids)
+    assert ok == b"\x01" * n
+    for i in range(n):
+        assert addrs[20 * i:20 * i + 20] == S.priv_to_address(privs[i])

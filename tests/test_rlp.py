@@ -1,0 +1,52 @@
+"""RLP codec tests against the canonical spec examples."""
+
+import pytest
+
+from coreth_tpu import rlp
+
+
+CASES = [
+    (b"dog", b"\x83dog"),
+    ([b"cat", b"dog"], b"\xc8\x83cat\x83dog"),
+    (b"", b"\x80"),
+    ([], b"\xc0"),
+    (b"\x00", b"\x00"),
+    (b"\x0f", b"\x0f"),
+    (b"\x04\x00", b"\x82\x04\x00"),
+    ([[], [[]], [[], [[]]]], b"\xc7\xc0\xc1\xc0\xc3\xc0\xc1\xc0"),
+    (b"Lorem ipsum dolor sit amet, consectetur adipisicing elit",
+     b"\xb8\x38Lorem ipsum dolor sit amet, consectetur adipisicing elit"),
+]
+
+
+@pytest.mark.parametrize("item,encoded", CASES)
+def test_encode(item, encoded):
+    assert rlp.encode(item) == encoded
+
+
+@pytest.mark.parametrize("item,encoded", CASES)
+def test_decode_roundtrip(item, encoded):
+    assert rlp.decode(encoded) == item
+
+
+def test_int_encoding():
+    assert rlp.encode(0) == b"\x80"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == b"\x82\x04\x00"
+    assert rlp.encode_uint(0) == b""
+    assert rlp.decode_uint(b"\x04\x00") == 1024
+
+
+def test_long_list():
+    items = [rlp.encode_uint(i) for i in range(100)]
+    enc = rlp.encode(items)
+    assert rlp.decode(enc) == [bytes(x) for x in items]
+
+
+def test_reject_noncanonical():
+    with pytest.raises(ValueError):
+        rlp.decode(b"\x81\x05")  # single byte <0x80 must be encoded as itself
+    with pytest.raises(ValueError):
+        rlp.decode(b"\x83do")  # truncated
+    with pytest.raises(ValueError):
+        rlp.decode(b"\x83dogX")  # trailing bytes
