@@ -1,0 +1,11 @@
+"""Merkle-Patricia trie.
+
+Twin of reference ``trie/`` (trie.go insert/delete/hash/commit,
+secure_trie.go keccak-keyed access, stacktrie.go ordered builder) with a
+TPU-friendly split: structural edits happen on host, hashing is batched —
+:mod:`coreth_tpu.mpt.rehash` collects dirty nodes level-by-level and
+hashes whole frontiers with the batched keccak kernel.
+"""
+
+from coreth_tpu.mpt.trie import Trie, SecureTrie, EMPTY_ROOT  # noqa: F401
+from coreth_tpu.mpt.stacktrie import StackTrie  # noqa: F401

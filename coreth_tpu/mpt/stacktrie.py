@@ -1,0 +1,26 @@
+"""StackTrie — ordered-insert trie builder.
+
+Role twin of reference trie/stacktrie.go (used for tx/receipt roots via
+DeriveSha, core/types/hashing.go:97, and for state-sync range rebuilds).
+This implementation reuses the structural engine from :mod:`mpt.trie`; the
+streaming early-hash optimization (hash-and-drop finished subtries) is a
+follow-up — correctness and the API contract come first.
+"""
+
+from __future__ import annotations
+
+from coreth_tpu.mpt.trie import Trie
+
+
+class StackTrie:
+    def __init__(self):
+        self._trie = Trie()
+
+    def update(self, key: bytes, value: bytes) -> None:
+        self._trie.update(key, value)
+
+    def hash(self) -> bytes:
+        return self._trie.hash()
+
+    def reset(self) -> None:
+        self._trie = Trie()

@@ -1,0 +1,404 @@
+"""Merkle-Patricia trie — host structural engine.
+
+Semantics per the Ethereum yellow-paper trie spec (reference trie/trie.go:
+insert :308, delete :413, Hash :573; hasher.go:69 collapse rules):
+
+- leaf:      [hex-prefix(nibbles, t=1), value]
+- extension: [hex-prefix(nibbles, t=0), child-ref]
+- branch:    [c0..c15, value]
+- a node's reference inside its parent is its RLP if len(rlp) < 32,
+  else keccak256(rlp); the root hash is always keccak256(rlp(root)).
+
+The in-memory representation is plain Python lists (mutable, cheap to
+edit); hashing walks bottom-up and can hand whole levels to the batched
+device keccak (mpt/rehash.py).  ``SecureTrie`` applies keccak to keys
+(reference trie/secure_trie.go).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from coreth_tpu import rlp
+from coreth_tpu.crypto import keccak256
+
+EMPTY_ROOT = keccak256(rlp.encode(b""))
+
+# Node model (mutable lists so edits are in place):
+#   ["L", nibbles(bytes), value(bytes)]              leaf
+#   ["E", nibbles(bytes), child]                     extension
+#   ["B", [child x 16], value(bytes)]                branch
+#   ["H", digest(bytes32)]                           hash reference (db-backed)
+#   None                                             empty
+
+LEAF, EXT, BRANCH, HASHREF = "L", "E", "B", "H"
+
+
+def hex_prefix(nibbles: bytes, is_leaf: bool) -> bytes:
+    """Hex-prefix encoding (yellow paper appendix C)."""
+    flag = 2 if is_leaf else 0
+    if len(nibbles) % 2:
+        out = bytearray([(flag + 1) << 4 | nibbles[0]])
+        rest = nibbles[1:]
+    else:
+        out = bytearray([flag << 4])
+        rest = nibbles
+    for i in range(0, len(rest), 2):
+        out.append(rest[i] << 4 | rest[i + 1])
+    return bytes(out)
+
+
+def decode_hex_prefix(data: bytes) -> Tuple[bytes, bool]:
+    flag = data[0] >> 4
+    is_leaf = flag >= 2
+    nibbles = bytearray()
+    if flag & 1:
+        nibbles.append(data[0] & 0x0F)
+    for b in data[1:]:
+        nibbles.append(b >> 4)
+        nibbles.append(b & 0x0F)
+    return bytes(nibbles), is_leaf
+
+
+def key_to_nibbles(key: bytes) -> bytes:
+    out = bytearray()
+    for b in key:
+        out.append(b >> 4)
+        out.append(b & 0x0F)
+    return bytes(out)
+
+
+def _common_prefix_len(a: bytes, b: bytes) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+class MissingNodeError(Exception):
+    """A hash reference was dereferenced but absent from the node store."""
+
+
+class Trie:
+    """In-memory MPT over an optional {hash: node-rlp} backing store."""
+
+    def __init__(self, root_hash: bytes = EMPTY_ROOT,
+                 db: Optional[Dict[bytes, bytes]] = None):
+        self.db = db if db is not None else {}
+        if root_hash == EMPTY_ROOT:
+            self.root = None
+        else:
+            self.root = [HASHREF, root_hash]
+        self._hash_cache: Optional[bytes] = None
+
+    # ------------------------------------------------------------------ get
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._get(self.root, key_to_nibbles(key))
+
+    def _resolve(self, node):
+        if node is not None and node[0] == HASHREF:
+            data = self.db.get(node[1])
+            if data is None:
+                raise MissingNodeError(node[1].hex())
+            return self._decode_node(rlp.decode(data))
+        return node
+
+    def _decode_node(self, items):
+        """RLP structure -> node model.  Child byte-strings of 32 bytes are
+        hash refs; nested lists are inlined nodes."""
+        if isinstance(items, list) and len(items) == 2:
+            nibbles, is_leaf = decode_hex_prefix(items[0])
+            if is_leaf:
+                return [LEAF, nibbles, items[1]]
+            return [EXT, nibbles, self._decode_ref(items[1])]
+        if isinstance(items, list) and len(items) == 17:
+            children = [self._decode_ref(c) if c else None
+                        for c in items[:16]]
+            return [BRANCH, children, items[16]]
+        raise ValueError("malformed trie node")
+
+    def _decode_ref(self, item):
+        if isinstance(item, list):
+            return self._decode_node(item)
+        if item == b"":
+            return None
+        if len(item) == 32:
+            return [HASHREF, item]
+        raise ValueError("malformed node reference")
+
+    def _get(self, node, nibbles: bytes) -> Optional[bytes]:
+        while True:
+            if node is None:
+                return None
+            node = self._resolve(node)
+            if node is None:
+                return None
+            kind = node[0]
+            if kind == LEAF:
+                return node[2] if node[1] == nibbles else None
+            if kind == EXT:
+                if nibbles[:len(node[1])] != node[1]:
+                    return None
+                nibbles = nibbles[len(node[1]):]
+                node = node[2]
+                continue
+            # branch
+            if not nibbles:
+                return node[2] or None
+            nxt = node[1][nibbles[0]]
+            nibbles = nibbles[1:]
+            node = nxt
+
+    # --------------------------------------------------------------- update
+    def update(self, key: bytes, value: bytes) -> None:
+        self._hash_cache = None
+        nibbles = key_to_nibbles(key)
+        if value:
+            self.root = self._insert(self.root, nibbles, value)
+        else:
+            self.root = self._delete(self.root, nibbles)
+
+    def delete(self, key: bytes) -> None:
+        self.update(key, b"")
+
+    def _insert(self, node, nibbles: bytes, value: bytes):
+        if node is None:
+            return [LEAF, nibbles, value]
+        node = self._resolve(node)
+        if node is None:
+            return [LEAF, nibbles, value]
+        kind = node[0]
+        if kind == LEAF:
+            existing = node[1]
+            if existing == nibbles:
+                node[2] = value
+                return node
+            cp = _common_prefix_len(existing, nibbles)
+            branch = [BRANCH, [None] * 16, b""]
+            # split both under a fresh branch at the divergence point
+            for nb, val in ((existing, node[2]), (nibbles, value)):
+                rest = nb[cp:]
+                if not rest:
+                    branch[2] = val
+                else:
+                    branch[1][rest[0]] = [LEAF, rest[1:], val]
+            if cp:
+                return [EXT, nibbles[:cp], branch]
+            return branch
+        if kind == EXT:
+            prefix = node[1]
+            cp = _common_prefix_len(prefix, nibbles)
+            if cp == len(prefix):
+                node[2] = self._insert(node[2], nibbles[cp:], value)
+                return node
+            # split the extension
+            branch = [BRANCH, [None] * 16, b""]
+            # remainder of the old extension path
+            old_rest = prefix[cp:]
+            child = node[2] if len(old_rest) == 1 else [EXT, old_rest[1:], node[2]]
+            branch[1][old_rest[0]] = child
+            new_rest = nibbles[cp:]
+            if not new_rest:
+                branch[2] = value
+            else:
+                branch[1][new_rest[0]] = [LEAF, new_rest[1:], value]
+            if cp:
+                return [EXT, nibbles[:cp], branch]
+            return branch
+        # branch
+        if not nibbles:
+            node[2] = value
+            return node
+        idx = nibbles[0]
+        node[1][idx] = self._insert(node[1][idx], nibbles[1:], value)
+        return node
+
+    # --------------------------------------------------------------- delete
+    def _delete(self, node, nibbles: bytes):
+        if node is None:
+            return None
+        node = self._resolve(node)
+        if node is None:
+            return None
+        kind = node[0]
+        if kind == LEAF:
+            return None if node[1] == nibbles else node
+        if kind == EXT:
+            prefix = node[1]
+            if nibbles[:len(prefix)] != prefix:
+                return node
+            child = self._delete(node[2], nibbles[len(prefix):])
+            if child is None:
+                return None
+            child = self._resolve(child)
+            # merge chains: ext+ext, ext+leaf
+            if child[0] == EXT:
+                return [EXT, prefix + child[1], child[2]]
+            if child[0] == LEAF:
+                return [LEAF, prefix + child[1], child[2]]
+            node[2] = child
+            return node
+        # branch
+        if not nibbles:
+            if not node[2]:
+                return node
+            node[2] = b""
+        else:
+            idx = nibbles[0]
+            node[1][idx] = self._delete(node[1][idx], nibbles[1:])
+        # collapse if <= 1 child remains
+        live = [(i, c) for i, c in enumerate(node[1]) if c is not None]
+        if node[2]:
+            if live:
+                return node
+            return [LEAF, b"", node[2]]
+        if len(live) > 1:
+            return node
+        if not live:
+            return None
+        idx, child = live[0]
+        child = self._resolve(child)
+        if child[0] == LEAF:
+            return [LEAF, bytes([idx]) + child[1], child[2]]
+        if child[0] == EXT:
+            return [EXT, bytes([idx]) + child[1], child[2]]
+        return [EXT, bytes([idx]), child]
+
+    # ----------------------------------------------------------------- hash
+    def _encode_node(self, node, acc: Optional[List[Tuple[bytes, bytes]]]):
+        """Node -> RLP bytes; children collapsed to refs.
+
+        acc, when given, collects (hash, rlp) for every node that hashes
+        (the commit set).
+        """
+        kind = node[0]
+        if kind == LEAF:
+            return rlp.encode([hex_prefix(node[1], True), node[2]])
+        if kind == EXT:
+            return rlp.encode([hex_prefix(node[1], False),
+                               self._ref(node[2], acc)])
+        if kind == BRANCH:
+            items = [self._ref(c, acc) if c is not None else b""
+                     for c in node[1]]
+            items.append(node[2])
+            return rlp.encode(items)
+        raise AssertionError("unreachable")
+
+    def _ref(self, node, acc):
+        if node[0] == HASHREF:
+            return node[1]
+        encoded = self._encode_node(node, acc)
+        if len(encoded) < 32:
+            # inlined: strip the outer list encoding by decoding again —
+            # parent embeds the structure, not a byte string
+            return rlp.decode(encoded)
+        h = keccak256(encoded)
+        if acc is not None:
+            acc.append((h, encoded))
+        return h
+
+    def hash(self) -> bytes:
+        """Root hash (reference trie.go:573 Hash)."""
+        if self._hash_cache is not None:
+            return self._hash_cache
+        if self.root is None:
+            self._hash_cache = EMPTY_ROOT
+            return EMPTY_ROOT
+        if self.root[0] == HASHREF:
+            return self.root[1]
+        encoded = self._encode_node(self.root, None)
+        self._hash_cache = keccak256(encoded)
+        return self._hash_cache
+
+    def commit(self) -> bytes:
+        """Hash and persist all nodes into the backing store.
+
+        Returns the root hash (reference trie.go:585 Commit +
+        committer.go).  The in-memory tree stays resident (it is the
+        clean cache); callers that want a pure hash use :meth:`hash`.
+        """
+        if self.root is None:
+            return EMPTY_ROOT
+        if self.root[0] == HASHREF:
+            return self.root[1]
+        acc: List[Tuple[bytes, bytes]] = []
+        encoded = self._encode_node(self.root, acc)
+        root_hash = keccak256(encoded)
+        self.db[root_hash] = encoded
+        for h, data in acc:
+            self.db[h] = data
+        self._hash_cache = root_hash
+        return root_hash
+
+    def copy(self) -> "Trie":
+        t = Trie(db=self.db)
+        t.root = _deep_copy(self.root)
+        t._hash_cache = self._hash_cache
+        return t
+
+    # ------------------------------------------------------------- iterate
+    def items(self):
+        """Yield (key_nibbles, value) in lexicographic key order."""
+        yield from self._iter(self.root, b"")
+
+    def _iter(self, node, prefix: bytes):
+        if node is None:
+            return
+        node = self._resolve(node)
+        if node is None:
+            return
+        kind = node[0]
+        if kind == LEAF:
+            yield prefix + node[1], node[2]
+        elif kind == EXT:
+            yield from self._iter(node[2], prefix + node[1])
+        else:
+            if node[2]:
+                yield prefix, node[2]
+            for i, c in enumerate(node[1]):
+                if c is not None:
+                    yield from self._iter(c, prefix + bytes([i]))
+
+
+def _deep_copy(node):
+    if node is None:
+        return None
+    kind = node[0]
+    if kind == LEAF:
+        return [LEAF, node[1], node[2]]
+    if kind == EXT:
+        return [EXT, node[1], _deep_copy(node[2])]
+    if kind == BRANCH:
+        return [BRANCH, [_deep_copy(c) for c in node[1]], node[2]]
+    return [HASHREF, node[1]]
+
+
+class SecureTrie(Trie):
+    """Trie with keccak256-hashed keys (reference trie/secure_trie.go).
+
+    Keeps the preimage map so callers can enumerate plain keys.
+    """
+
+    def __init__(self, root_hash: bytes = EMPTY_ROOT,
+                 db: Optional[Dict[bytes, bytes]] = None):
+        super().__init__(root_hash, db)
+        self.preimages: Dict[bytes, bytes] = {}
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return super().get(keccak256(key))
+
+    def update(self, key: bytes, value: bytes) -> None:
+        hk = keccak256(key)
+        self.preimages[hk] = key
+        super().update(hk, value)
+
+    def delete(self, key: bytes) -> None:
+        self.update(key, b"")
+
+    def copy(self) -> "SecureTrie":
+        t = SecureTrie(db=self.db)
+        t.root = _deep_copy(self.root)
+        t._hash_cache = self._hash_cache
+        t.preimages = dict(self.preimages)
+        return t

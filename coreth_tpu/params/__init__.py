@@ -1,0 +1,23 @@
+"""Chain configuration, fork schedule, and protocol gas constants.
+
+Semantic twin of reference ``params/`` (config.go:474, protocol_params.go,
+avalanche_params.go).  The constants are protocol facts — they must match
+the Ethereum/Avalanche specification bit-for-bit; everything else (the
+Python shape of the config object, the Rules resolution) is our own design.
+"""
+
+from coreth_tpu.params.protocol import *  # noqa: F401,F403
+from coreth_tpu.params.config import (  # noqa: F401
+    ChainConfig,
+    Rules,
+    TEST_CHAIN_CONFIG,
+    TEST_LAUNCH_CONFIG,
+    TEST_APRICOT_PHASE1_CONFIG,
+    TEST_APRICOT_PHASE2_CONFIG,
+    TEST_APRICOT_PHASE3_CONFIG,
+    TEST_APRICOT_PHASE4_CONFIG,
+    TEST_APRICOT_PHASE5_CONFIG,
+    TEST_BANFF_CONFIG,
+    TEST_CORTINA_CONFIG,
+    TEST_DURANGO_CONFIG,
+)

@@ -1,0 +1,31 @@
+"""Consensus types: transactions, headers, blocks, receipts, logs, accounts.
+
+Semantic twin of reference ``core/types/`` (block.go, tx_*.go,
+transaction_signing.go, receipt.go, bloom9.go, state_account.go,
+hashing.go) with the Avalanche extras: Header carries ExtDataHash /
+ExtDataGasUsed / BlockGasCost, Block carries ExtData (the atomic-tx
+payload), and StateAccount carries the multicoin flag.
+"""
+
+from coreth_tpu.types.account import (  # noqa: F401
+    EMPTY_CODE_HASH,
+    EMPTY_ROOT_HASH,
+    StateAccount,
+)
+from coreth_tpu.types.transaction import (  # noqa: F401
+    AccessListTx,
+    DynamicFeeTx,
+    LegacyTx,
+    Transaction,
+    LatestSigner,
+    sign_tx,
+)
+from coreth_tpu.types.receipt import (  # noqa: F401
+    Log,
+    Receipt,
+    bloom9,
+    logs_bloom,
+    create_bloom,
+)
+from coreth_tpu.types.block import Block, Header  # noqa: F401
+from coreth_tpu.types.hashing import derive_sha  # noqa: F401

@@ -1,0 +1,23 @@
+"""derive_sha — tx/receipt/withdrawal root derivation.
+
+Twin of reference core/types/hashing.go:97 DeriveSha: item i is inserted
+at key rlp(i) with its consensus encoding as the value; the root of the
+resulting trie is the header's TxHash / ReceiptHash.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from coreth_tpu import rlp
+from coreth_tpu.mpt import StackTrie
+
+
+def derive_sha(items: Sequence) -> bytes:
+    """Root over items exposing ``.encode()`` or ``.encode_consensus()``."""
+    trie = StackTrie()
+    for i, item in enumerate(items):
+        enc = (item.encode_consensus() if hasattr(item, "encode_consensus")
+               else item.encode())
+        trie.update(rlp.encode(rlp.encode_uint(i)), enc)
+    return trie.hash()

@@ -1,0 +1,181 @@
+"""MPT correctness against public Ethereum trie test vectors."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from coreth_tpu.mpt import Trie, SecureTrie, EMPTY_ROOT
+from coreth_tpu.mpt.trie import hex_prefix, decode_hex_prefix, key_to_nibbles
+
+
+def test_empty_root():
+    assert EMPTY_ROOT.hex() == (
+        "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421")
+    assert Trie().hash() == EMPTY_ROOT
+
+
+def test_hex_prefix_roundtrip():
+    for nibbles in (b"", b"\x01", b"\x01\x02", b"\x0f\x00\x0a"):
+        for leaf in (False, True):
+            enc = hex_prefix(nibbles, leaf)
+            dec, is_leaf = decode_hex_prefix(enc)
+            assert dec == nibbles and is_leaf == leaf
+
+
+# Vectors from the canonical ethereum/tests trietest.json corpus.
+def test_single_entry():
+    t = Trie()
+    t.update(b"A", b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa")
+    assert t.hash().hex() == (
+        "d23786fb4a010da3ce639d66d5e904a11dbc02746d1ce25029e53290cabf28ab")
+
+
+def test_branching():
+    # "branchingTests" vector: keys under 0x04/0x vs others
+    t = Trie()
+    pairs = [
+        (bytes.fromhex("04110d816c380812a427968ece99b1c963dfbce6"), b"something"),
+        (bytes.fromhex("095e7baea6a6c7c4c2dfeb977efac326af552d87"), b"something"),
+        (bytes.fromhex("0a517d755cebbf66312b30fff713666a9cb917e0"), b"something"),
+        (bytes.fromhex("24dd378f51adc67a50e339e8031fe9bd4aafab36"), b"something"),
+        (bytes.fromhex("293f982d000532a7861ab122bdc4bbfd26bf9030"), b"something"),
+        (bytes.fromhex("2cf5732f017b0cf1b1f13a1478e10239716bf6b5"), b"something"),
+        (bytes.fromhex("31c640b92c21a1f1465c91070b4b3b4d6854195f"), b"something"),
+        (bytes.fromhex("37f998764813b136ddf5a754f34063fd03065e36"), b"something"),
+        (bytes.fromhex("37fa399a749c121f8a15ce77e3d9f9bec8020d7a"), b"something"),
+        (bytes.fromhex("4f36659fa632310b6ec438dea4085b522a2dd077"), b"something"),
+        (bytes.fromhex("62c01474f089b07dae603491675dc5b5748f7049"), b"something"),
+        (bytes.fromhex("729af7294be595a0efd7d891c9e51f89c07950c7"), b"something"),
+        (bytes.fromhex("83e3e5a16d3b696a0314b30b2534804dd5e11197"), b"something"),
+        (bytes.fromhex("8703df2417e0d7c59d063caa9583cb10a4d20532"), b"something"),
+        (bytes.fromhex("8dffcd74e5b5923512916c6a64b502689cfa65e1"), b"something"),
+        (bytes.fromhex("95a4d7cccb5204733874fa87285a176fe1e9e240"), b"something"),
+        (bytes.fromhex("99b2fcba8120bedd048fe79f5262a6690ed38c39"), b"something"),
+        (bytes.fromhex("a4202b8b8afd5354e3e40a219bdc17f6001bf2cf"), b"something"),
+        (bytes.fromhex("a94f5374fce5edbc8e2a8697c15331677e6ebf0b"), b"something"),
+        (bytes.fromhex("a9647f4a0a14042d91dc33c0328030a7157c93ae"), b"something"),
+        (bytes.fromhex("aa6cffe5185732689c18f37a7f86170cb7304c2a"), b"something"),
+        (bytes.fromhex("aae4a2e3c51c04606dcb3723456e58f3ed214f45"), b"something"),
+        (bytes.fromhex("c37a43e940dfb5baf581a0b82b351d48305fc885"), b"something"),
+        (bytes.fromhex("d2571607e241ecf590ed94b12d87c94babe36db6"), b"something"),
+        (bytes.fromhex("f735071cbee190d76b704ce68384fc21e389fbe7"), b"something"),
+    ]
+    for k, v in pairs:
+        t.update(k, v)
+    for k, _ in pairs:
+        t.update(k, b"")
+    assert t.hash() == EMPTY_ROOT
+
+
+def test_delete_vector():
+    # trie_test.go TestDelete / TestEmptyValues: both interleaved deletes and
+    # empty-value updates must land on the same root.
+    pairs = [
+        (b"do", b"verb"),
+        (b"ether", b"wookiedoo"),
+        (b"horse", b"stallion"),
+        (b"shaman", b"horse"),
+        (b"doge", b"coin"),
+        (b"ether", b""),
+        (b"dog", b"puppy"),
+        (b"shaman", b""),
+    ]
+    expected = "5991bb8c6514148a29db676a14ac506cd2cd5775ace63c30a4fe457715e9ac84"
+    t = Trie()
+    for k, v in pairs:
+        if v:
+            t.update(k, v)
+        else:
+            t.delete(k)
+    assert t.hash().hex() == expected
+    t2 = Trie()
+    for k, v in pairs:
+        t2.update(k, v)  # empty value acts as delete
+    assert t2.hash().hex() == expected
+
+
+def test_branch_value_self_consistency():
+    # variable-length keys put values on branch nodes; insertion order must
+    # not matter
+    import itertools
+    pairs = [(b"abc", b"abc"), (b"abcd", b"abcd"), (b"ab", b"x"),
+             (b"b", b"yy")]
+    roots = set()
+    for perm in itertools.permutations(pairs):
+        t = Trie()
+        for k, v in perm:
+            t.update(k, v)
+        roots.add(t.hash())
+    assert len(roots) == 1
+
+
+def test_secure_trie_keys_are_hashed():
+    t = SecureTrie()
+    t.update(b"foo", b"bar")
+    assert t.get(b"foo") == b"bar"
+    plain = Trie()
+    from coreth_tpu.crypto import keccak256
+    plain.update(keccak256(b"foo"), b"bar")
+    assert t.hash() == plain.hash()
+
+
+def test_get_after_updates():
+    t = Trie()
+    t.update(b"doe", b"reindeer")
+    t.update(b"dog", b"puppy")
+    t.update(b"dogglesworth", b"cat")
+    assert t.get(b"dog") == b"puppy"
+    assert t.get(b"doe") == b"reindeer"
+    assert t.get(b"dogglesworth") == b"cat"
+    assert t.get(b"unknown") is None
+    assert t.hash().hex() == (
+        "8aad789dff2f538bca5d8ea56e8abe10f4c7ba3a5dea95fea4cd6e7c3a1168d3")
+
+
+def test_commit_reload():
+    db = {}
+    t = Trie(db=db)
+    pairs = [(f"key-{i}".encode(), f"value-{i}".encode() * (i % 7 + 1))
+             for i in range(100)]
+    for k, v in pairs:
+        t.update(k, v)
+    root = t.commit()
+    # reopen from the db
+    t2 = Trie(root_hash=root, db=db)
+    for k, v in pairs:
+        assert t2.get(k) == v
+    assert t2.hash() == root
+    # delete half, check parity with a freshly built trie
+    for k, _ in pairs[::2]:
+        t2.delete(k)
+    fresh = Trie()
+    for k, v in pairs[1::2]:
+        fresh.update(k, v)
+    assert t2.hash() == fresh.hash()
+
+
+def test_random_parity_with_model():
+    """Randomized insert/delete parity against a dict model, with root
+    equality to a freshly-built trie at every checkpoint."""
+    import random
+    rng = random.Random(1234)
+    t = Trie()
+    model = {}
+    for step in range(2000):
+        k = rng.randrange(256).to_bytes(rng.choice([1, 2, 3]), "big")
+        if rng.random() < 0.3 and model:
+            k = rng.choice(list(model))
+            t.delete(k)
+            model.pop(k, None)
+        else:
+            v = bytes([rng.randrange(256)]) * rng.randrange(1, 40)
+            t.update(k, v)
+            model[k] = v
+        if step % 400 == 0:
+            fresh = Trie()
+            for mk, mv in model.items():
+                fresh.update(mk, mv)
+            assert t.hash() == fresh.hash()
+    for mk, mv in model.items():
+        assert t.get(mk) == mv

@@ -1,0 +1,126 @@
+"""Transaction / header / receipt consensus-encoding tests."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from coreth_tpu import rlp
+from coreth_tpu.types import (
+    AccessListTx, DynamicFeeTx, LegacyTx, Transaction, LatestSigner, sign_tx,
+    Header, Block, Receipt, Log, derive_sha, logs_bloom, StateAccount,
+    EMPTY_ROOT_HASH,
+)
+from coreth_tpu.types.block import EMPTY_UNCLE_HASH, EMPTY_EXT_DATA_HASH
+
+
+def test_eip155_spec_vector():
+    """The worked example from the EIP-155 specification."""
+    tx = LegacyTx(
+        nonce=9,
+        gas_price=20 * 10**9,
+        gas=21000,
+        to=bytes.fromhex("3535353535353535353535353535353535353535"),
+        value=10**18,
+        data=b"",
+    )
+    sig_hash = tx.sig_hash(chain_id=1)
+    assert sig_hash.hex() == (
+        "daf5a779ae972f972197303d7b574746c7ef83eadac0f2791ad23db92e4c8e53")
+    priv = int.from_bytes(bytes.fromhex("46" * 32), "big")
+    signed = sign_tx(tx, priv, chain_id=1)
+    assert signed.inner.v == 37
+    assert signed.inner.r == int(
+        "18515461264373351373200002665853028612451056578545711640558177340"
+        "181847433846")
+    assert signed.inner.s == int(
+        "46948507304638947509940763649030358759909902576025900602547168820"
+        "602576006531")
+    # recover round trip through an un-cached wrapper
+    wire = signed.encode()
+    decoded = Transaction.decode(wire)
+    signer = LatestSigner(chain_id=1)
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    assert signer.sender(decoded) == priv_to_address(priv)
+
+
+def test_typed_tx_roundtrip():
+    priv = 0xA1B2C3D4E5F60718293A4B5C6D7E8F90A1B2C3D4E5F60718293A4B5C6D7E8F90
+    for inner in (
+        AccessListTx(chain_id_=43111, nonce=3, gas_price=225 * 10**9,
+                     gas=100_000, to=b"\x11" * 20, value=5,
+                     data=b"\xde\xad",
+                     al=[(b"\x22" * 20, [b"\x00" * 32, b"\x01" * 32])]),
+        DynamicFeeTx(chain_id_=43111, nonce=7, gas_tip_cap_=10**9,
+                     gas_fee_cap_=300 * 10**9, gas=21000, to=b"\x33" * 20,
+                     value=123456789, data=b""),
+        LegacyTx(nonce=0, gas_price=470 * 10**9, gas=21000, to=None,
+                 value=0, data=b"\x60\x00\x60\x00"),
+    ):
+        tx = sign_tx(inner, priv, chain_id=43111)
+        wire = tx.encode()
+        decoded = Transaction.decode(wire)
+        assert decoded.encode() == wire
+        assert decoded.hash() == tx.hash()
+        signer = LatestSigner(43111)
+        from coreth_tpu.crypto.secp256k1 import priv_to_address
+        assert signer.sender(decoded) == priv_to_address(priv)
+
+
+def test_header_rlp_roundtrip():
+    h = Header(number=42, gas_limit=8_000_000, gas_used=21000,
+               time=1_700_000_000, base_fee=25 * 10**9,
+               ext_data_gas_used=0, block_gas_cost=100_000,
+               extra=b"\x00" * 80)
+    data = h.encode()
+    h2 = Header.decode(data)
+    assert h2 == h
+    assert h.hash() == h2.hash()
+    # legacy header (no optional tail) must omit the fields entirely
+    legacy = Header(number=1)
+    items = rlp.decode(legacy.encode())
+    assert len(items) == 16
+
+
+def test_block_roundtrip_with_extdata():
+    priv = 0x1234
+    tx = sign_tx(LegacyTx(nonce=0, gas_price=1, gas=21000, to=b"\x01" * 20,
+                          value=1), priv, chain_id=43111)
+    blk = Block(Header(number=7), [tx], version=0,
+                extdata=b"atomic-tx-bytes")
+    data = blk.encode()
+    blk2 = Block.decode(data)
+    assert blk2.header == blk.header
+    assert blk2.extdata == b"atomic-tx-bytes"
+    assert [t.hash() for t in blk2.transactions] == [tx.hash()]
+    assert blk2.hash() == blk.hash()
+
+
+def test_empty_roots():
+    assert derive_sha([]) == EMPTY_ROOT_HASH
+    assert EMPTY_UNCLE_HASH.hex() == (
+        "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347")
+    from coreth_tpu.crypto import keccak256
+    assert EMPTY_EXT_DATA_HASH == keccak256(rlp.encode(b""))
+
+
+def test_receipt_bloom_and_derive():
+    log = Log(address=b"\xAA" * 20, topics=[b"\x01" * 32], data=b"hello")
+    r1 = Receipt(tx_type=0, status=1, cumulative_gas_used=21000, logs=[log])
+    r2 = Receipt(tx_type=2, status=0, cumulative_gas_used=42000, logs=[])
+    bloom = logs_bloom([log])
+    assert sum(bin(b).count("1") for b in bloom) <= 6  # 3 bits per value x2
+    root = derive_sha([r1, r2])
+    assert len(root) == 32 and root != EMPTY_ROOT_HASH
+    # typed receipt consensus encoding is prefixed with the tx type
+    assert r2.encode_consensus()[0] == 2
+
+
+def test_state_account_rlp():
+    acct = StateAccount(nonce=5, balance=10**18, is_multi_coin=True)
+    data = acct.rlp()
+    back = StateAccount.from_rlp(data)
+    assert back == acct
+    # multicoin flag participates in the encoding (coreth consensus rule)
+    plain = StateAccount(nonce=5, balance=10**18, is_multi_coin=False)
+    assert plain.rlp() != data
