@@ -1,0 +1,10 @@
+"""Chain orchestration: genesis, block generation fixtures, blockchain.
+
+Semantic twin of reference core/genesis.go, core/chain_makers.go,
+core/blockchain.go (consensus-less insert/accept/reject lifecycle) and
+core/block_validator.go.
+"""
+
+from coreth_tpu.chain.genesis import Genesis, GenesisAccount  # noqa: F401
+from coreth_tpu.chain.chain_makers import generate_chain, BlockGen  # noqa: F401
+from coreth_tpu.chain.blockchain import BlockChain  # noqa: F401

@@ -1,0 +1,220 @@
+"""BlockChain: consensus-less chain store + processing orchestrator.
+
+Twin of reference core/blockchain.go, restructured around the snowman
+lifecycle (SURVEY.md section 1): blocks are inserted individually —
+possibly as competing siblings — via :meth:`insert_block`, and only
+become canonical on :meth:`accept`.  The per-phase timers replicate the
+metric split at blockchain.go:1343-1357 (execution / validation /
+state-root hashing / write) so TPU-vs-host comparisons decompose the
+same way.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from coreth_tpu.chain.genesis import Genesis
+from coreth_tpu.consensus.engine import ConsensusError, DummyEngine
+from coreth_tpu.params import ChainConfig
+from coreth_tpu.processor.state_processor import Processor
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.types import Block, Receipt, create_bloom, derive_sha
+from coreth_tpu.types.block import calc_ext_data_hash
+
+
+@dataclass
+class PhaseTimers:
+    """blockchain.go:1343-1357 insert-phase decomposition (seconds)."""
+    sender_recover: float = 0.0
+    execution: float = 0.0
+    validation: float = 0.0
+    state_root: float = 0.0
+    write: float = 0.0
+    total: float = 0.0
+    blocks: int = 0
+
+    def row(self) -> dict:
+        return {k: getattr(self, k) for k in
+                ("sender_recover", "execution", "validation", "state_root",
+                 "write", "total", "blocks")}
+
+
+class BadBlockError(Exception):
+    pass
+
+
+@dataclass
+class _Entry:
+    block: Block
+    receipts: List[Receipt] = field(default_factory=list)
+    status: str = "processed"  # processed | accepted | rejected
+
+
+class BlockChain:
+    def __init__(self, genesis: Genesis, db: Optional[Database] = None,
+                 engine: Optional[DummyEngine] = None):
+        self.db = db if db is not None else Database()
+        self.config: ChainConfig = genesis.config
+        self.engine = engine or DummyEngine()
+        self.engine.set_config(self.config)
+        self.genesis_block = genesis.to_block(self.db)
+        self.processor = Processor(self.config, engine=self.engine)
+        g = self.genesis_block
+        self._blocks: Dict[bytes, _Entry] = {
+            g.hash(): _Entry(g, status="accepted")}
+        self._canonical: Dict[int, bytes] = {0: g.hash()}
+        self.last_accepted: Block = g
+        self._preferred: Block = g
+        self.timers = PhaseTimers()
+
+    # ------------------------------------------------------------- accessors
+    def current_block(self) -> Block:
+        return self._preferred
+
+    def get_block(self, block_hash: bytes) -> Optional[Block]:
+        entry = self._blocks.get(block_hash)
+        return entry.block if entry else None
+
+    def get_block_by_number(self, number: int) -> Optional[Block]:
+        h = self._canonical.get(number)
+        return self._blocks[h].block if h else None
+
+    def get_receipts(self, block_hash: bytes) -> Optional[List[Receipt]]:
+        entry = self._blocks.get(block_hash)
+        return entry.receipts if entry else None
+
+    def has_state(self, root: bytes) -> bool:
+        from coreth_tpu.mpt import EMPTY_ROOT
+        return (root == EMPTY_ROOT or root in self.db.trie_cache
+                or root in self.db.node_db)
+
+    def state_at(self, root: bytes) -> StateDB:
+        return StateDB(root, self.db)
+
+    def _ancestry_hash_fn(self, parent: Block):
+        """BLOCKHASH resolver walking header ancestry from [parent]
+        (geth GetHashFn) — correct even for inserted-but-unaccepted
+        chains and competing siblings, where the accepted-canonical map
+        would lie."""
+        def get_hash(number: int) -> bytes:
+            cur = parent
+            while cur.number > number:
+                entry = self._blocks.get(cur.parent_hash)
+                if entry is None:
+                    return b"\x00" * 32
+                cur = entry.block
+            return cur.hash() if cur.number == number else b"\x00" * 32
+        return get_hash
+
+    # ------------------------------------------------------------ validation
+    def _validate_body(self, block: Block) -> None:
+        """ValidateBody (block_validator.go): structural roots."""
+        header = block.header
+        tx_root = derive_sha(block.transactions)
+        if tx_root != header.tx_hash:
+            raise BadBlockError(
+                f"tx root mismatch: {tx_root.hex()} != "
+                f"{header.tx_hash.hex()}")
+        if calc_ext_data_hash(block.ext_data()) != header.ext_data_hash:
+            raise BadBlockError("extdata hash mismatch")
+        if block.uncles:
+            raise BadBlockError("uncles are not allowed")
+
+    def _validate_state(self, block: Block, statedb: StateDB,
+                        receipts: List[Receipt], used_gas: int) -> bytes:
+        """ValidateState (block_validator.go): post-execution roots."""
+        header = block.header
+        if header.gas_used != used_gas:
+            raise BadBlockError(
+                f"gas used mismatch: header {header.gas_used}, "
+                f"actual {used_gas}")
+        bloom = create_bloom(receipts)
+        if bloom != header.bloom:
+            raise BadBlockError("bloom mismatch")
+        receipt_root = derive_sha(receipts)
+        if receipt_root != header.receipt_hash:
+            raise BadBlockError(
+                f"receipt root mismatch: {receipt_root.hex()} != "
+                f"{header.receipt_hash.hex()}")
+        t0 = _time.monotonic()
+        root = statedb.intermediate_root(self.config.is_eip158(header.number))
+        self.timers.state_root += _time.monotonic() - t0
+        if root != header.root:
+            raise BadBlockError(
+                f"state root mismatch: {root.hex()} != {header.root.hex()}")
+        return root
+
+    # --------------------------------------------------------------- insert
+    def insert_block(self, block: Block) -> None:
+        """InsertBlockManual (blockchain.go:1241-1357): verify + execute +
+        keep resident; canonicality is decided later by accept()."""
+        t_start = _time.monotonic()
+        if block.hash() in self._blocks:
+            return
+        parent_entry = self._blocks.get(block.parent_hash)
+        if parent_entry is None:
+            raise BadBlockError("unknown ancestor")
+        parent = parent_entry.block
+        self.engine.verify_header(self.config, block.header, parent.header)
+        self._validate_body(block)
+        t0 = _time.monotonic()
+        # warm the sender cache (senderCacher.Recover analog; the TPU
+        # path batches this through the native/ecrecover kernel)
+        from coreth_tpu.types import LatestSigner
+        signer = LatestSigner(self.config.chain_id)
+        for tx in block.transactions:
+            signer.sender(tx)
+        self.timers.sender_recover += _time.monotonic() - t0
+        statedb = StateDB(parent.root, self.db)
+        t0 = _time.monotonic()
+        receipts, logs, used_gas = self.processor.process(
+            block, parent.header, statedb,
+            get_hash=self._ancestry_hash_fn(parent))
+        self.timers.execution += _time.monotonic() - t0
+        t0 = _time.monotonic()
+        self._validate_state(block, statedb, receipts, used_gas)
+        self.timers.validation += _time.monotonic() - t0
+        t0 = _time.monotonic()
+        statedb.commit(delete_empty_objects=True)
+        self.timers.write += _time.monotonic() - t0
+        for i, r in enumerate(receipts):
+            r.block_hash = block.hash()
+            r.transaction_index = i
+        self._blocks[block.hash()] = _Entry(block, receipts)
+        self._preferred = block
+        self.timers.total += _time.monotonic() - t_start
+        self.timers.blocks += 1
+
+    def insert_chain(self, blocks: List[Block]) -> int:
+        for i, b in enumerate(blocks):
+            self.insert_block(b)
+            self.accept(b.hash())
+        return len(blocks)
+
+    # -------------------------------------------------------- accept/reject
+    def accept(self, block_hash: bytes) -> None:
+        """Accept (blockchain.go:1041): make canonical + durable."""
+        entry = self._blocks.get(block_hash)
+        if entry is None:
+            raise BadBlockError("accepting unknown block")
+        block = entry.block
+        if block.parent_hash != self.last_accepted.hash():
+            raise BadBlockError(
+                "accepted block is not a child of the last accepted block")
+        entry.status = "accepted"
+        self._canonical[block.number] = block_hash
+        self.last_accepted = block
+
+    def reject(self, block_hash: bytes) -> None:
+        """Reject (blockchain.go:1074)."""
+        entry = self._blocks.get(block_hash)
+        if entry is not None:
+            entry.status = "rejected"
+
+    def set_preference(self, block_hash: bytes) -> None:
+        entry = self._blocks.get(block_hash)
+        if entry is None:
+            raise BadBlockError("preferring unknown block")
+        self._preferred = entry.block

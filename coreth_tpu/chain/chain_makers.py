@@ -1,0 +1,130 @@
+"""Deterministic chain generation for tests and benchmarks.
+
+Twin of reference core/chain_makers.go (BlockGen :47, GenerateChain
+:245): build N blocks by applying txs against a live StateDB, finalizing
+each through the dummy engine so headers carry correct fee fields.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from coreth_tpu.consensus import calc_base_fee
+from coreth_tpu.consensus.engine import DummyEngine, ConsensusCallbacks
+from coreth_tpu.params import ChainConfig
+from coreth_tpu.params import protocol as P
+from coreth_tpu.processor.message import tx_to_message
+from coreth_tpu.processor.state_processor import (
+    apply_transaction, new_block_context,
+)
+from coreth_tpu.processor.state_transition import GasPool
+from coreth_tpu.evm import EVM, TxContext
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.types import Block, Header, Receipt, Transaction, LatestSigner
+
+
+class BlockGen:
+    """Per-block generation context (chain_makers.go:47)."""
+
+    def __init__(self, index: int, parent: Block, statedb: StateDB,
+                 config: ChainConfig, engine: DummyEngine, gap: int):
+        self.index = index
+        self.parent = parent
+        self.statedb = statedb
+        self.config = config
+        self.engine = engine
+        self.header = _make_header(config, parent, statedb, gap)
+        self.txs: List[Transaction] = []
+        self.receipts: List[Receipt] = []
+        self.gas_pool = GasPool(self.header.gas_limit)
+        self.signer = LatestSigner(config.chain_id)
+        self._used_gas = [0]
+        self._evm: Optional[EVM] = None
+
+    def set_coinbase(self, addr: bytes) -> None:
+        self.header.coinbase = addr
+
+    def set_timestamp(self, time: int) -> None:
+        self.header.time = time
+
+    @property
+    def base_fee(self):
+        return self.header.base_fee
+
+    def add_tx(self, tx: Transaction) -> None:
+        """AddTx (chain_makers.go:103): applies immediately; panics
+        (raises) if the tx is invalid."""
+        if self._evm is None:
+            ctx = new_block_context(self.header)
+            self._evm = EVM(ctx, TxContext(), self.statedb, self.config)
+        msg = tx_to_message(tx, self.signer, self.header.base_fee)
+        self.statedb.set_tx_context(tx.hash(), len(self.txs))
+        receipt = apply_transaction(
+            msg, self.gas_pool, self.statedb, self.header.number,
+            b"\x00" * 32, tx, self._used_gas, self._evm)
+        receipt.transaction_index = len(self.txs)
+        self.txs.append(tx)
+        self.receipts.append(receipt)
+
+    @property
+    def used_gas(self) -> int:
+        return self._used_gas[0]
+
+
+def _make_header(config: ChainConfig, parent: Block, statedb: StateDB,
+                 gap: int) -> Header:
+    """makeHeader (chain_makers.go:380): fee fields per fork."""
+    time = parent.time + gap
+    header = Header(
+        parent_hash=parent.hash(),
+        coinbase=b"\x00" * 20,
+        difficulty=1,
+        number=parent.number + 1,
+        time=time,
+    )
+    if config.is_cortina(time):
+        header.gas_limit = P.CORTINA_GAS_LIMIT
+    elif config.is_apricot_phase1(time):
+        header.gas_limit = P.APRICOT_PHASE1_GAS_LIMIT
+    else:
+        header.gas_limit = parent.gas_limit
+    if config.is_apricot_phase3(time):
+        window, base_fee = calc_base_fee(config, parent.header, time)
+        header.extra = window
+        header.base_fee = base_fee
+    return header
+
+
+def generate_chain(config: ChainConfig, parent: Block, db: Database,
+                   n: int, gen: Optional[Callable[[int, BlockGen], None]],
+                   gap: int = 10,
+                   engine: Optional[DummyEngine] = None
+                   ) -> Tuple[List[Block], List[List[Receipt]]]:
+    """GenerateChain (chain_makers.go:245).
+
+    Returns (blocks, receipts).  State is committed into [db] so the
+    chain can be inserted/replayed from it.
+    """
+    engine = engine or DummyEngine()
+    engine.set_config(config)
+    blocks: List[Block] = []
+    all_receipts: List[List[Receipt]] = []
+    for i in range(n):
+        statedb = StateDB(parent.root, db)
+        bg = BlockGen(i, parent, statedb, config, engine, gap)
+        if gen is not None:
+            gen(i, bg)
+        bg.header.gas_used = bg.used_gas
+        block = engine.finalize_and_assemble(
+            config, bg.header, parent.header, statedb, bg.txs, [],
+            bg.receipts)
+        statedb.commit(delete_empty_objects=True)
+        block_hash = block.hash()
+        for r in bg.receipts:
+            r.block_hash = block_hash
+            for log in r.logs:
+                log.block_hash = block_hash
+        blocks.append(block)
+        all_receipts.append(bg.receipts)
+        parent = block
+    return blocks, all_receipts

@@ -1,0 +1,75 @@
+"""Genesis specification -> genesis block + initial state.
+
+Twin of reference core/genesis.go (ToBlock :246, SetupGenesisBlock).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.params import ChainConfig
+from coreth_tpu.params import protocol as P
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.types import Block, Header
+
+
+@dataclass
+class GenesisAccount:
+    balance: int = 0
+    code: bytes = b""
+    nonce: int = 0
+    storage: Dict[bytes, bytes] = field(default_factory=dict)
+    mc_balance: Dict[bytes, int] = field(default_factory=dict)
+
+
+@dataclass
+class Genesis:
+    config: ChainConfig = field(default_factory=ChainConfig)
+    alloc: Dict[bytes, GenesisAccount] = field(default_factory=dict)
+    nonce: int = 0
+    timestamp: int = 0
+    extra_data: bytes = b""
+    gas_limit: int = 0
+    difficulty: int = 0
+    coinbase: bytes = b"\x00" * 20
+    base_fee: Optional[int] = None
+    number: int = 0
+    gas_used: int = 0
+    parent_hash: bytes = b"\x00" * 32
+
+    def to_block(self, db: Optional[Database] = None) -> Block:
+        """ToBlock (genesis.go:246): writes state into [db], returns the
+        genesis block."""
+        db = db if db is not None else Database()
+        statedb = StateDB(EMPTY_ROOT, db)
+        for addr, account in self.alloc.items():
+            statedb.add_balance(addr, account.balance)
+            if account.code:
+                statedb.set_code(addr, account.code)
+            if account.nonce:
+                statedb.set_nonce(addr, account.nonce)
+            for key, value in account.storage.items():
+                statedb.set_state(addr, key, value)
+            for coin_id, value in account.mc_balance.items():
+                statedb.add_balance_multi_coin(addr, coin_id, value)
+        root = statedb.commit(delete_empty_objects=False)
+        gas_limit = self.gas_limit or P.GENESIS_GAS_LIMIT
+        base_fee = self.base_fee
+        if self.config.is_apricot_phase3(0) and base_fee is None:
+            base_fee = P.APRICOT_PHASE3_INITIAL_BASE_FEE
+        header = Header(
+            parent_hash=self.parent_hash,
+            coinbase=self.coinbase,
+            root=root,
+            number=self.number,
+            gas_limit=gas_limit,
+            gas_used=self.gas_used,
+            time=self.timestamp,
+            extra=self.extra_data,
+            difficulty=self.difficulty,
+            nonce=self.nonce.to_bytes(8, "big"),
+            base_fee=base_fee,
+        )
+        return Block(header)

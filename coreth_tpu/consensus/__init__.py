@@ -1,0 +1,18 @@
+"""Consensus engine (the "dummy" engine twin).
+
+Reference consensus/dummy/: there is no mining — the engine verifies
+header gas/fee fields against the Avalanche dynamic-fee algorithm and
+finalizes blocks (applying atomic-tx callbacks).  Consensus decisions
+come from outside (snowman), see SURVEY.md section 1.
+"""
+
+from coreth_tpu.consensus.dynamic_fees import (  # noqa: F401
+    calc_base_fee,
+    calc_block_gas_cost,
+    estimate_next_base_fee,
+    min_required_tip,
+)
+from coreth_tpu.consensus.engine import (  # noqa: F401
+    ConsensusCallbacks,
+    DummyEngine,
+)

@@ -1,0 +1,12 @@
+"""The EVM.
+
+Semantic twin of reference ``core/vm/`` (evm.go, interpreter.go,
+jump_table.go, instructions.go, gas_table.go, operations_acl.go, eips.go,
+contracts.go).  The host interpreter here is the correctness anchor —
+bit-exact gas and semantics; the batched TPU step machine
+(coreth_tpu.replay) handles the data-parallel common case and defers to
+this interpreter for the long tail.
+"""
+
+from coreth_tpu.evm.evm import EVM, BlockContext, TxContext, Config  # noqa: F401
+from coreth_tpu.evm import vmerrs  # noqa: F401

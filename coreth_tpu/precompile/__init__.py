@@ -1,0 +1,14 @@
+"""Stateful precompile framework.
+
+Twin of reference ``precompile/`` (contract/, modules/, precompileconfig/,
+registry/): user-defined precompiles registered at reserved addresses,
+activated/deactivated by chain-config upgrades, with predicate support
+(gas + verify hooks consumed by the warp precompile).
+"""
+
+from coreth_tpu.precompile.modules import (  # noqa: F401
+    Module,
+    register_module,
+    registered_modules,
+    reserved_address,
+)

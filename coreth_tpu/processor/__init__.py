@@ -1,0 +1,18 @@
+"""Block processing: message transition + block processor.
+
+Semantic twin of reference core/state_transition.go +
+core/state_processor.go.  This is the bit-identical contract between the
+host execution path and the batched TPU replay engine.
+"""
+
+from coreth_tpu.processor.message import Message, tx_to_message  # noqa: F401
+from coreth_tpu.processor.state_transition import (  # noqa: F401
+    ExecutionResult,
+    GasPool,
+    apply_message,
+    intrinsic_gas,
+)
+from coreth_tpu.processor.state_processor import (  # noqa: F401
+    Processor,
+    apply_transaction,
+)

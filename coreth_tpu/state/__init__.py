@@ -1,0 +1,11 @@
+"""World state: journaled StateDB over trie-backed storage.
+
+Semantic twin of reference ``core/state/`` (statedb.go, state_object.go,
+journal.go).  The flat-read acceleration role of core/state/snapshot/ is
+played by the Database's account/storage caches; the TPU replay engine
+(coreth_tpu.replay) additionally mirrors hot state into device arrays.
+"""
+
+from coreth_tpu.state.database import Database  # noqa: F401
+from coreth_tpu.state.statedb import StateDB  # noqa: F401
+from coreth_tpu.state.statedb import normalize_coin_id, normalize_state_key  # noqa: F401

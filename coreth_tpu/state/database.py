@@ -1,0 +1,46 @@
+"""State backing store: trie node db + code db + structural trie cache.
+
+Plays the role of reference core/state/database.go (cachingDB) plus the
+hashdb node store (trie/triedb/hashdb): committed trie nodes live in
+``node_db`` keyed by hash, contract code in ``code_db`` keyed by code
+hash, and recently-committed tries are kept structurally (Python node
+trees) in ``trie_cache`` so re-opening state at a recent root costs a
+copy, not a node-by-node decode.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from coreth_tpu.mpt import SecureTrie, EMPTY_ROOT
+from coreth_tpu.types.account import EMPTY_CODE_HASH
+
+
+class Database:
+    def __init__(self):
+        self.node_db: Dict[bytes, bytes] = {}
+        self.code_db: Dict[bytes, bytes] = {}
+        self.trie_cache: Dict[bytes, SecureTrie] = {}
+        self.max_cached_tries = 128
+
+    def open_trie(self, root: bytes) -> SecureTrie:
+        """Account or storage trie at ``root``; always a private copy."""
+        cached = self.trie_cache.get(root)
+        if cached is not None:
+            return cached.copy()
+        return SecureTrie(root_hash=root, db=self.node_db)
+
+    def cache_trie(self, root: bytes, trie: SecureTrie) -> None:
+        if len(self.trie_cache) >= self.max_cached_tries:
+            # drop the oldest entries (insertion order)
+            for key in list(self.trie_cache)[: self.max_cached_tries // 4]:
+                del self.trie_cache[key]
+        self.trie_cache[root] = trie.copy()
+
+    def contract_code(self, code_hash: bytes) -> bytes:
+        if code_hash == EMPTY_CODE_HASH:
+            return b""
+        return self.code_db.get(code_hash, b"")
+
+    def write_code(self, code_hash: bytes, code: bytes) -> None:
+        self.code_db[code_hash] = code

@@ -50,10 +50,14 @@ class Receipt:
     block_hash: bytes = b"\x00" * 32
     block_number: int = 0
     transaction_index: int = 0
+    # lazily-computed cache; logs are write-once in practice
+    _bloom: Optional[bytes] = None
 
     @property
     def bloom(self) -> bytes:
-        return logs_bloom(self.logs)
+        if self._bloom is None:
+            self._bloom = logs_bloom(self.logs)
+        return self._bloom
 
     def _status_item(self) -> bytes:
         if self.post_state:

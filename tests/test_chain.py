@@ -1,0 +1,201 @@
+"""End-to-end: genesis -> generate chain -> re-insert -> bit-identical
+roots.  This is the M2 milestone gate (SURVEY.md section 7)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount, generate_chain
+from coreth_tpu.chain.blockchain import BadBlockError
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.params import TEST_CHAIN_CONFIG, TEST_APRICOT_PHASE2_CONFIG
+from coreth_tpu.state import Database
+from coreth_tpu.types import LegacyTx, DynamicFeeTx, sign_tx
+
+KEY1 = 0xB71C71A67E1177AD4E901695E1B4B9EE17AE16C6668D313EAC2F96DBCDA3F291
+KEY2 = 0x8A1F9A8F95BE41CD7CCB6168179AFBD504D945964EB2CB4E8E0AE563BEDEFFF4
+ADDR1 = priv_to_address(KEY1)
+ADDR2 = priv_to_address(KEY2)
+CHAIN_ID = TEST_CHAIN_CONFIG.chain_id
+GWEI = 10**9
+
+
+def make_genesis(config=TEST_CHAIN_CONFIG, balance=10**24):
+    return Genesis(
+        config=config,
+        gas_limit=8_000_000,
+        alloc={ADDR1: GenesisAccount(balance=balance)},
+    )
+
+
+def transfer_chain(config, n_blocks, txs_per_block):
+    """Value-transfer workload (bench_test.go:45 value-tx analog)."""
+    genesis = make_genesis(config)
+    db = Database()
+    genesis_block = genesis.to_block(db)
+    nonce = [0]
+
+    def gen(i, bg):
+        for _ in range(txs_per_block):
+            tx = sign_tx(DynamicFeeTx(
+                chain_id_=config.chain_id, nonce=nonce[0],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=21_000,
+                to=ADDR2, value=10_000,
+            ), KEY1, config.chain_id)
+            bg.add_tx(tx)
+            nonce[0] += 1
+
+    blocks, receipts = generate_chain(config, genesis_block, db, n_blocks,
+                                      gen, gap=2)
+    return genesis, blocks, receipts
+
+
+def test_generate_and_insert_value_chain():
+    genesis, blocks, _ = transfer_chain(TEST_CHAIN_CONFIG, 5, 10)
+    # re-insert into a FRESH blockchain: roots must be re-derived
+    # bit-identically from scratch
+    chain = BlockChain(make_genesis())
+    assert chain.insert_chain(blocks) == 5
+    assert chain.last_accepted.hash() == blocks[-1].hash()
+    # balances after 50 transfers
+    state = chain.state_at(blocks[-1].root)
+    assert state.get_balance(ADDR2) == 50 * 10_000
+    assert state.get_nonce(ADDR1) == 50
+    # coinbase burn: fees went to the zero coinbase address
+    assert state.get_balance(b"\x00" * 20) > 0
+    assert chain.timers.blocks == 5
+    assert chain.timers.execution > 0
+
+
+def test_insert_detects_bad_state_root():
+    genesis, blocks, _ = transfer_chain(TEST_CHAIN_CONFIG, 2, 3)
+    chain = BlockChain(make_genesis())
+    chain.insert_block(blocks[0])
+    chain.accept(blocks[0].hash())
+    bad = blocks[1]
+    bad.header.root = b"\x11" * 32
+    bad._hash = None
+    with pytest.raises(BadBlockError):
+        chain.insert_block(bad)
+
+
+def test_base_fee_progression():
+    """Base fee must follow the AP3+ dynamic fee algorithm and headers
+    must verify."""
+    genesis, blocks, _ = transfer_chain(TEST_CHAIN_CONFIG, 8, 20)
+    fees = [b.base_fee for b in blocks]
+    assert all(f is not None for f in fees)
+    # initial base fee at block 1 (genesis parent => initial fee)
+    from coreth_tpu.params import protocol as P
+    assert fees[0] == P.APRICOT_PHASE3_INITIAL_BASE_FEE
+    # light usage -> fee should decay toward the minimum
+    assert fees[-1] <= fees[0]
+
+
+def test_ap4_block_gas_cost_fields():
+    genesis, blocks, _ = transfer_chain(TEST_CHAIN_CONFIG, 3, 2)
+    for b in blocks:
+        assert b.header.block_gas_cost is not None
+        assert b.header.ext_data_gas_used == 0
+
+
+def test_legacy_tx_chain_ap2():
+    """Pre-AP3 config: legacy gas-price txs, no base fee."""
+    config = TEST_APRICOT_PHASE2_CONFIG
+    genesis = make_genesis(config)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonce = [0]
+
+    def gen(i, bg):
+        tx = sign_tx(LegacyTx(
+            nonce=nonce[0], gas_price=225 * GWEI, gas=21_000, to=ADDR2,
+            value=5,
+        ), KEY1, config.chain_id)
+        bg.add_tx(tx)
+        nonce[0] += 1
+
+    blocks, _ = generate_chain(config, gblock, db, 3, gen, gap=2)
+    assert all(b.base_fee is None for b in blocks)
+    chain = BlockChain(make_genesis(config))
+    assert chain.insert_chain(blocks) == 3
+    state = chain.state_at(blocks[-1].root)
+    assert state.get_balance(ADDR2) == 15
+
+
+def test_contract_deploy_and_interact_in_chain():
+    """Deploy a contract via tx, then call it in the next block."""
+    config = TEST_CHAIN_CONFIG
+    genesis = make_genesis(config)
+    db = Database()
+    gblock = genesis.to_block(db)
+    # runtime: store calldata word at slot 0: CALLDATALOAD(0) PUSH1 0 SSTORE
+    runtime = bytes.fromhex("60003560005500")
+    init = b"\x66" + runtime + bytes.fromhex("60005260076019f3")
+    created = []
+
+    def gen(i, bg):
+        if i == 0:
+            tx = sign_tx(DynamicFeeTx(
+                chain_id_=config.chain_id, nonce=0, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=200_000, to=None, value=0,
+                data=init,
+            ), KEY1, config.chain_id)
+            bg.add_tx(tx)
+            created.append(bg.receipts[0].contract_address)
+            assert bg.receipts[0].status == 1
+        else:
+            tx = sign_tx(DynamicFeeTx(
+                chain_id_=config.chain_id, nonce=1, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=200_000, to=created[0],
+                value=0, data=(0xABCD).to_bytes(32, "big"),
+            ), KEY1, config.chain_id)
+            bg.add_tx(tx)
+
+    blocks, receipts = generate_chain(config, gblock, db, 2, gen, gap=2)
+    chain = BlockChain(make_genesis(config))
+    assert chain.insert_chain(blocks) == 2
+    state = chain.state_at(blocks[-1].root)
+    assert state.get_code(created[0]) == runtime
+    stored = state.get_state(created[0], b"\x00" * 32)
+    assert int.from_bytes(stored, "big") == 0xABCD
+
+
+def test_sibling_blocks_accept_one():
+    """Competing siblings: insert both, accept one, reject the other
+    (snowman lifecycle, blockchain.go Accept/Reject)."""
+    config = TEST_CHAIN_CONFIG
+    genesis = make_genesis(config)
+    db = Database()
+    gblock = genesis.to_block(db)
+
+    def gen_a(i, bg):
+        bg.add_tx(sign_tx(DynamicFeeTx(
+            chain_id_=config.chain_id, nonce=0, gas_tip_cap_=GWEI,
+            gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDR2, value=111,
+        ), KEY1, config.chain_id))
+
+    def gen_b(i, bg):
+        bg.add_tx(sign_tx(DynamicFeeTx(
+            chain_id_=config.chain_id, nonce=0, gas_tip_cap_=GWEI,
+            gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDR2, value=222,
+        ), KEY1, config.chain_id))
+
+    blocks_a, _ = generate_chain(config, gblock, db, 1, gen_a, gap=2)
+    # build sibling B against its own copy of the genesis state
+    db_b = Database()
+    gblock_b = genesis.to_block(db_b)
+    assert gblock_b.hash() == gblock.hash()
+    blocks_b, _ = generate_chain(config, gblock_b, db_b, 1, gen_b, gap=3)
+
+    chain = BlockChain(make_genesis(config))
+    chain.insert_block(blocks_a[0])
+    chain.insert_block(blocks_b[0])
+    chain.accept(blocks_b[0].hash())
+    chain.reject(blocks_a[0].hash())
+    assert chain.last_accepted.hash() == blocks_b[0].hash()
+    state = chain.state_at(blocks_b[0].root)
+    assert state.get_balance(ADDR2) == 222

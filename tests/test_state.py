@@ -1,0 +1,195 @@
+"""StateDB: journaling, revert, finalise, roots, multicoin."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.params import TEST_CHAIN_CONFIG
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.types import StateAccount
+from coreth_tpu.types.receipt import Log
+
+A1 = b"\x11" * 20
+A2 = b"\x22" * 20
+A3 = b"\x33" * 20
+K1 = b"\x00" * 31 + b"\x02"
+V1 = b"\x00" * 31 + b"\x07"
+ZERO = b"\x00" * 32
+
+
+def fresh():
+    return StateDB(EMPTY_ROOT, Database())
+
+
+def test_balance_nonce_roundtrip():
+    s = fresh()
+    s.add_balance(A1, 1000)
+    s.set_nonce(A1, 5)
+    assert s.get_balance(A1) == 1000
+    assert s.get_nonce(A1) == 5
+    assert s.get_balance(A2) == 0
+
+
+def test_snapshot_revert():
+    s = fresh()
+    s.add_balance(A1, 100)
+    snap = s.snapshot()
+    s.add_balance(A1, 50)
+    s.set_nonce(A1, 1)
+    s.set_state(A1, K1, V1)
+    s.set_code(A1, b"\x60\x00")
+    s.add_refund(10)
+    s.add_log(Log(address=A1))
+    s.add_address_to_access_list(A2)
+    s.set_transient_state(A1, K1, V1)
+    assert s.get_balance(A1) == 150
+    s.revert_to_snapshot(snap)
+    assert s.get_balance(A1) == 100
+    assert s.get_nonce(A1) == 0
+    assert s.get_state(A1, K1) == ZERO
+    assert s.get_code(A1) == b""
+    assert s.refund == 0
+    assert s.logs == []
+    assert not s.address_in_access_list(A2)
+    assert s.get_transient_state(A1, K1) == ZERO
+
+
+def test_nested_snapshots():
+    s = fresh()
+    s.add_balance(A1, 1)
+    s1 = s.snapshot()
+    s.add_balance(A1, 2)
+    s2 = s.snapshot()
+    s.add_balance(A1, 4)
+    s.revert_to_snapshot(s2)
+    assert s.get_balance(A1) == 3
+    s.revert_to_snapshot(s1)
+    assert s.get_balance(A1) == 1
+
+
+def test_storage_committed_vs_dirty():
+    db = Database()
+    s = StateDB(EMPTY_ROOT, db)
+    s.add_balance(A1, 1)
+    s.set_state(A1, K1, V1)
+    s.finalise(True)
+    # new tx in same block: committed == pending value
+    v2 = b"\x00" * 31 + b"\x09"
+    s.set_state(A1, K1, v2)
+    assert s.get_state(A1, K1) == v2
+    assert s.get_committed_state_ap1(A1, K1) == V1
+    root = s.commit()
+    # reopen from committed state
+    s2 = StateDB(root, db)
+    assert s2.get_state(A1, K1) == v2
+    assert s2.get_balance(A1) == 1
+
+
+def test_intermediate_root_deterministic():
+    s = fresh()
+    s.add_balance(A1, 10)
+    s.add_balance(A2, 20)
+    r1 = s.intermediate_root(True)
+    # identical state built in the other order
+    s2 = fresh()
+    s2.add_balance(A2, 20)
+    s2.add_balance(A1, 10)
+    assert s2.intermediate_root(True) == r1
+
+
+def test_empty_account_deletion():
+    s = fresh()
+    s.add_balance(A1, 0)  # touch only
+    root = s.intermediate_root(True)
+    assert root == EMPTY_ROOT
+
+
+def test_suicide():
+    db = Database()
+    s = StateDB(EMPTY_ROOT, db)
+    s.add_balance(A1, 100)
+    s.set_state(A1, K1, V1)
+    root_with = s.commit()
+    s2 = StateDB(root_with, db)
+    assert s2.suicide(A1)
+    assert s2.get_balance(A1) == 0
+    assert s2.has_suicided(A1)
+    # still readable until finalise
+    assert s2.exist(A1)
+    s2.finalise(True)
+    assert not s2.exist(A1)
+    assert s2.intermediate_root(True) == EMPTY_ROOT
+
+
+def test_destruct_then_resurrect_across_txs():
+    db = Database()
+    s = StateDB(EMPTY_ROOT, db)
+    s.add_balance(A1, 7)
+    s.set_state(A1, K1, V1)
+    root = s.commit()
+    s2 = StateDB(root, db)
+    s2.suicide(A1)
+    s2.finalise(True)  # tx boundary
+    s2.add_balance(A1, 50)  # resurrect
+    s2.finalise(True)
+    root2 = s2.commit()
+    # old storage must be gone
+    s3 = StateDB(root2, db)
+    assert s3.get_balance(A1) == 50
+    assert s3.get_state(A1, K1) == ZERO
+
+
+def test_multicoin():
+    s = fresh()
+    coin = b"\xAB" * 32
+    s.add_balance(A1, 1)
+    s.add_balance_multi_coin(A1, coin, 500)
+    assert s.get_balance_multi_coin(A1, coin) == 500
+    s.sub_balance_multi_coin(A1, coin, 100)
+    assert s.get_balance_multi_coin(A1, coin) == 400
+    # regular balance untouched; multicoin flag set
+    assert s.get_balance(A1) == 1
+    obj = s._get_object(A1)
+    assert obj.account.is_multi_coin
+    # multicoin storage does not collide with normal state at the same key
+    s.set_state(A1, coin, V1)
+    assert s.get_balance_multi_coin(A1, coin) == 400
+    assert s.get_state(A1, coin) == V1
+
+
+def test_access_list_prepare():
+    s = fresh()
+    rules = TEST_CHAIN_CONFIG.rules(1, 1)
+    al = [(A3, [K1])]
+    s.prepare(rules, A1, A2, None, [], al)
+    assert s.address_in_access_list(A1)      # sender
+    assert s.address_in_access_list(A2)      # coinbase (Durango EIP-3651)
+    assert s.address_in_access_list(A3)      # from access list
+    assert s.slot_in_access_list(A3, K1) == (True, True)
+    assert s.slot_in_access_list(A3, V1) == (True, False)
+
+
+def test_refund_and_logs_lifecycle():
+    s = fresh()
+    s.add_refund(100)
+    s.sub_refund(40)
+    assert s.refund == 60
+    s.set_tx_context(b"\x01" * 32, 0)
+    s.add_log(Log(address=A1))
+    s.add_log(Log(address=A2))
+    assert [l.index for l in s.get_logs()] == [0, 1]
+    s.finalise(True)
+    assert s.refund == 0  # cleared per tx
+
+
+def test_copy_independence():
+    s = fresh()
+    s.add_balance(A1, 10)
+    cp = s.copy()
+    cp.add_balance(A1, 5)
+    cp.set_state(A1, K1, V1)
+    assert s.get_balance(A1) == 10
+    assert s.get_state(A1, K1) == ZERO
+    assert cp.get_balance(A1) == 15
