@@ -1,0 +1,8 @@
+"""Block assembly (no mining — consensus is external).
+
+Semantic twin of reference ``miner/`` (miner.go GenerateBlock :67,
+worker.go commitNewWork :129): pull pending txs by price & nonce,
+execute them into a fresh state, finalize through the dummy engine.
+"""
+
+from coreth_tpu.miner.worker import Miner, Worker  # noqa: F401

@@ -1,0 +1,127 @@
+"""Block assembly worker.
+
+Twin of reference miner/worker.go: commitNewWork (:129) builds the
+header (fee fields from the dummy engine), commitTransactions (:274)
+executes pool txs until the gas pool drains, commit (:331) finalizes and
+assembles via engine.FinalizeAndAssemble.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+from coreth_tpu.consensus import calc_base_fee
+from coreth_tpu.consensus.engine import DummyEngine
+from coreth_tpu.evm import EVM, TxContext
+from coreth_tpu.params import ChainConfig
+from coreth_tpu.params import protocol as P
+from coreth_tpu.processor.message import tx_to_message
+from coreth_tpu.processor.state_processor import (
+    apply_transaction, apply_upgrades, new_block_context,
+)
+from coreth_tpu.processor.state_transition import (
+    ConsensusError, ErrGasLimitReached, ErrNonceTooHigh, ErrNonceTooLow,
+    GasPool,
+)
+from coreth_tpu.types import Block, Header, LatestSigner, Transaction
+
+
+class Worker:
+    def __init__(self, config: ChainConfig, chain, txpool,
+                 engine: Optional[DummyEngine] = None, clock=_time.time):
+        self.config = config
+        self.chain = chain
+        self.txpool = txpool
+        self.engine = engine or DummyEngine()
+        self.engine.set_config(config)
+        self.clock = clock
+        self.coinbase = b"\x00" * 20
+        self.signer = LatestSigner(config.chain_id)
+
+    def set_coinbase(self, addr: bytes) -> None:
+        self.coinbase = addr
+
+    def commit_new_work(self) -> Block:
+        """commitNewWork (worker.go:129)."""
+        parent = self.chain.current_block()
+        timestamp = max(int(self.clock()), parent.time)
+        header = Header(
+            parent_hash=parent.hash(),
+            coinbase=self.coinbase,
+            difficulty=1,
+            number=parent.number + 1,
+            time=timestamp,
+        )
+        if self.config.is_cortina(timestamp):
+            header.gas_limit = P.CORTINA_GAS_LIMIT
+        elif self.config.is_apricot_phase1(timestamp):
+            header.gas_limit = P.APRICOT_PHASE1_GAS_LIMIT
+        else:
+            header.gas_limit = parent.gas_limit
+        if self.config.is_apricot_phase3(timestamp):
+            window, base_fee = calc_base_fee(self.config, parent.header,
+                                             timestamp)
+            header.extra = window
+            header.base_fee = base_fee
+        statedb = self.chain.state_at(parent.root)
+        apply_upgrades(self.config, parent.time, Block(header), statedb)
+        txs = self.txpool.txs_by_price_and_nonce(header.base_fee)
+        receipts, included, used = self._commit_transactions(
+            header, statedb, txs)
+        header.gas_used = used
+        block = self.engine.finalize_and_assemble(
+            self.config, header, parent.header, statedb, included, [],
+            receipts)
+        block_hash = block.hash()
+        for i, r in enumerate(receipts):
+            r.block_hash = block_hash
+            r.transaction_index = i
+        return block
+
+    def _commit_transactions(self, header: Header, statedb, txs):
+        """commitTransactions (worker.go:274)."""
+        gas_pool = GasPool(header.gas_limit)
+        receipts = []
+        included: List[Transaction] = []
+        used_gas = [0]
+        evm = EVM(new_block_context(header), TxContext(), statedb,
+                  self.config)
+        for tx in txs:
+            if gas_pool.gas < P.TX_GAS:
+                break
+            snap = statedb.snapshot()
+            try:
+                msg = tx_to_message(tx, self.signer, header.base_fee)
+                statedb.set_tx_context(tx.hash(), len(included))
+                receipt = apply_transaction(
+                    msg, gas_pool, statedb, header.number, b"\x00" * 32,
+                    tx, used_gas, evm)
+            except ErrGasLimitReached:
+                statedb.revert_to_snapshot(snap)
+                break
+            except (ErrNonceTooLow, ErrNonceTooHigh):
+                statedb.revert_to_snapshot(snap)
+                continue
+            except ConsensusError:
+                statedb.revert_to_snapshot(snap)
+                continue
+            receipt.transaction_index = len(included)
+            receipts.append(receipt)
+            included.append(tx)
+        return receipts, included, used_gas[0]
+
+
+class Miner:
+    """miner.go Miner: the VM-facing facade."""
+
+    def __init__(self, config: ChainConfig, chain, txpool,
+                 engine: Optional[DummyEngine] = None, clock=_time.time):
+        self.worker = Worker(config, chain, txpool, engine, clock)
+
+    def set_coinbase(self, addr: bytes) -> None:
+        self.worker.set_coinbase(addr)
+
+    def generate_block(self) -> Block:
+        """GenerateBlock (miner.go:67)."""
+        return self.worker.commit_new_work()

@@ -1,4 +1,4 @@
-"""Merkle-Patricia trie — host structural engine.
+"""Merkle-Patricia trie — host structural engine with incremental hashing.
 
 Semantics per the Ethereum yellow-paper trie spec (reference trie/trie.go:
 insert :308, delete :413, Hash :573; hasher.go:69 collapse rules):
@@ -9,10 +9,13 @@ insert :308, delete :413, Hash :573; hasher.go:69 collapse rules):
 - a node's reference inside its parent is its RLP if len(rlp) < 32,
   else keccak256(rlp); the root hash is always keccak256(rlp(root)).
 
-The in-memory representation is plain Python lists (mutable, cheap to
-edit); hashing walks bottom-up and can hand whole levels to the batched
-device keccak (mpt/rehash.py).  ``SecureTrie`` applies keccak to keys
-(reference trie/secure_trie.go).
+Every node carries a memo slot caching (encoded-rlp, parent-ref); edits
+clear memos along the touched path only, so re-hashing after a block
+touches O(dirty * depth) nodes — the host analog of the reference's
+cached trie nodes (trie/triedb/hashdb), and the contract that lets
+mpt/rehash.py hand whole dirty frontiers to the batched device keccak.
+
+``SecureTrie`` applies keccak to keys (reference trie/secure_trie.go).
 """
 
 from __future__ import annotations
@@ -24,14 +27,16 @@ from coreth_tpu.crypto import keccak256
 
 EMPTY_ROOT = keccak256(rlp.encode(b""))
 
-# Node model (mutable lists so edits are in place):
-#   ["L", nibbles(bytes), value(bytes)]              leaf
-#   ["E", nibbles(bytes), child]                     extension
-#   ["B", [child x 16], value(bytes)]                branch
-#   ["H", digest(bytes32)]                           hash reference (db-backed)
-#   None                                             empty
+# Node model (mutable lists so edits are in place); last slot is the memo:
+#   [LEAF,   nibbles(bytes), value(bytes),      memo]
+#   [EXT,    nibbles(bytes), child,             memo]
+#   [BRANCH, [child x 16],   value(bytes),      memo]
+#   [HASHREF, digest(bytes32)]                  (db-backed reference)
+# memo = (encoded_rlp: bytes, ref) where ref is the 32-byte hash if
+# len(encoded) >= 32 else the decoded RLP structure to inline in parents.
 
 LEAF, EXT, BRANCH, HASHREF = "L", "E", "B", "H"
+_MEMO = 3  # memo slot index for L/E/B nodes
 
 
 def hex_prefix(nibbles: bytes, is_leaf: bool) -> bytes:
@@ -80,6 +85,18 @@ class MissingNodeError(Exception):
     """A hash reference was dereferenced but absent from the node store."""
 
 
+def _leaf(nibbles, value):
+    return [LEAF, nibbles, value, None]
+
+
+def _ext(nibbles, child):
+    return [EXT, nibbles, child, None]
+
+
+def _branch(children, value):
+    return [BRANCH, children, value, None]
+
+
 class Trie:
     """In-memory MPT over an optional {hash: node-rlp} backing store."""
 
@@ -90,7 +107,6 @@ class Trie:
             self.root = None
         else:
             self.root = [HASHREF, root_hash]
-        self._hash_cache: Optional[bytes] = None
 
     # ------------------------------------------------------------------ get
     def get(self, key: bytes) -> Optional[bytes]:
@@ -104,18 +120,27 @@ class Trie:
             return self._decode_node(rlp.decode(data))
         return node
 
+    def _resolve_in_place(self, parent, slot):
+        """Resolve a HASHREF child and replace it in the parent so the
+        decode cost is paid once."""
+        node = parent[slot]
+        if node is not None and node[0] == HASHREF:
+            node = self._resolve(node)
+            parent[slot] = node
+        return node
+
     def _decode_node(self, items):
         """RLP structure -> node model.  Child byte-strings of 32 bytes are
         hash refs; nested lists are inlined nodes."""
         if isinstance(items, list) and len(items) == 2:
             nibbles, is_leaf = decode_hex_prefix(items[0])
             if is_leaf:
-                return [LEAF, nibbles, items[1]]
-            return [EXT, nibbles, self._decode_ref(items[1])]
+                return _leaf(nibbles, items[1])
+            return _ext(nibbles, self._decode_ref(items[1]))
         if isinstance(items, list) and len(items) == 17:
             children = [self._decode_ref(c) if c else None
                         for c in items[:16]]
-            return [BRANCH, children, items[16]]
+            return _branch(children, items[16])
         raise ValueError("malformed trie node")
 
     def _decode_ref(self, item):
@@ -152,7 +177,6 @@ class Trie:
 
     # --------------------------------------------------------------- update
     def update(self, key: bytes, value: bytes) -> None:
-        self._hash_cache = None
         nibbles = key_to_nibbles(key)
         if value:
             self.root = self._insert(self.root, nibbles, value)
@@ -164,54 +188,56 @@ class Trie:
 
     def _insert(self, node, nibbles: bytes, value: bytes):
         if node is None:
-            return [LEAF, nibbles, value]
+            return _leaf(nibbles, value)
         node = self._resolve(node)
         if node is None:
-            return [LEAF, nibbles, value]
+            return _leaf(nibbles, value)
         kind = node[0]
         if kind == LEAF:
             existing = node[1]
             if existing == nibbles:
                 node[2] = value
+                node[_MEMO] = None
                 return node
             cp = _common_prefix_len(existing, nibbles)
-            branch = [BRANCH, [None] * 16, b""]
-            # split both under a fresh branch at the divergence point
+            branch = _branch([None] * 16, b"")
             for nb, val in ((existing, node[2]), (nibbles, value)):
                 rest = nb[cp:]
                 if not rest:
                     branch[2] = val
                 else:
-                    branch[1][rest[0]] = [LEAF, rest[1:], val]
+                    branch[1][rest[0]] = _leaf(rest[1:], val)
             if cp:
-                return [EXT, nibbles[:cp], branch]
+                return _ext(nibbles[:cp], branch)
             return branch
         if kind == EXT:
             prefix = node[1]
             cp = _common_prefix_len(prefix, nibbles)
             if cp == len(prefix):
                 node[2] = self._insert(node[2], nibbles[cp:], value)
+                node[_MEMO] = None
                 return node
-            # split the extension
-            branch = [BRANCH, [None] * 16, b""]
-            # remainder of the old extension path
+            branch = _branch([None] * 16, b"")
             old_rest = prefix[cp:]
-            child = node[2] if len(old_rest) == 1 else [EXT, old_rest[1:], node[2]]
+            child = node[2] if len(old_rest) == 1 \
+                else _ext(old_rest[1:], node[2])
             branch[1][old_rest[0]] = child
             new_rest = nibbles[cp:]
             if not new_rest:
                 branch[2] = value
             else:
-                branch[1][new_rest[0]] = [LEAF, new_rest[1:], value]
+                branch[1][new_rest[0]] = _leaf(new_rest[1:], value)
             if cp:
-                return [EXT, nibbles[:cp], branch]
+                return _ext(nibbles[:cp], branch)
             return branch
         # branch
         if not nibbles:
             node[2] = value
+            node[_MEMO] = None
             return node
         idx = nibbles[0]
         node[1][idx] = self._insert(node[1][idx], nibbles[1:], value)
+        node[_MEMO] = None
         return node
 
     # --------------------------------------------------------------- delete
@@ -232,12 +258,12 @@ class Trie:
             if child is None:
                 return None
             child = self._resolve(child)
-            # merge chains: ext+ext, ext+leaf
             if child[0] == EXT:
-                return [EXT, prefix + child[1], child[2]]
+                return _ext(prefix + child[1], child[2])
             if child[0] == LEAF:
-                return [LEAF, prefix + child[1], child[2]]
+                return _leaf(prefix + child[1], child[2])
             node[2] = child
+            node[_MEMO] = None
             return node
         # branch
         if not nibbles:
@@ -247,94 +273,116 @@ class Trie:
         else:
             idx = nibbles[0]
             node[1][idx] = self._delete(node[1][idx], nibbles[1:])
-        # collapse if <= 1 child remains
+        node[_MEMO] = None
         live = [(i, c) for i, c in enumerate(node[1]) if c is not None]
         if node[2]:
             if live:
                 return node
-            return [LEAF, b"", node[2]]
+            return _leaf(b"", node[2])
         if len(live) > 1:
             return node
         if not live:
             return None
         idx, child = live[0]
-        child = self._resolve(child)
+        child = self._resolve_in_place(node[1], idx)
         if child[0] == LEAF:
-            return [LEAF, bytes([idx]) + child[1], child[2]]
+            return _leaf(bytes([idx]) + child[1], child[2])
         if child[0] == EXT:
-            return [EXT, bytes([idx]) + child[1], child[2]]
-        return [EXT, bytes([idx]), child]
+            return _ext(bytes([idx]) + child[1], child[2])
+        return _ext(bytes([idx]), child)
 
     # ----------------------------------------------------------------- hash
-    def _encode_node(self, node, acc: Optional[List[Tuple[bytes, bytes]]]):
-        """Node -> RLP bytes; children collapsed to refs.
+    def _encode_node(self, node, acc):
+        """Node -> (rlp bytes, parent-ref), memoized.
 
-        acc, when given, collects (hash, rlp) for every node that hashes
-        (the commit set).
+        acc, when given, collects (hash, rlp) for every hashed node (the
+        commit set) — including memoized subtrees on their first commit.
         """
+        memo = node[_MEMO]
+        if memo is not None:
+            if acc is not None:
+                self._collect_committed(node, acc)
+            return memo
         kind = node[0]
         if kind == LEAF:
-            return rlp.encode([hex_prefix(node[1], True), node[2]])
-        if kind == EXT:
-            return rlp.encode([hex_prefix(node[1], False),
-                               self._ref(node[2], acc)])
-        if kind == BRANCH:
+            encoded = rlp.encode([hex_prefix(node[1], True), node[2]])
+        elif kind == EXT:
+            encoded = rlp.encode([hex_prefix(node[1], False),
+                                  self._ref(node[2], acc)])
+        else:
             items = [self._ref(c, acc) if c is not None else b""
                      for c in node[1]]
             items.append(node[2])
-            return rlp.encode(items)
-        raise AssertionError("unreachable")
+            encoded = rlp.encode(items)
+        if len(encoded) < 32:
+            ref = rlp.decode(encoded)
+        else:
+            ref = keccak256(encoded)
+            if acc is not None:
+                acc.append((ref, encoded))
+        node[_MEMO] = (encoded, ref)
+        return node[_MEMO]
+
+    def _collect_committed(self, node, acc):
+        """Emit (hash, rlp) pairs for a memoized subtree (first commit
+        after a hash() pass)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n is None or n[0] == HASHREF:
+                continue
+            memo = n[_MEMO]
+            if memo is None:
+                continue
+            encoded, ref = memo
+            if isinstance(ref, bytes) and len(ref) == 32:
+                if ref in self.db:
+                    continue  # subtree already persisted
+                acc.append((ref, encoded))
+            if n[0] == EXT:
+                stack.append(n[2])
+            elif n[0] == BRANCH:
+                stack.extend(n[1])
 
     def _ref(self, node, acc):
         if node[0] == HASHREF:
             return node[1]
-        encoded = self._encode_node(node, acc)
-        if len(encoded) < 32:
-            # inlined: strip the outer list encoding by decoding again —
-            # parent embeds the structure, not a byte string
-            return rlp.decode(encoded)
-        h = keccak256(encoded)
-        if acc is not None:
-            acc.append((h, encoded))
-        return h
+        return self._encode_node(node, acc)[1]
 
     def hash(self) -> bytes:
         """Root hash (reference trie.go:573 Hash)."""
-        if self._hash_cache is not None:
-            return self._hash_cache
         if self.root is None:
-            self._hash_cache = EMPTY_ROOT
             return EMPTY_ROOT
         if self.root[0] == HASHREF:
             return self.root[1]
-        encoded = self._encode_node(self.root, None)
-        self._hash_cache = keccak256(encoded)
-        return self._hash_cache
+        encoded, ref = self._encode_node(self.root, None)
+        if isinstance(ref, bytes) and len(ref) == 32:
+            return ref
+        return keccak256(encoded)
 
     def commit(self) -> bytes:
         """Hash and persist all nodes into the backing store.
 
         Returns the root hash (reference trie.go:585 Commit +
         committer.go).  The in-memory tree stays resident (it is the
-        clean cache); callers that want a pure hash use :meth:`hash`.
+        clean cache).
         """
         if self.root is None:
             return EMPTY_ROOT
         if self.root[0] == HASHREF:
             return self.root[1]
         acc: List[Tuple[bytes, bytes]] = []
-        encoded = self._encode_node(self.root, acc)
-        root_hash = keccak256(encoded)
+        encoded, ref = self._encode_node(self.root, acc)
+        root_hash = ref if isinstance(ref, bytes) and len(ref) == 32 \
+            else keccak256(encoded)
         self.db[root_hash] = encoded
         for h, data in acc:
             self.db[h] = data
-        self._hash_cache = root_hash
         return root_hash
 
     def copy(self) -> "Trie":
         t = Trie(db=self.db)
         t.root = _deep_copy(self.root)
-        t._hash_cache = self._hash_cache
         return t
 
     # ------------------------------------------------------------- iterate
@@ -366,11 +414,12 @@ def _deep_copy(node):
         return None
     kind = node[0]
     if kind == LEAF:
-        return [LEAF, node[1], node[2]]
+        return [LEAF, node[1], node[2], node[_MEMO]]
     if kind == EXT:
-        return [EXT, node[1], _deep_copy(node[2])]
+        return [EXT, node[1], _deep_copy(node[2]), node[_MEMO]]
     if kind == BRANCH:
-        return [BRANCH, [_deep_copy(c) for c in node[1]], node[2]]
+        return [BRANCH, [_deep_copy(c) for c in node[1]], node[2],
+                node[_MEMO]]
     return [HASHREF, node[1]]
 
 
@@ -399,6 +448,5 @@ class SecureTrie(Trie):
     def copy(self) -> "SecureTrie":
         t = SecureTrie(db=self.db)
         t.root = _deep_copy(self.root)
-        t._hash_cache = self._hash_cache
         t.preimages = dict(self.preimages)
         return t

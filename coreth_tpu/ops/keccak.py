@@ -162,6 +162,7 @@ def keccak256_fixed(words, nbytes: int):
     return state[..., :4, :].reshape(state.shape[:-2] + (8,))
 
 
+@jax.jit
 def keccak256_blocks(blocks, nblocks):
     """keccak-256 of host-padded multi-block messages.
 
@@ -169,6 +170,10 @@ def keccak256_blocks(blocks, nblocks):
     on host (suffix 0x01 / 0x80 in the final real block).
     nblocks: int32 (batch,) — real block count per item (>= 1).
     Returns (batch, 8) uint32 digest words.
+
+    Jitted: the 24 unrolled rounds compile to one executable; callers
+    should bucket (batch, max_blocks) shapes (pack_blocks pads) so the
+    compile cache stays small.
     """
     blocks = jnp.asarray(blocks, dtype=jnp.uint32)
     nblocks = jnp.asarray(nblocks, dtype=jnp.int32)
@@ -197,18 +202,29 @@ def pack_fixed(msgs: list[bytes], nbytes: int) -> np.ndarray:
     return buf.view(np.uint32).reshape(len(msgs), 34)
 
 
-def pack_blocks(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+def pack_blocks(msgs: list[bytes],
+                pad_batch: bool = True) -> tuple[np.ndarray, np.ndarray]:
     """Pack variable-length messages (keccak padding applied) for
-    keccak256_blocks."""
+    keccak256_blocks.  Batch and block-count dimensions are padded to
+    powers of two so the jitted kernel compiles per bucket, not per
+    call."""
     nblocks = np.array([len(m) // 136 + 1 for m in msgs], dtype=np.int32)
     max_blocks = int(nblocks.max()) if len(msgs) else 1
-    buf = np.zeros((len(msgs), max_blocks * 136), dtype=np.uint8)
+    max_blocks = 1 << (max_blocks - 1).bit_length()
+    n = len(msgs)
+    batch = 1 << (n - 1).bit_length() if (pad_batch and n) else n
+    buf = np.zeros((batch, max_blocks * 136), dtype=np.uint8)
     for i, m in enumerate(msgs):
         buf[i, :len(m)] = np.frombuffer(m, dtype=np.uint8)
         end = nblocks[i] * 136
         buf[i, len(m)] ^= 0x01
         buf[i, end - 1] ^= 0x80
-    return (buf.view(np.uint32).reshape(len(msgs), max_blocks, 34), nblocks)
+    if batch > n:
+        nblocks = np.concatenate(
+            [nblocks, np.ones(batch - n, dtype=np.int32)])
+        buf[n:, 0] ^= 0x01   # empty-message keccak padding
+        buf[n:, 135] ^= 0x80
+    return (buf.view(np.uint32).reshape(batch, max_blocks, 34), nblocks)
 
 
 def digest_words_to_bytes(words: np.ndarray) -> list[bytes]:

@@ -1,0 +1,111 @@
+"""256-bit integer arithmetic on TPU-friendly 16-bit limbs.
+
+Values are represented as int32 arrays of shape (..., 16): limb i holds
+bits [16*i, 16*i+16) (little-endian limbs), each in [0, 2^16).  The
+16-bit-in-int32 layout gives headroom for segment-sums over up to ~2^14
+operands before a single carry renormalization — the pattern the replay
+engine uses for per-account debit/credit aggregation (reference analog:
+the per-tx sequential big.Int balance updates in core/state_transition.go
+buyGas/refundGas, here batched).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+LIMBS = 16
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def pack_np(values) -> np.ndarray:
+    """Python ints -> (n, 16) numpy limb array (C-speed via to_bytes)."""
+    blob = b"".join(v.to_bytes(32, "little") for v in values)
+    return np.frombuffer(blob, dtype=np.uint16).reshape(
+        len(values), LIMBS).astype(np.int32)
+
+
+def from_ints(values, dtype=jnp.int32) -> jnp.ndarray:
+    """Python ints -> (n, 16) limb array on device."""
+    return jnp.asarray(pack_np(values), dtype=dtype)
+
+
+def to_ints(arr) -> list:
+    """(n, 16) limb array -> Python ints (host-side unpacking)."""
+    a = np.asarray(arr, dtype=np.int64)
+    if a.size == 0:
+        return []
+    # combine limbs vectorized: little-endian uint16 limbs -> bytes
+    blob = a.astype(np.uint16).tobytes()
+    return [int.from_bytes(blob[i * 32:(i + 1) * 32], "little")
+            for i in range(a.shape[0])]
+
+
+def normalize(x: jnp.ndarray) -> jnp.ndarray:
+    """Propagate carries so every limb lands in [0, 2^16).
+
+    Accepts limbs that exceed 16 bits (e.g. after a segment-sum); needs
+    ceil(32/16)=2+ passes in the worst case, so we run a short fixed
+    loop — XLA unrolls it.
+    """
+    def one_pass(v):
+        carry = v >> LIMB_BITS
+        v = v & LIMB_MASK
+        v = v + jnp.concatenate(
+            [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1)
+        return v
+    for _ in range(3):
+        x = one_pass(x)
+    return x
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b mod 2^256, both normalized."""
+    return normalize(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod 2^256 (caller checks a >= b via gte)."""
+    # borrow-propagate: add 2^16 to each limb, subtract borrow chain
+    diff = a - b
+
+    def body(carry, limb):
+        limb = limb - carry
+        borrow = (limb < 0).astype(jnp.int32)
+        return borrow, limb + (borrow << LIMB_BITS)
+
+    _, limbs = jax.lax.scan(body, jnp.zeros(a.shape[:-1], dtype=jnp.int32),
+                            jnp.moveaxis(diff, -1, 0))
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def gte(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a >= b elementwise over the last axis (both normalized)."""
+    # lexicographic from the most-significant limb
+    def body(state, limbs):
+        decided, result = state
+        a_l, b_l = limbs
+        gt = a_l > b_l
+        lt = a_l < b_l
+        result = jnp.where(~decided & gt, True, result)
+        result = jnp.where(~decided & lt, False, result)
+        decided = decided | gt | lt
+        return (decided, result), None
+
+    init = (jnp.zeros(a.shape[:-1], dtype=bool),
+            jnp.ones(a.shape[:-1], dtype=bool))  # equal => True
+    (decided, result), _ = jax.lax.scan(
+        body, init,
+        (jnp.moveaxis(a, -1, 0)[::-1], jnp.moveaxis(b, -1, 0)[::-1]))
+    return result
+
+
+def mul_small(a: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """a * k for k < 2^15 (per-limb product fits int32 headroom)."""
+    return normalize(a * k[..., None])
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)

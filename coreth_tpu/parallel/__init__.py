@@ -1,0 +1,13 @@
+"""Multi-chip scaling: meshes, shardings, collective replay.
+
+Reference analog (SURVEY.md section 2.9): the reference's distributed
+backend is gRPC + AppRequest/Gossip on the host; compute-side scaling in
+the TPU build rides jax.sharding over ICI — the replay batch shards over
+the ``dp`` mesh axis, account state shards over the same devices, and
+per-account reductions cross shards with psum_scatter.
+"""
+
+from coreth_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    sharded_transfer_step,
+)

@@ -1,0 +1,107 @@
+"""Device-mesh sharded replay step.
+
+The single-chip batched transfer step (replay/engine.py) generalizes to a
+mesh by sharding BOTH the tx batch and the account-state rows over one
+``dp`` axis:
+
+- each device computes full-width per-account totals from its local tx
+  shard (segment-sum into the global account range);
+- one ``psum_scatter`` over ``dp`` reduces the partial totals AND leaves
+  them sharded by account row — the collective rides ICI, and its output
+  layout matches the local balance shard exactly (no all-gather);
+- validation flags combine with a scalar ``psum``.
+
+This is the sharding recipe the scaling-book prescribes: annotate,
+reduce-scatter into the layout you need next, never materialize the full
+array.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from coreth_tpu.ops import u256
+
+
+def make_mesh(devices=None, axis: str = "dp") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    import numpy as np
+    return Mesh(np.array(devices), (axis,))
+
+
+def sharded_transfer_step(mesh: Mesh, num_accounts: int):
+    """Build the mesh-sharded transfer step.
+
+    Shapes (global): balances [A, 16], nonces [A], tx arrays [B, ...];
+    A and B must divide by the mesh size.  Returns a jitted function
+    (balances, nonces, sender_idx, recip_idx, value16, fee16, required16,
+    tx_nonce, nonce_offset, mask) -> (new_balances, new_nonces, ok).
+
+    Nonce-sequence validation is computed against gathered nonce rows for
+    the local tx shard (an all_gather of one i32 row — cheap vs the limb
+    traffic saved by psum_scatter on the totals).
+    """
+    n_dev = mesh.devices.size
+    assert num_accounts % n_dev == 0
+
+    def step(balances, nonces, sender_idx, recip_idx, value16, fee16,
+             required16, tx_nonce, nonce_offset, mask, coinbase_idx):
+        # local shards: balances [A/d, 16], tx arrays [B/d, ...]
+        mask_i = mask.astype(jnp.int32)
+        debit = u256.add(value16, fee16) * mask_i[:, None]
+        required = required16 * mask_i[:, None]
+        credit = value16 * mask_i[:, None]
+        # full-width partial totals from the local tx shard
+        debit_part = jax.ops.segment_sum(debit, sender_idx,
+                                         num_segments=num_accounts)
+        req_part = jax.ops.segment_sum(required, sender_idx,
+                                       num_segments=num_accounts)
+        credit_part = jax.ops.segment_sum(credit, recip_idx,
+                                          num_segments=num_accounts)
+        # tx fees accrue to the coinbase (state_transition.go:443)
+        fee_local = jnp.sum(fee16 * mask_i[:, None], axis=0)
+        credit_part = credit_part.at[coinbase_idx].add(fee_local)
+        counts_part = jax.ops.segment_sum(mask_i, sender_idx,
+                                          num_segments=num_accounts)
+        # reduce across devices, scattering rows back onto the account
+        # sharding (ICI collective; output [A/d, 16])
+        debit_tot = u256.normalize(
+            jax.lax.psum_scatter(debit_part, "dp", scatter_dimension=0,
+                                 tiled=True))
+        req_tot = u256.normalize(
+            jax.lax.psum_scatter(req_part, "dp", scatter_dimension=0,
+                                 tiled=True))
+        credit_tot = u256.normalize(
+            jax.lax.psum_scatter(credit_part, "dp", scatter_dimension=0,
+                                 tiled=True))
+        counts = jax.lax.psum_scatter(counts_part, "dp",
+                                      scatter_dimension=0, tiled=True)
+        # nonce check needs the global nonce row for local txs
+        all_nonces = jax.lax.all_gather(nonces, "dp", tiled=True)
+        expected = all_nonces[sender_idx] + nonce_offset
+        nonce_ok = jnp.all(jnp.where(mask, tx_nonce == expected, True))
+        solvent = u256.gte(balances, req_tot)
+        ok_local = nonce_ok & jnp.all(solvent | (counts == 0))
+        ok = jax.lax.psum(ok_local.astype(jnp.int32), "dp") == n_dev
+        new_balances = u256.sub(u256.add(balances, credit_tot), debit_tot)
+        new_nonces = nonces + counts
+        return new_balances, new_nonces, ok
+
+    spec_acc2 = PS("dp", None)
+    spec_acc1 = PS("dp")
+    spec_tx2 = PS("dp", None)
+    spec_tx1 = PS("dp")
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(spec_acc2, spec_acc1, spec_tx1, spec_tx1, spec_tx2,
+                  spec_tx2, spec_tx2, spec_tx1, spec_tx1, spec_tx1, PS()),
+        out_specs=(spec_acc2, spec_acc1, PS()))
+    return jax.jit(sharded)

@@ -1,0 +1,13 @@
+"""The batched block-replay engine (the north star).
+
+Reference analog: the sequential tx loop in core/state_processor.go:95-107
+and core/state_transition.go, re-designed data-parallel for TPU
+(SURVEY.md section 7): dependency-analyze the window, execute the
+batched common case (pure value transfers) on device with segment
+reductions, route the long tail (contract calls, conflicts, failures)
+through the bit-exact host processor, then rebuild the state root with
+the level-synchronous batched keccak rehash.  The result is validated
+bit-identical against the header roots.
+"""
+
+from coreth_tpu.replay.engine import ReplayEngine, ReplayStats  # noqa: F401

@@ -1,0 +1,598 @@
+"""Batched block-replay engine.
+
+Re-design of the reference's sequential hot path (state_processor.go:95
+tx loop) for TPU:
+
+1. **Classify** (host): a block is device-replayable when every tx is a
+   pure value transfer — `to` set, empty calldata, 21k gas, callee has
+   no code and no multicoin flag.  Anything else routes through the
+   bit-exact host Processor (execute-validate fallback, cf. SURVEY.md
+   section 2.8).
+2. **Execute** (device): one jitted step per block — per-sender debits
+   and per-recipient credits as segment reductions over 16x16-bit limb
+   arrays (ops/u256), nonce-sequence and solvency validation included.
+   The solvency check ignores same-block credits, so success implies
+   the sequential result (credits only help); any doubt falls back.
+3. **Hash** (device): account trie updated structurally on host, then
+   level-synchronous batched keccak rehash (mpt/rehash) reproduces the
+   state root bit-identically; it is checked against the header.
+
+State is shared with the host path through the same state Database, so
+both engines can interleave over one chain.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from coreth_tpu.consensus.engine import DummyEngine
+from coreth_tpu.mpt.rehash import device_rehash
+from coreth_tpu.ops import u256
+from coreth_tpu.params import ChainConfig
+from coreth_tpu.params import protocol as P
+from coreth_tpu.processor.state_processor import Processor
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.types import (
+    Block, LatestSigner, Receipt, StateAccount, Transaction, create_bloom,
+    derive_sha,
+)
+from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
+
+
+class ReplayError(Exception):
+    pass
+
+
+def secp_half_n() -> int:
+    from coreth_tpu.crypto.secp256k1 import N
+    return N // 2
+
+
+@dataclass
+class ReplayStats:
+    blocks_device: int = 0
+    blocks_fallback: int = 0
+    txs: int = 0
+    t_classify: float = 0.0
+    t_sender: float = 0.0
+    t_device: float = 0.0
+    t_trie: float = 0.0
+    t_fallback: float = 0.0
+
+    def row(self) -> dict:
+        return dict(self.__dict__)
+
+
+# Packed tx-batch column layout — ONE host->device transfer per block
+# (each separate transfer pays the full tunnel round-trip latency):
+#   0 sender_idx | 1 recip_idx | 2 tx_nonce | 3 nonce_offset | 4 mask
+#   5 coinbase_idx (broadcast) | 6:22 value16 | 22:38 fee16
+#   38:54 required16
+TXD_COLS = 54
+
+
+def pack_txd(batch: dict, B: int, pad: int) -> np.ndarray:
+    txd = np.zeros((pad, TXD_COLS), dtype=np.int32)
+    txd[:B, 0] = batch["senders"]
+    txd[:B, 1] = batch["recips"]
+    txd[:B, 2] = batch["nonces"]
+    txd[:B, 3] = batch["offsets"]
+    txd[:B, 4] = 1
+    txd[:, 5] = batch["coinbase"]
+    txd[:B, 6:22] = u256.pack_np(batch["values"])
+    txd[:B, 22:38] = u256.pack_np(batch["fees"])
+    txd[:B, 38:54] = u256.pack_np(batch["required"])
+    return txd
+
+
+def _gather_fetch(balances, nonces, ok, t_idx):
+    """[t_pad+1, 17] fetch tensor: touched (balance, nonce) rows + ok."""
+    g = jnp.concatenate([balances[t_idx],
+                         nonces[t_idx][:, None]], axis=1)
+    ok_row = jnp.zeros((1, u256.LIMBS + 1), dtype=jnp.int32)
+    ok_row = ok_row.at[0, 0].set(ok.astype(jnp.int32))
+    return jnp.concatenate([g, ok_row], axis=0)
+
+
+def _step_core(balances, nonces, txd, num_accounts: int):
+    """One block of pure transfers from a packed [pad, 54] batch."""
+    return _transfer_step(
+        balances, nonces, txd[:, 0], txd[:, 1], txd[:, 6:22],
+        txd[:, 22:38], txd[:, 38:54], txd[:, 2], txd[:, 3],
+        txd[:, 4].astype(bool), txd[0, 5], num_accounts=num_accounts)
+
+
+_transfer_step_packed = partial(jax.jit, static_argnames=("num_accounts",))(
+    _step_core)
+
+
+@partial(jax.jit, static_argnames=("num_accounts",))
+def _transfer_window(balances, nonces, txds, t_idxs, num_accounts: int):
+    """A WINDOW of blocks in one device call: lax.scan over the packed
+    per-block batches, emitting one fetch tensor per block.
+
+    This is the shape that amortizes the host<->device round trip over
+    the whole window — the TPU-native analog of the reference's
+    commit-interval batching (core/state_manager.go:74): one upload, one
+    scan, one download.
+    """
+    def body(carry, inp):
+        bal, non = carry
+        txd, t_idx = inp
+        nb, nn, ok = _step_core(bal, non, txd, num_accounts)
+        return (nb, nn), _gather_fetch(nb, nn, ok, t_idx)
+
+    (bal, non), fetches = jax.lax.scan(
+        body, (balances, nonces), (txds, t_idxs))
+    return bal, non, fetches
+
+
+@partial(jax.jit, static_argnames=("num_accounts",))
+def _transfer_step(balances, nonces, sender_idx, recip_idx, value16, fee16,
+                   required16, tx_nonce, nonce_offset, mask, coinbase_idx,
+                   num_accounts: int):
+    """One block of pure transfers, batched.
+
+    required16 carries the buyGas balance requirement per tx
+    (gas_limit * gas_fee_cap + value, state_transition.go:286) — checked
+    against the pre-block balance summed per sender, which is
+    conservative vs the sequential per-tx check (credits only help), so
+    ok=True implies the sequential outcome.  Returns
+    (new_balances, new_nonces, ok); ok False => caller falls back.
+    """
+    mask_i = mask.astype(jnp.int32)
+    debit = u256.add(value16, fee16)                      # [B, 16]
+    debit = debit * mask_i[:, None]
+    required = required16 * mask_i[:, None]
+    credit = value16 * mask_i[:, None]
+    # nonce sequence: state nonce + #earlier same-sender txs in block
+    expected = nonces[sender_idx] + nonce_offset
+    nonce_ok = jnp.all(jnp.where(mask, tx_nonce == expected, True))
+    # per-account totals (16-bit limbs give segment-sum headroom)
+    debit_tot = u256.normalize(jax.ops.segment_sum(
+        debit, sender_idx, num_segments=num_accounts))
+    required_tot = u256.normalize(jax.ops.segment_sum(
+        required, sender_idx, num_segments=num_accounts))
+    credit_tot = u256.normalize(jax.ops.segment_sum(
+        credit, recip_idx, num_segments=num_accounts))
+    fee_total = u256.normalize(jnp.sum(fee16 * mask_i[:, None], axis=0))
+    credit_tot = credit_tot.at[coinbase_idx].add(fee_total)
+    credit_tot = u256.normalize(credit_tot)
+    send_counts = jax.ops.segment_sum(mask_i, sender_idx,
+                                      num_segments=num_accounts)
+    solvent = u256.gte(balances, required_tot)            # [A]
+    ok = nonce_ok & jnp.all(solvent | (send_counts == 0))
+    new_balances = u256.sub(u256.add(balances, credit_tot), debit_tot)
+    new_nonces = nonces + send_counts
+    return new_balances, new_nonces, ok
+
+
+class DeviceState:
+    """Account-indexed device arrays (the flat-state / snapshot analog,
+    reference core/state/snapshot/ — here resident in HBM)."""
+
+    def __init__(self, capacity: int = 1 << 14):
+        self.index: Dict[bytes, int] = {}
+        self.addrs: List[bytes] = []
+        self.capacity = capacity
+        self.balances = jnp.zeros((capacity, u256.LIMBS), dtype=jnp.int32)
+        self.nonces = jnp.zeros((capacity,), dtype=jnp.int32)
+        # host-side metadata that gates device replay
+        self.has_code: List[bool] = []
+        self.multicoin: List[bool] = []
+        self._staged: List[Tuple[int, int, int]] = []
+
+    def _grow(self, need: int) -> None:
+        while self.capacity < need:
+            self.capacity *= 2
+        self.balances = jnp.zeros(
+            (self.capacity, u256.LIMBS), dtype=jnp.int32
+        ).at[:self.balances.shape[0]].set(self.balances)
+        self.nonces = jnp.zeros(
+            (self.capacity,), dtype=jnp.int32
+        ).at[:self.nonces.shape[0]].set(self.nonces)
+
+    def ensure(self, addr: bytes, account: Optional[StateAccount]) -> int:
+        idx = self.index.get(addr)
+        if idx is not None:
+            return idx
+        idx = len(self.addrs)
+        if idx >= self.capacity:
+            self._grow(idx + 1)
+        self.index[addr] = idx
+        self.addrs.append(addr)
+        if account is None:
+            self.has_code.append(False)
+            self.multicoin.append(False)
+        else:
+            self.has_code.append(account.code_hash != EMPTY_CODE_HASH)
+            self.multicoin.append(account.is_multi_coin)
+            if account.balance or account.nonce:
+                # staged; one scatter per block (a per-account .at[].set
+                # would copy the whole array each time)
+                self._staged.append((idx, account.balance, account.nonce))
+        return idx
+
+    _staged: List[Tuple[int, int, int]]
+
+    def flush_staged(self) -> None:
+        if not self._staged:
+            return
+        idx = jnp.asarray([s[0] for s in self._staged], dtype=jnp.int32)
+        bal = u256.from_ints([s[1] for s in self._staged])
+        non = jnp.asarray([s[2] for s in self._staged], dtype=jnp.int32)
+        self.balances = self.balances.at[idx].set(bal)
+        self.nonces = self.nonces.at[idx].set(non)
+        self._staged = []
+
+    def read_accounts(self, indices: List[int]) -> List[Tuple[int, int]]:
+        """Pull (balance, nonce) for given indices to host."""
+        idx = np.asarray(indices, dtype=np.int32)
+        bal = np.asarray(self.balances[jnp.asarray(idx)])
+        non = np.asarray(self.nonces[jnp.asarray(idx)])
+        balances = u256.to_ints(bal)
+        return [(balances[i], int(non[i])) for i in range(len(indices))]
+
+
+class ReplayEngine:
+    """Windowed replay over a shared state Database."""
+
+    def __init__(self, config: ChainConfig, db: Database, state_root: bytes,
+                 parent_header=None, batch_pad: int = 1024,
+                 capacity: int = 1 << 14, window: int = 16):
+        self.config = config
+        self.db = db
+        self.trie = db.open_trie(state_root)
+        self.state = DeviceState(capacity)
+        self.signer = LatestSigner(config.chain_id)
+        self.engine = DummyEngine()
+        self.engine.set_config(config)
+        self.processor = Processor(config, engine=self.engine)
+        self.stats = ReplayStats()
+        self.batch_pad = batch_pad
+        self.window = window
+        self.root = state_root
+        # parent header of the next block to replay; needed by the
+        # fallback path's engine.finalize (AP4 blockGasCost validation)
+        self.parent_header = parent_header
+
+    # ---------------------------------------------------------------- index
+    def _account(self, addr: bytes) -> int:
+        idx = self.state.index.get(addr)
+        if idx is not None:
+            return idx
+        raw = self.trie.get(addr)
+        account = StateAccount.from_rlp(raw) if raw is not None else None
+        return self.state.ensure(addr, account)
+
+    # -------------------------------------------------------------- senders
+    def warm_senders(self, block: Block) -> None:
+        """Batched sender recovery (reference core/sender_cacher.go role,
+        via the native C++ batch instead of goroutines)."""
+        t0 = time.monotonic()
+        todo = [tx for tx in block.transactions
+                if tx.cached_sender() is None]
+        if todo:
+            try:
+                from coreth_tpu.crypto import native
+                if native.load() is not None:
+                    hashes, rs, ss, recids = [], [], [], []
+                    for tx in todo:
+                        r, s, recid = tx.inner.raw_signature()
+                        hashes.append(self.signer.sig_hash(tx))
+                        rs.append(r.to_bytes(32, "big"))
+                        ss.append(s.to_bytes(32, "big"))
+                        recids.append(recid)
+                    out, ok = native.recover_addresses_batch(
+                        b"".join(hashes), b"".join(rs), b"".join(ss),
+                        bytes(recids))
+                    for i, tx in enumerate(todo):
+                        if ok[i]:
+                            # signer.sender re-validates chain id + low-s
+                            # before trusting the cache; prime it only
+                            r, s, recid = tx.inner.raw_signature()
+                            if recid in (0, 1) and \
+                                    0 < s <= secp_half_n():
+                                tx.set_sender(out[i * 20:(i + 1) * 20])
+            except Exception:  # noqa: BLE001 — fall back to per-tx path
+                pass
+        self.stats.t_sender += time.monotonic() - t0
+
+    # ------------------------------------------------------------- classify
+    def _classify(self, block: Block) -> Optional[dict]:
+        """Batch inputs if the block is device-replayable, else None."""
+        base_fee = block.base_fee
+        senders, recips, values, fees, required, nonces, offsets = \
+            [], [], [], [], [], [], []
+        seen_count: Dict[bytes, int] = {}
+        for tx in block.transactions:
+            if tx.to is None or tx.data or tx.gas != P.TX_GAS:
+                return None
+            if tx.access_list:
+                return None
+            sender = self.signer.sender(tx)
+            s_idx = self._account(sender)
+            r_idx = self._account(tx.to)
+            if (self.state.has_code[s_idx] or self.state.has_code[r_idx]
+                    or self.state.multicoin[s_idx]
+                    or self.state.multicoin[r_idx]):
+                return None
+            if base_fee is not None:
+                if tx.gas_fee_cap < base_fee or \
+                        tx.gas_fee_cap < tx.gas_tip_cap:
+                    return None
+                price = min(tx.gas_fee_cap, base_fee + tx.gas_tip_cap)
+            else:
+                price = tx.gas_price
+            senders.append(s_idx)
+            recips.append(r_idx)
+            values.append(tx.value)
+            fees.append(P.TX_GAS * price)
+            # buyGas requirement (cap-based for typed txs)
+            required.append(P.TX_GAS * tx.gas_fee_cap + tx.value)
+            nonces.append(tx.nonce)
+            offsets.append(seen_count.get(sender, 0))
+            seen_count[sender] = seen_count.get(sender, 0) + 1
+        coinbase_idx = self._account(block.header.coinbase)
+        return dict(senders=senders, recips=recips, values=values,
+                    fees=fees, required=required, nonces=nonces,
+                    offsets=offsets, coinbase=coinbase_idx)
+
+    # ---------------------------------------------------------------- replay
+    def _prepare_window(self, items: List[Tuple[Block, dict]]):
+        """Pack a run of classified blocks into stacked device inputs.
+
+        The window is padded to ``self.window`` slots with no-op blocks
+        (all-masked-out batches) so every device call shares ONE
+        compiled shape — a fresh shape costs seconds of remote compile
+        per process."""
+        self.state.flush_staged()
+        K = max(len(items), self.window)
+        pad = self.batch_pad
+        t_pad = 256
+        touched_lists = []
+        for block, batch in items:
+            B = len(block.transactions)
+            while pad < B:
+                pad *= 2
+            touched = sorted(set(batch["senders"]) | set(batch["recips"])
+                             | {batch["coinbase"]})
+            touched_lists.append(touched)
+            while t_pad < len(touched):
+                t_pad *= 2
+        txds = np.zeros((K, pad, TXD_COLS), dtype=np.int32)
+        t_idxs = np.zeros((K, t_pad), dtype=np.int32)
+        for k, (block, batch) in enumerate(items):
+            B = len(block.transactions)
+            txds[k] = pack_txd(batch, B, pad)
+            t_idxs[k, :len(touched_lists[k])] = touched_lists[k]
+        return txds, t_idxs, touched_lists
+
+    def _issue_window(self, items: List[Tuple[Block, dict]]) -> dict:
+        """One device call for a whole run of transfer blocks: upload the
+        stacked batches, lax.scan the steps, download one stacked fetch
+        tensor.  Round-trip latency amortizes over the window."""
+        t0 = time.monotonic()
+        txds, t_idxs, touched_lists = self._prepare_window(items)
+        prev = (self.state.balances, self.state.nonces)
+        new_bal, new_non, fetches = _transfer_window(
+            prev[0], prev[1], jnp.asarray(txds), jnp.asarray(t_idxs),
+            num_accounts=self.state.capacity)
+        self.state.balances = new_bal
+        self.state.nonces = new_non
+        self.stats.t_device += time.monotonic() - t0
+        return dict(items=items, prev=prev, fetches=fetches,
+                    touched_lists=touched_lists)
+
+    def _complete_window(self, win: dict, blocks: List[Block],
+                         start_idx: int) -> Optional[int]:
+        """Validate a window from its fetched tensors.  Returns None on
+        full success, else the index (into ``blocks``) to resume from
+        after the rewind+fallback recovery."""
+        t0 = time.monotonic()
+        arr = np.asarray(win["fetches"])  # ONE device read per window
+        self.stats.t_device += time.monotonic() - t0
+        items = win["items"]
+        for k, (block, batch) in enumerate(items):
+            if arr[k, -1, 0] != 1:
+                return self._recover_window(win, arr, k, blocks, start_idx)
+            self._validate_and_advance(block, arr[k],
+                                       win["touched_lists"][k])
+        return None
+
+    def _recover_window(self, win, arr, k: int, blocks, start_idx: int) -> int:
+        """Block k of the window failed the device validation: the valid
+        prefix [0, k) has already been folded into the trie by the loop
+        above; restore device arrays to the window start, re-apply the
+        valid prefix on device, then run block k through the exact host
+        path.  Returns the next block index to resume issuing from."""
+        self.state.balances, self.state.nonces = win["prev"]
+        if k > 0:
+            items = win["items"][:k]
+            txds, t_idxs, _ = self._prepare_window(items)
+            new_bal, new_non, _ = _transfer_window(
+                self.state.balances, self.state.nonces,
+                jnp.asarray(txds), jnp.asarray(t_idxs),
+                num_accounts=self.state.capacity)
+            self.state.balances = new_bal
+            self.state.nonces = new_non
+        self._fallback(blocks[start_idx + k])
+        return start_idx + k + 1
+
+    def _validate_and_advance(self, block: Block, fetched: np.ndarray,
+                              touched: List[int]) -> None:
+        """Host-side consensus checks + trie fold for one device block."""
+        B = len(block.transactions)
+        used_gas = P.TX_GAS * B
+        if used_gas != block.header.gas_used:
+            raise ReplayError("gas used mismatch")
+        receipts = [Receipt(tx_type=tx.tx_type, status=1,
+                            cumulative_gas_used=P.TX_GAS * (i + 1),
+                            tx_hash=tx.hash(), gas_used=P.TX_GAS)
+                    for i, tx in enumerate(block.transactions)]
+        if derive_sha(receipts) != block.header.receipt_hash:
+            raise ReplayError("receipt root mismatch")
+        if create_bloom(receipts) != block.header.bloom:
+            raise ReplayError("bloom mismatch")
+        if self.config.is_apricot_phase4(block.time):
+            self.engine.verify_block_fee(
+                block.base_fee, block.header.block_gas_cost,
+                block.transactions, receipts, None)
+        t0 = time.monotonic()
+        n_touched = len(touched)
+        balances = u256.to_ints(fetched[:n_touched, :16])
+        nonces = fetched[:n_touched, 16]
+        for i, idx in enumerate(touched):
+            addr = self.state.addrs[idx]
+            balance, nonce = balances[i], int(nonces[i])
+            if balance == 0 and nonce == 0:
+                # touched but empty: EIP-158 deletion semantics
+                self.trie.delete(addr)
+            else:
+                self.trie.update(
+                    addr, StateAccount(nonce=nonce, balance=balance).rlp())
+        root = device_rehash(self.trie)
+        self.stats.t_trie += time.monotonic() - t0
+        if root != block.header.root:
+            raise ReplayError(
+                f"state root mismatch at block {block.number}: "
+                f"{root.hex()} != {block.header.root.hex()}")
+        self.root = root
+        self.parent_header = block.header
+        self.stats.blocks_device += 1
+        self.stats.txs += B
+
+    def replay_block(self, block: Block) -> bytes:
+        """Process one block synchronously (tests; replay() windows)."""
+        self.warm_senders(block)
+        t0 = time.monotonic()
+        batch = self._classify(block)
+        self.stats.t_classify += time.monotonic() - t0
+        if batch is None:
+            return self._fallback(block)
+        win = self._issue_window([(block, batch)])
+        resume = self._complete_window(win, [block], 0)
+        return self.root if resume is None else self.root
+
+    def replay(self, blocks: List[Block],
+               window: Optional[int] = None) -> bytes:
+        """Windowed replay: consecutive device-replayable blocks execute
+        as ONE device call (scan over the window) with one upload and
+        one download — the TPU-native analog of the reference's
+        commit-interval batching (state_manager.go:74) and acceptor
+        pipeline (blockchain.go:566).  Unreplayable blocks flush the
+        window and run through the exact host path."""
+        window = window or self.window
+        i = 0
+        n = len(blocks)
+        run: List[Tuple[Block, dict]] = []
+        run_start = 0
+
+        def flush() -> Optional[int]:
+            nonlocal run
+            if not run:
+                return None
+            win = self._issue_window(run)
+            resume = self._complete_window(win, blocks, run_start)
+            run = []
+            return resume
+
+        while i < n:
+            block = blocks[i]
+            self.warm_senders(block)
+            t0 = time.monotonic()
+            batch = self._classify(block)
+            self.stats.t_classify += time.monotonic() - t0
+            if batch is None:
+                resume = flush()
+                if resume is not None:
+                    i = resume
+                    continue
+                self._fallback(block)
+                i += 1
+                continue
+            if not run:
+                run_start = i
+            run.append((block, batch))
+            i += 1
+            if len(run) >= window:
+                resume = flush()
+                if resume is not None:
+                    i = resume
+        resume = flush()
+        if resume is not None:
+            # finish the tail after a late rewind
+            return self.replay(blocks[resume:], window)
+        return self.root
+
+    def _fallback(self, block: Block) -> bytes:
+        """Bit-exact host path for non-transfer blocks; device state for
+        touched accounts is refreshed afterwards."""
+        t0 = time.monotonic()
+        self.trie.commit()
+        self.db.cache_trie(self.root, self.trie)
+        statedb = StateDB(self.root, self.db)
+        parent = self.parent_header or _HeaderShim(block)
+        receipts, logs, used_gas = self.processor.process(
+            block, parent, statedb)
+        if used_gas != block.header.gas_used:
+            raise ReplayError("gas used mismatch (fallback)")
+        if derive_sha(receipts) != block.header.receipt_hash:
+            raise ReplayError("receipt root mismatch (fallback)")
+        root = statedb.intermediate_root(True)
+        if root != block.header.root:
+            raise ReplayError("state root mismatch (fallback)")
+        statedb.commit(delete_empty_objects=True)
+        # refresh engine trie + device copies of touched accounts (one
+        # batched scatter via the staging buffer)
+        self.trie = self.db.open_trie(root)
+        self.state.flush_staged()
+        for addr in list(statedb._objects):
+            idx = self.state.index.get(addr)
+            if idx is None:
+                continue
+            raw = self.trie.get(addr)
+            account = StateAccount.from_rlp(raw) if raw else StateAccount()
+            self.state._staged.append(
+                (idx, account.balance, account.nonce))
+            self.state.has_code[idx] = \
+                account.code_hash != EMPTY_CODE_HASH
+            self.state.multicoin[idx] = account.is_multi_coin
+        self.state.flush_staged()
+        self.root = root
+        self.parent_header = block.header
+        self.stats.blocks_fallback += 1
+        self.stats.txs += len(block.transactions)
+        self.stats.t_fallback += time.monotonic() - t0
+        return root
+
+    def replay(self, blocks: List[Block]) -> bytes:
+        for block in blocks:
+            self.replay_block(block)
+        return self.root
+
+    def commit(self) -> bytes:
+        """Persist the engine trie so host StateDBs can open the state."""
+        root = self.trie.commit()
+        self.db.cache_trie(root, self.trie)
+        return root
+
+
+class _HeaderShim:
+    """Minimal parent-header stand-in when the true parent header was not
+    supplied to the engine — correct only pre-AP4 (the AP4 blockGasCost
+    validation needs the real parent's block_gas_cost/time)."""
+
+    def __init__(self, block: Block):
+        self.time = block.header.time
+        self.number = block.header.number - 1
+        self.block_gas_cost = None
+        self.base_fee = None
+        self.ext_data_gas_used = None

@@ -1,0 +1,9 @@
+"""Transaction mempool.
+
+Semantic twin of reference ``core/txpool/`` (txpool.go, list.go,
+noncer.go): pending (executable) and queued (gapped) per-account
+nonce-sorted lists, validation against current state, price-based
+eviction, and head-reset handling driven by chain events.
+"""
+
+from coreth_tpu.txpool.pool import TxPool, TxPoolConfig  # noqa: F401

@@ -1,0 +1,321 @@
+"""The EVM transaction pool.
+
+Twin of reference core/txpool/txpool.go (NewTxPool :318, add :815,
+validateTx :792, Pending :599, reset loop :379) + list.go (nonce-ordered
+per-account lists) + noncer.go (virtual pending nonces).  Event-loop
+goroutines become explicit methods: the chain calls :meth:`reset` on
+head change (the reference drives this from chainHeadEvent).
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from coreth_tpu.params import ChainConfig
+from coreth_tpu.params import protocol as P
+from coreth_tpu.processor.state_transition import intrinsic_gas
+from coreth_tpu.types import LatestSigner, Transaction
+
+
+class TxPoolError(Exception):
+    pass
+
+
+class ErrAlreadyKnown(TxPoolError):
+    pass
+
+
+class ErrNonceTooLow(TxPoolError):
+    pass
+
+
+class ErrUnderpriced(TxPoolError):
+    pass
+
+
+class ErrReplaceUnderpriced(TxPoolError):
+    pass
+
+
+class ErrInsufficientFunds(TxPoolError):
+    pass
+
+
+class ErrIntrinsicGas(TxPoolError):
+    pass
+
+
+class ErrGasLimit(TxPoolError):
+    pass
+
+
+class ErrOversizedData(TxPoolError):
+    pass
+
+
+class ErrTxPoolOverflow(TxPoolError):
+    pass
+
+
+TX_MAX_SIZE = 4 * 32 * 1024  # txMaxSize (txpool.go)
+
+
+@dataclass
+class TxPoolConfig:
+    """config twin (txpool.go TxPoolConfig / DefaultTxPoolConfig)."""
+    price_limit: int = 1
+    price_bump: int = 10          # % price bump to replace a pending tx
+    account_slots: int = 16
+    global_slots: int = 4096 + 1024
+    account_queue: int = 64
+    global_queue: int = 1024
+
+
+class _AccountList:
+    """Nonce-sorted tx list for one account (list.go txList)."""
+
+    def __init__(self):
+        self.items: Dict[int, Transaction] = {}
+
+    def get(self, nonce: int) -> Optional[Transaction]:
+        return self.items.get(nonce)
+
+    def put(self, tx: Transaction) -> None:
+        self.items[tx.nonce] = tx
+
+    def remove(self, nonce: int) -> bool:
+        return self.items.pop(nonce, None) is not None
+
+    def forward(self, threshold: int) -> List[Transaction]:
+        """Drop (and return) every tx with nonce < threshold."""
+        drop = [tx for n, tx in self.items.items() if n < threshold]
+        for tx in drop:
+            del self.items[tx.nonce]
+        return drop
+
+    def ready(self, start: int) -> List[Transaction]:
+        """Sequential run of txs beginning at nonce ``start``."""
+        out = []
+        nonce = start
+        while nonce in self.items:
+            out.append(self.items[nonce])
+            nonce += 1
+        return out
+
+    def cap_cost(self, balance: int,
+                 gas_limit: int) -> List[Transaction]:
+        """Drop txs whose cost exceeds balance or gas the block limit."""
+        drop = [tx for tx in self.items.values()
+                if tx.cost() > balance or tx.gas > gas_limit]
+        for tx in drop:
+            del self.items[tx.nonce]
+        return drop
+
+    def __len__(self):
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+
+class TxPool:
+    def __init__(self, config: ChainConfig, chain,
+                 pool_config: Optional[TxPoolConfig] = None):
+        """``chain`` must expose current_block(), state_at(root),
+        and the chain config's signer rules."""
+        self.config = config
+        self.chain = chain
+        self.pool_config = pool_config or TxPoolConfig()
+        self.signer = LatestSigner(config.chain_id)
+        self.pending: Dict[bytes, _AccountList] = {}
+        self.queue: Dict[bytes, _AccountList] = {}
+        self.all: Dict[bytes, Transaction] = {}
+        self.pending_nonces: Dict[bytes, int] = {}  # noncer.go
+        self._head = chain.current_block()
+        self._statedb = chain.state_at(self._head.root)
+        # AP3+: minimum fee estimation baseline for validation
+        self.gas_tip = self.pool_config.price_limit
+
+    # -------------------------------------------------------------- queries
+    def get(self, tx_hash: bytes) -> Optional[Transaction]:
+        return self.all.get(tx_hash)
+
+    def has(self, tx_hash: bytes) -> bool:
+        return tx_hash in self.all
+
+    def stats(self) -> Tuple[int, int]:
+        return (sum(len(l) for l in self.pending.values()),
+                sum(len(l) for l in self.queue.values()))
+
+    def content(self):
+        return ({a: list(l.items.values()) for a, l in self.pending.items()},
+                {a: list(l.items.values()) for a, l in self.queue.items()})
+
+    def pending_txs(self, base_fee: Optional[int] = None
+                    ) -> Dict[bytes, List[Transaction]]:
+        """Executable txs per account, nonce-ordered (Pending :599)."""
+        out = {}
+        for addr, lst in self.pending.items():
+            txs = lst.ready(self._statedb.get_nonce(addr))
+            if base_fee is not None:
+                txs = [tx for tx in txs if tx.gas_fee_cap >= base_fee]
+            if txs:
+                out[addr] = txs
+        return out
+
+    def nonce(self, addr: bytes) -> int:
+        """Next executable nonce including pending txs (noncer)."""
+        return self.pending_nonces.get(addr,
+                                       self._statedb.get_nonce(addr))
+
+    # ------------------------------------------------------------ add path
+    def add_remotes(self, txs: List[Transaction]) -> List[Optional[Exception]]:
+        return [self._add_one(tx) for tx in txs]
+
+    def add_local(self, tx: Transaction) -> None:
+        err = self._add_one(tx)
+        if err is not None:
+            raise err
+
+    def _add_one(self, tx: Transaction) -> Optional[Exception]:
+        try:
+            self._add(tx)
+            return None
+        except TxPoolError as e:
+            return e
+
+    def _validate(self, tx: Transaction) -> bytes:
+        """validateTx (txpool.go:792)."""
+        if tx.size() > TX_MAX_SIZE:
+            raise ErrOversizedData("oversized data")
+        if tx.value < 0:
+            raise TxPoolError("negative value")
+        head = self.chain.current_block()
+        if tx.gas > head.gas_limit:
+            raise ErrGasLimit(f"exceeds block gas limit {head.gas_limit}")
+        if tx.gas_fee_cap < tx.gas_tip_cap:
+            raise TxPoolError("tip above fee cap")
+        try:
+            sender = self.signer.sender(tx)
+        except ValueError as e:
+            raise TxPoolError(f"invalid sender: {e}")
+        if tx.gas_tip_cap < self.gas_tip:
+            raise ErrUnderpriced("transaction underpriced")
+        state_nonce = self._statedb.get_nonce(sender)
+        if state_nonce > tx.nonce:
+            raise ErrNonceTooLow(
+                f"nonce too low: state {state_nonce}, tx {tx.nonce}")
+        if self._statedb.get_balance(sender) < tx.cost():
+            raise ErrInsufficientFunds("insufficient funds")
+        rules = self.config.rules(head.number + 1, head.time)
+        gas = intrinsic_gas(tx.data, tx.access_list, tx.to is None, rules)
+        if tx.gas < gas:
+            raise ErrIntrinsicGas(f"intrinsic gas {gas} > limit {tx.gas}")
+        return sender
+
+    def _add(self, tx: Transaction) -> None:
+        h = tx.hash()
+        if h in self.all:
+            raise ErrAlreadyKnown("already known")
+        sender = self._validate(tx)
+        pending_cnt, queue_cnt = self.stats()
+        if pending_cnt + queue_cnt >= (self.pool_config.global_slots
+                                       + self.pool_config.global_queue):
+            raise ErrTxPoolOverflow("txpool is full")
+        # replacement: same nonce in pending requires a price bump
+        plist = self.pending.get(sender)
+        if plist is not None:
+            old = plist.get(tx.nonce)
+            if old is not None:
+                bump = old.gas_tip_cap * (100 + self.pool_config.price_bump) \
+                    // 100
+                bump_fee = old.gas_fee_cap * (
+                    100 + self.pool_config.price_bump) // 100
+                if tx.gas_tip_cap < bump or tx.gas_fee_cap < bump_fee:
+                    raise ErrReplaceUnderpriced("replacement underpriced")
+                del self.all[old.hash()]
+                plist.put(tx)
+                self.all[h] = tx
+                return
+        # enqueue, then promote whatever became executable
+        qlist = self.queue.setdefault(sender, _AccountList())
+        old = qlist.get(tx.nonce)
+        if old is not None:
+            bump = old.gas_tip_cap * (100 + self.pool_config.price_bump) // 100
+            if tx.gas_tip_cap < bump:
+                raise ErrReplaceUnderpriced("replacement underpriced")
+            del self.all[old.hash()]
+        qlist.put(tx)
+        self.all[h] = tx
+        self._promote(sender)
+
+    def _promote(self, addr: bytes) -> None:
+        """Move the executable nonce-run from queue to pending
+        (promoteExecutables)."""
+        qlist = self.queue.get(addr)
+        if qlist is None:
+            return
+        start = self.nonce(addr)
+        run = qlist.ready(start)
+        if not run:
+            return
+        plist = self.pending.setdefault(addr, _AccountList())
+        for tx in run:
+            qlist.remove(tx.nonce)
+            plist.put(tx)
+        self.pending_nonces[addr] = run[-1].nonce + 1
+        if qlist.empty():
+            del self.queue[addr]
+
+    # --------------------------------------------------------------- reset
+    def reset(self) -> None:
+        """Head changed: drop mined/stale txs, demote, re-promote
+        (the reference's reset loop, txpool.go:379/:640)."""
+        self._head = self.chain.current_block()
+        self._statedb = self.chain.state_at(self._head.root)
+        for addr in list(self.pending):
+            lst = self.pending[addr]
+            state_nonce = self._statedb.get_nonce(addr)
+            for tx in lst.forward(state_nonce):
+                self.all.pop(tx.hash(), None)
+            balance = self._statedb.get_balance(addr)
+            for tx in lst.cap_cost(balance, self._head.gas_limit):
+                self.all.pop(tx.hash(), None)
+            if lst.empty():
+                del self.pending[addr]
+                self.pending_nonces.pop(addr, None)
+            else:
+                self.pending_nonces[addr] = max(lst.items) + 1
+        for addr in list(self.queue):
+            lst = self.queue[addr]
+            state_nonce = self._statedb.get_nonce(addr)
+            for tx in lst.forward(state_nonce):
+                self.all.pop(tx.hash(), None)
+            if lst.empty():
+                del self.queue[addr]
+        for addr in list(self.queue):
+            self._promote(addr)
+
+    # ---------------------------------------------------------- assembly aid
+    def txs_by_price_and_nonce(self, base_fee: Optional[int]
+                               ) -> List[Transaction]:
+        """Flatten pending into miner order: per-account nonce order,
+        across accounts by effective tip (types.TransactionsByPriceAndNonce
+        consumed at miner/worker.go:~190)."""
+        pending = self.pending_txs(base_fee)
+        heads = []
+        for addr, txs in pending.items():
+            tip = txs[0].effective_gas_tip(base_fee)
+            heapq.heappush(heads, (-tip, addr.hex(), 0, txs))
+        out = []
+        while heads:
+            neg_tip, ahex, i, txs = heapq.heappop(heads)
+            out.append(txs[i])
+            if i + 1 < len(txs):
+                nxt = txs[i + 1]
+                heapq.heappush(
+                    heads,
+                    (-nxt.effective_gas_tip(base_fee), ahex, i + 1, txs))
+        return out

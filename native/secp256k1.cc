@@ -177,23 +177,82 @@ void fe_inv(U256& r, const U256& a) {
   fe_pow(r, a, e);
 }
 
-// ---- scalar arithmetic mod n (shift-and-add; cold path) ----
+// ---- scalar arithmetic mod n ----
+//
+// 4x4-limb schoolbook product + fold reduction: with K = 2^256 - n
+// (129 bits), hi*2^256 + lo == hi*K + lo (mod n); three folds bring any
+// 512-bit value under ~2^257, then conditional subtracts finish.
 
-void sc_mul(U256& r, const U256& a, const U256& b, const U256& m) {
-  U256 acc = ZERO;
-  for (int i = 255; i >= 0; --i) {
-    // acc = 2*acc mod m
-    U256 t;
-    uint64_t carry = add_raw(t, acc, acc);
-    if (carry || cmp(t, m) >= 0) {
-      U256 t2;
-      sub_raw(t2, t, m);
-      t = t2;
+// K = 2^256 - n, little-endian limbs (third limb = 1, fourth = 0)
+const uint64_t ORDER_K[3] = {0x402DA1732FC9BEBFULL, 0x4551231950B75FC4ULL,
+                             1ULL};
+
+// w[0..7] = a * b (little-endian 64-bit limbs)
+inline void mul_wide(uint64_t w[8], const U256& a, const U256& b) {
+  for (int i = 0; i < 8; ++i) w[i] = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)a.v[i] * b.v[j] + w[i + j] + carry;
+      w[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
     }
-    acc = t;
-    if ((b.v[i / 64] >> (i % 64)) & 1) mod_add(acc, acc, a, m);
+    w[i + 4] += (uint64_t)carry;
   }
-  r = acc;
+}
+
+// fold an 8-limb value once: out(<= 7 limbs) = lo(4) + hi(4) * K
+inline int fold_once(uint64_t out[8], const uint64_t in[8], int limbs) {
+  uint64_t hiK[8] = {0};
+  int hi_limbs = limbs - 4;
+  for (int i = 0; i < hi_limbs; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 3; ++j) {
+      u128 cur = (u128)in[4 + i] * ORDER_K[j] + hiK[i + j] + carry;
+      hiK[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    int k = i + 3;
+    while (carry) {
+      u128 cur = (u128)hiK[k] + carry;
+      hiK[k] = (uint64_t)cur;
+      carry = cur >> 64;
+      ++k;
+    }
+  }
+  u128 carry = 0;
+  for (int i = 0; i < 8; ++i) {
+    u128 cur = (u128)hiK[i] + (i < 4 ? in[i] : 0) + carry;
+    out[i] = (uint64_t)cur;
+    carry = cur >> 64;
+  }
+  int top = 8;
+  while (top > 4 && out[top - 1] == 0) --top;
+  return top;
+}
+
+void sc_reduce_wide(U256& r, const uint64_t w[8]) {
+  uint64_t a[8], b[8];
+  int limbs = 8;
+  for (int i = 0; i < 8; ++i) a[i] = w[i];
+  // each fold strictly shrinks the value; 8 passes is a safe bound
+  for (int pass = 0; pass < 8 && limbs > 4; ++pass) {
+    limbs = fold_once(b, a, limbs);
+    for (int i = 0; i < 8; ++i) a[i] = b[i];
+  }
+  U256 t = {{a[0], a[1], a[2], a[3]}};
+  while (cmp(t, ORDER) >= 0) {
+    U256 t2;
+    sub_raw(t2, t, ORDER);
+    t = t2;
+  }
+  r = t;
+}
+
+void sc_mul(U256& r, const U256& a, const U256& b, const U256& /*m*/) {
+  uint64_t w[8];
+  mul_wide(w, a, b);
+  sc_reduce_wide(r, w);
 }
 
 void sc_pow(U256& r, const U256& a, const U256& e, const U256& m) {

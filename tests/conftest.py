@@ -8,10 +8,28 @@ exactly as the driver's dryrun_multichip harness does.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the virtual CPU mesh even when the ambient environment pins the
+# axon TPU tunnel (its bootstrap overrides JAX_PLATFORMS programmatically,
+# so the env var alone is not enough — jax.config.update below wins).
+# Set CORETH_TPU_TESTS=1 to run the suite against the real chip.
+_FORCE_CPU = not os.environ.get("CORETH_TPU_TESTS")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Persistent XLA compilation cache: the keccak/replay kernels compile once
+# per machine instead of once per pytest run.
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+os.makedirs(_cache_dir, exist_ok=True)
+
+
+def pytest_configure(config):
+    import jax
+    if _FORCE_CPU:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,79 @@
+"""Mesh-sharded replay step: parity with the single-device step on the
+virtual 8-device CPU mesh."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from coreth_tpu.ops import u256
+from coreth_tpu.parallel import make_mesh, sharded_transfer_step
+from coreth_tpu.replay.engine import _transfer_step
+
+
+def test_sharded_step_matches_single_device():
+    assert len(jax.devices()) == 8, "conftest must force 8 cpu devices"
+    A, B = 64, 32
+    rng = np.random.default_rng(42)
+    balances_int = [int(x) * 10**18 for x in rng.integers(1, 1000, A)]
+    balances = u256.from_ints(balances_int)
+    nonces = jnp.asarray(rng.integers(0, 5, A), dtype=jnp.int32)
+    sender = rng.integers(0, A // 2, B).astype(np.int32)
+    recip = (rng.integers(A // 2, A, B)).astype(np.int32)
+    value = u256.from_ints([int(x) for x in rng.integers(1, 10**9, B)])
+    fee = u256.from_ints([21000 * 25 * 10**9] * B)
+    required = u256.normalize(value + fee)
+    # nonce bookkeeping: offsets per sender in order
+    offsets = np.zeros(B, dtype=np.int32)
+    seen = {}
+    tx_nonce = np.zeros(B, dtype=np.int32)
+    nonces_host = np.asarray(nonces)
+    for i, s in enumerate(sender):
+        offsets[i] = seen.get(s, 0)
+        tx_nonce[i] = nonces_host[s] + offsets[i]
+        seen[s] = offsets[i] + 1
+    mask = np.ones(B, dtype=bool)
+    coinbase = A - 1
+
+    single = _transfer_step(
+        balances, nonces, jnp.asarray(sender), jnp.asarray(recip),
+        value, fee, required, jnp.asarray(tx_nonce), jnp.asarray(offsets),
+        jnp.asarray(mask), coinbase, num_accounts=A)
+
+    mesh = make_mesh()
+    step = sharded_transfer_step(mesh, A)
+    sharded = step(balances, nonces, jnp.asarray(sender),
+                   jnp.asarray(recip), value, fee, required,
+                   jnp.asarray(tx_nonce), jnp.asarray(offsets),
+                   jnp.asarray(mask), coinbase)
+
+    assert bool(single[2]) and bool(sharded[2])
+    np.testing.assert_array_equal(np.asarray(single[0]),
+                                  np.asarray(sharded[0]))
+    np.testing.assert_array_equal(np.asarray(single[1]),
+                                  np.asarray(sharded[1]))
+
+
+def test_sharded_step_detects_bad_nonce():
+    A, B = 16, 8
+    balances = u256.from_ints([10**20] * A)
+    nonces = jnp.zeros(A, dtype=jnp.int32)
+    sender = np.arange(B, dtype=np.int32)
+    recip = (np.arange(B, dtype=np.int32) + 8) % A
+    value = u256.from_ints([1] * B)
+    fee = u256.from_ints([21000] * B)
+    required = u256.normalize(value + fee)
+    tx_nonce = np.zeros(B, dtype=np.int32)
+    tx_nonce[3] = 7  # wrong
+    mesh = make_mesh()
+    step = sharded_transfer_step(mesh, A)
+    _, _, ok = step(balances, nonces, jnp.asarray(sender),
+                    jnp.asarray(recip), value, fee, required,
+                    jnp.asarray(tx_nonce),
+                    jnp.zeros(B, dtype=jnp.int32),
+                    jnp.ones(B, dtype=bool), A - 1)
+    assert not bool(ok)

@@ -1,0 +1,174 @@
+"""Batched replay engine: u256 limb math + device/host parity on roots."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount, generate_chain
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ops import u256
+from coreth_tpu.params import TEST_CHAIN_CONFIG
+from coreth_tpu.replay import ReplayEngine
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+
+GWEI = 10**9
+KEYS = [0x1000 + i for i in range(8)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+CFG = TEST_CHAIN_CONFIG
+
+
+# ---------------------------------------------------------------- u256 math
+
+def test_u256_roundtrip():
+    vals = [0, 1, 0xFFFF, 2**255 + 12345, 2**256 - 1, 10**24]
+    arr = u256.from_ints(vals)
+    assert u256.to_ints(arr) == vals
+
+
+def test_u256_add_sub_gte():
+    import random
+    rng = random.Random(7)
+    a_vals = [rng.randrange(2**250) for _ in range(64)]
+    b_vals = [rng.randrange(2**250) for _ in range(64)]
+    a = u256.from_ints(a_vals)
+    b = u256.from_ints(b_vals)
+    add = u256.to_ints(u256.add(a, b))
+    assert add == [(x + y) % 2**256 for x, y in zip(a_vals, b_vals)]
+    big = u256.from_ints([max(x, y) for x, y in zip(a_vals, b_vals)])
+    small = u256.from_ints([min(x, y) for x, y in zip(a_vals, b_vals)])
+    sub = u256.to_ints(u256.sub(big, small))
+    assert sub == [abs(x - y) for x, y in zip(a_vals, b_vals)]
+    gte = np.asarray(u256.gte(a, b))
+    assert list(gte) == [x >= y for x, y in zip(a_vals, b_vals)]
+
+
+def test_u256_segment_headroom():
+    # sum 4096 maxed values then normalize — no overflow in int32 limbs
+    import jax.numpy as jnp
+    vals = u256.from_ints([2**256 - 1] * 4096)
+    summed = jnp.sum(vals, axis=0)
+    norm = u256.normalize(summed[None, :])
+    expect = (4096 * (2**256 - 1)) % 2**256
+    assert u256.to_ints(norm)[0] == expect
+
+
+# ------------------------------------------------------------ replay parity
+
+def build_transfer_chain(n_blocks, txs_per_block, cross=False):
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={a: GenesisAccount(balance=10**24)
+                             for a in ADDRS})
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    def gen(i, bg):
+        for j in range(txs_per_block):
+            k = (i * txs_per_block + j) % len(KEYS)
+            to = ADDRS[(k + 1) % len(KEYS)] if cross \
+                else bytes([0x40 + k]) * 20
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=21_000,
+                to=to, value=1000 + j,
+            ), KEYS[k], CFG.chain_id))
+            nonces[k] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, db, n_blocks, gen, gap=2)
+    return genesis, gblock, blocks
+
+
+def test_replay_disjoint_transfers():
+    genesis, gblock, blocks = build_transfer_chain(4, 16)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header, capacity=256, batch_pad=64)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].header.root
+    assert engine.stats.blocks_device == 4
+    assert engine.stats.blocks_fallback == 0
+    assert engine.stats.txs == 64
+
+
+def test_replay_cross_transfers_sender_is_recipient():
+    """Senders send to each other; engine must stay exact (solvency is
+    checked conservatively, these accounts are well funded)."""
+    genesis, gblock, blocks = build_transfer_chain(3, 8, cross=True)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header, capacity=256, batch_pad=64)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].header.root
+    assert engine.stats.blocks_device == 3
+
+
+def test_replay_fallback_on_contract_block():
+    """Blocks with contract txs route through the host processor and the
+    engine keeps going, bit-identically."""
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDRS[0]: GenesisAccount(balance=10**24)})
+    db = Database()
+    gblock = genesis.to_block(db)
+    runtime = bytes.fromhex("60003560005500")
+    init = b"\x66" + runtime + bytes.fromhex("60005260076019f3")
+
+    def gen(i, bg):
+        if i == 1:
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=i, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=200_000, to=None, value=0,
+                data=init), KEYS[0], CFG.chain_id))
+        else:
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=i, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000, to=b"\x77" * 20,
+                value=5), KEYS[0], CFG.chain_id))
+
+    blocks, _ = generate_chain(CFG, gblock, db, 3, gen, gap=2)
+    db2 = Database()
+    gb2 = genesis.to_block(db2)
+    engine = ReplayEngine(CFG, db2, gb2.root, parent_header=gb2.header, capacity=256, batch_pad=64)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].header.root
+    assert engine.stats.blocks_device == 2
+    assert engine.stats.blocks_fallback == 1
+
+
+def test_replay_matches_blockchain_insert():
+    """Replay and the canonical BlockChain.insert path land on identical
+    state (cross-engine parity)."""
+    genesis, gblock, blocks = build_transfer_chain(3, 10)
+    # path A: replay engine
+    db_a = Database()
+    gb_a = genesis.to_block(db_a)
+    engine = ReplayEngine(CFG, db_a, gb_a.root, parent_header=gb_a.header, capacity=256, batch_pad=64)
+    root_a = engine.replay(blocks)
+    # path B: full blockchain insert
+    chain = BlockChain(genesis)
+    chain.insert_chain(blocks)
+    assert root_a == chain.last_accepted.root
+
+
+def test_device_rehash_parity():
+    """device_rehash == host hash on a large dirty set."""
+    from coreth_tpu.mpt import SecureTrie
+    from coreth_tpu.mpt.rehash import device_rehash
+    t1 = SecureTrie()
+    t2 = SecureTrie()
+    for i in range(3000):
+        k = i.to_bytes(20, "big")
+        v = (b"\x01" + i.to_bytes(8, "big")) * 4
+        t1.update(k, v)
+        t2.update(k, v)
+    assert device_rehash(t1, min_batch=64) == t2.hash()
+    # incremental dirty batch
+    for i in range(500):
+        k = i.to_bytes(20, "big")
+        t1.update(k, b"\x99" * 40)
+        t2.update(k, b"\x99" * 40)
+    assert device_rehash(t1, min_batch=64) == t2.hash()

@@ -1,0 +1,130 @@
+"""TxPool + Miner: validation, promotion, replacement, block assembly."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.miner import Miner
+from coreth_tpu.params import TEST_CHAIN_CONFIG
+from coreth_tpu.txpool import TxPool
+from coreth_tpu.txpool.pool import (
+    ErrAlreadyKnown, ErrInsufficientFunds, ErrNonceTooLow,
+    ErrReplaceUnderpriced,
+)
+from coreth_tpu.types import DynamicFeeTx, LegacyTx, sign_tx
+
+CFG = TEST_CHAIN_CONFIG
+GWEI = 10**9
+KEY1 = 0xB71C71A67E1177AD4E901695E1B4B9EE17AE16C6668D313EAC2F96DBCDA3F291
+KEY2 = 0x8A1F9A8F95BE41CD7CCB6168179AFBD504D945964EB2CB4E8E0AE563BEDEFFF4
+A1 = priv_to_address(KEY1)
+A2 = priv_to_address(KEY2)
+
+
+def make_chain():
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={A1: GenesisAccount(balance=10**24),
+                             A2: GenesisAccount(balance=10**24)})
+    return BlockChain(genesis)
+
+
+def tx(key, nonce, tip=GWEI, cap=2000 * GWEI, to=b"\x42" * 20, value=1,
+       gas=21_000, data=b""):
+    return sign_tx(DynamicFeeTx(
+        chain_id_=CFG.chain_id, nonce=nonce, gas_tip_cap_=tip,
+        gas_fee_cap_=cap, gas=gas, to=to, value=value, data=data),
+        key, CFG.chain_id)
+
+
+def test_add_promote_pending():
+    pool = TxPool(CFG, make_chain())
+    pool.add_local(tx(KEY1, 0))
+    pool.add_local(tx(KEY1, 1))
+    assert pool.stats() == (2, 0)
+    assert pool.nonce(A1) == 2
+    pending = pool.pending_txs()
+    assert [t.nonce for t in pending[A1]] == [0, 1]
+
+
+def test_gapped_nonce_stays_queued():
+    pool = TxPool(CFG, make_chain())
+    pool.add_local(tx(KEY1, 2))
+    assert pool.stats() == (0, 1)
+    pool.add_local(tx(KEY1, 0))
+    pool.add_local(tx(KEY1, 1))
+    # the gap closed: all three executable
+    assert pool.stats() == (3, 0)
+
+
+def test_duplicate_and_replacement():
+    pool = TxPool(CFG, make_chain())
+    t0 = tx(KEY1, 0)
+    pool.add_local(t0)
+    with pytest.raises(ErrAlreadyKnown):
+        pool.add_local(t0)
+    # same-nonce with insufficient bump rejected
+    with pytest.raises(ErrReplaceUnderpriced):
+        pool.add_local(tx(KEY1, 0, tip=GWEI + 1))
+    # >=10% bump accepted
+    pool.add_local(tx(KEY1, 0, tip=2 * GWEI, cap=2200 * GWEI))
+    assert pool.stats() == (1, 0)
+
+
+def test_validation_failures():
+    pool = TxPool(CFG, make_chain())
+    poor = 0xDEAD01
+    with pytest.raises(ErrInsufficientFunds):
+        pool.add_local(tx(poor, 0))
+    chain = make_chain()
+    pool2 = TxPool(CFG, chain)
+    with pytest.raises(Exception):
+        pool2.add_local(tx(KEY1, 0, gas=20_000))  # below intrinsic
+
+
+def test_price_and_nonce_ordering():
+    pool = TxPool(CFG, make_chain())
+    pool.add_local(tx(KEY1, 0, tip=5 * GWEI))
+    pool.add_local(tx(KEY1, 1, tip=50 * GWEI))
+    pool.add_local(tx(KEY2, 0, tip=10 * GWEI))
+    ordered = pool.txs_by_price_and_nonce(base_fee=25 * GWEI)
+    # KEY2's 10-gwei head beats KEY1's 5-gwei head; KEY1's nonce order kept
+    senders = [pool.signer.sender(t) for t in ordered]
+    assert senders[0] == A2
+    assert [t.nonce for t in ordered if pool.signer.sender(t) == A1] == [0, 1]
+
+
+def test_miner_assembles_and_chain_accepts():
+    chain = make_chain()
+    pool = TxPool(CFG, chain)
+    for i in range(5):
+        pool.add_local(tx(KEY1, i, value=100 + i))
+    miner = Miner(CFG, chain, pool,
+                  clock=lambda: chain.current_block().time + 10)
+    block = miner.generate_block()
+    assert len(block.transactions) == 5
+    # the assembled block must insert + accept cleanly (full validation)
+    chain.insert_block(block)
+    chain.accept(block.hash())
+    state = chain.state_at(block.root)
+    assert state.get_balance(b"\x42" * 20) == sum(100 + i for i in range(5))
+    # pool reset drops mined txs
+    pool.reset()
+    assert pool.stats() == (0, 0)
+
+
+def test_miner_respects_base_fee():
+    chain = make_chain()
+    pool = TxPool(CFG, chain)
+    # fee cap below the initial base fee: excluded from the block
+    pool.add_local(tx(KEY1, 0, cap=30 * GWEI, tip=GWEI))
+    pool.add_local(tx(KEY2, 0))
+    miner = Miner(CFG, chain, pool,
+                  clock=lambda: chain.current_block().time + 10)
+    block = miner.generate_block()
+    senders = {pool.signer.sender(t) for t in block.transactions}
+    assert A2 in senders and A1 not in senders
