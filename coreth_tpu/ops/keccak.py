@@ -67,68 +67,88 @@ for _s, _d in enumerate(_PI_DEST):
     _PI_SRC[_d] = _s
 
 
-def _rotl64(lo, hi, r: int):
-    """Rotate a (lo, hi) uint32 pair left by static r."""
-    r &= 63
-    if r == 0:
-        return lo, hi
-    if r == 32:
-        return hi, lo
-    if r < 32:
-        nlo = (lo << r) | (hi >> (32 - r))
-        nhi = (hi << r) | (lo >> (32 - r))
-        return nlo, nhi
-    r -= 32
-    nlo = (hi << r) | (lo >> (32 - r))
-    nhi = (lo << r) | (hi >> (32 - r))
+# Static per-lane rho/pi vectors (numpy, baked into the graph as constants)
+_RHO_ARR = np.array(_RHO, dtype=np.int64)
+_PI_SRC_ARR = np.array(_PI_SRC, dtype=np.int32)
+_MOVED_RHO = _RHO_ARR[_PI_SRC_ARR]          # rotation of each dest lane
+_RC_LO = np.array([rc & 0xFFFFFFFF for rc in _RC], dtype=np.uint32)
+_RC_HI = np.array([rc >> 32 for rc in _RC], dtype=np.uint32)
+
+
+def _rotl_lanes(lo, hi, r: np.ndarray):
+    """Rotate (lo, hi) uint32 lane-pair arrays left by static per-lane
+    amounts r (numpy vector broadcast over the trailing lane axis).
+
+    A 64-bit rotate by r over (lo, hi) = a conditional word swap
+    (r >= 32) followed by a sub-word rotate by r % 32; all masks and
+    shift counts are trace-time constants, so this lowers to a handful
+    of elementwise VPU ops regardless of lane count."""
+    r = r % 64
+    swap = jnp.asarray(r >= 32)
+    rr = (r % 32).astype(np.uint32)
+    sh = jnp.asarray(rr)
+    inv = jnp.asarray(np.where(rr == 0, 1, 32 - rr).astype(np.uint32))
+    zero = jnp.asarray(rr == 0)
+    l1 = jnp.where(swap, hi, lo)
+    h1 = jnp.where(swap, lo, hi)
+    nlo = jnp.where(zero, l1, (l1 << sh) | (h1 >> inv))
+    nhi = jnp.where(zero, h1, (h1 << sh) | (l1 >> inv))
     return nlo, nhi
+
+
+def _round(lo, hi, rc_lo, rc_hi):
+    """One keccak-f[1600] round over (..., 25) uint32 lane-pair arrays."""
+    # theta: column parity C[x] = xor over y of lane[x + 5y]
+    vlo = lo.reshape(lo.shape[:-1] + (5, 5))    # [..., y, x]
+    vhi = hi.reshape(hi.shape[:-1] + (5, 5))
+    c_lo = vlo[..., 0, :] ^ vlo[..., 1, :] ^ vlo[..., 2, :] \
+        ^ vlo[..., 3, :] ^ vlo[..., 4, :]
+    c_hi = vhi[..., 0, :] ^ vhi[..., 1, :] ^ vhi[..., 2, :] \
+        ^ vhi[..., 3, :] ^ vhi[..., 4, :]
+    r1_lo, r1_hi = _rotl_lanes(jnp.roll(c_lo, -1, axis=-1),
+                               jnp.roll(c_hi, -1, axis=-1),
+                               np.array([1] * 5))
+    d_lo = jnp.roll(c_lo, 1, axis=-1) ^ r1_lo
+    d_hi = jnp.roll(c_hi, 1, axis=-1) ^ r1_hi
+    lo = (vlo ^ d_lo[..., None, :]).reshape(lo.shape)
+    hi = (vhi ^ d_hi[..., None, :]).reshape(hi.shape)
+    # rho + pi: moved[d] = rotl(lane[pi_src[d]], rho[pi_src[d]])
+    lo, hi = _rotl_lanes(lo[..., _PI_SRC_ARR], hi[..., _PI_SRC_ARR],
+                         _MOVED_RHO)
+    # chi: a ^ (~a[x+1] & a[x+2]) along x
+    vlo = lo.reshape(lo.shape[:-1] + (5, 5))
+    vhi = hi.reshape(hi.shape[:-1] + (5, 5))
+    a1_lo = jnp.roll(vlo, -1, axis=-1)
+    a1_hi = jnp.roll(vhi, -1, axis=-1)
+    a2_lo = jnp.roll(vlo, -2, axis=-1)
+    a2_hi = jnp.roll(vhi, -2, axis=-1)
+    lo = (vlo ^ (~a1_lo & a2_lo)).reshape(lo.shape)
+    hi = (vhi ^ (~a1_hi & a2_hi)).reshape(hi.shape)
+    # iota
+    lo = lo.at[..., 0].set(lo[..., 0] ^ rc_lo)
+    hi = hi.at[..., 0].set(hi[..., 0] ^ rc_hi)
+    return lo, hi
 
 
 def keccak_f1600(state):
     """Apply the keccak-f[1600] permutation.
 
-    state: uint32 array (..., 25, 2); returns the same shape.
-    Rounds are unrolled; all control flow is static.
-    """
-    lanes = [(state[..., i, 0], state[..., i, 1]) for i in range(25)]
-    for rnd in range(24):
-        # theta
-        C = []
-        for xx in range(5):
-            clo = lanes[xx][0]
-            chi = lanes[xx][1]
-            for yy in range(1, 5):
-                clo = clo ^ lanes[xx + 5 * yy][0]
-                chi = chi ^ lanes[xx + 5 * yy][1]
-            C.append((clo, chi))
-        for xx in range(5):
-            rl, rh = _rotl64(*C[(xx + 1) % 5], 1)
-            dlo = C[(xx + 4) % 5][0] ^ rl
-            dhi = C[(xx + 4) % 5][1] ^ rh
-            for yy in range(5):
-                i = xx + 5 * yy
-                lanes[i] = (lanes[i][0] ^ dlo, lanes[i][1] ^ dhi)
-        # rho + pi
-        moved = [None] * 25
-        for d in range(25):
-            s = _PI_SRC[d]
-            moved[d] = _rotl64(lanes[s][0], lanes[s][1], _RHO[s])
-        # chi
-        new = [None] * 25
-        for yy in range(5):
-            for xx in range(5):
-                i = xx + 5 * yy
-                a1 = moved[(xx + 1) % 5 + 5 * yy]
-                a2 = moved[(xx + 2) % 5 + 5 * yy]
-                new[i] = (moved[i][0] ^ (~a1[0] & a2[0]),
-                          moved[i][1] ^ (~a1[1] & a2[1]))
-        lanes = new
-        # iota
-        rc = _RC[rnd]
-        lanes[0] = (lanes[0][0] ^ np.uint32(rc & 0xFFFFFFFF),
-                    lanes[0][1] ^ np.uint32(rc >> 32))
-    return jnp.stack(
-        [jnp.stack([lo, hi], axis=-1) for lo, hi in lanes], axis=-2)
+    state: uint32 array (..., 25, 2); returns the same shape.  The 24
+    rounds run under lax.fori_loop with the round constants indexed from
+    a baked array — the graph is one round body, so CPU compile stays in
+    seconds (round 1 unrolled 24 rounds x 25 scalar lanes and took ~10
+    minutes to compile; VERDICT.md weak#4)."""
+    lo = state[..., 0]
+    hi = state[..., 1]
+    rc_lo = jnp.asarray(_RC_LO)
+    rc_hi = jnp.asarray(_RC_HI)
+
+    def body(rnd, carry):
+        lo, hi = carry
+        return _round(lo, hi, rc_lo[rnd], rc_hi[rnd])
+
+    lo, hi = jax.lax.fori_loop(0, 24, body, (lo, hi))
+    return jnp.stack([lo, hi], axis=-1)
 
 
 _RATE_WORDS = 34  # 136 bytes / 4

@@ -12,7 +12,6 @@ buyGas/refundGas, here batched).
 from __future__ import annotations
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 LIMBS = 16
@@ -67,38 +66,35 @@ def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a - b mod 2^256 (caller checks a >= b via gte)."""
-    # borrow-propagate: add 2^16 to each limb, subtract borrow chain
+    """a - b mod 2^256 (caller checks a >= b via gte).
+
+    The 16-limb borrow chain is unrolled at trace time (no lax.scan:
+    scans over carries interact badly with shard_map's varying-axis
+    typing, and 16 fixed steps fuse fine)."""
     diff = a - b
-
-    def body(carry, limb):
-        limb = limb - carry
+    limbs = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.int32)
+    for i in range(LIMBS):
+        limb = diff[..., i] - borrow
         borrow = (limb < 0).astype(jnp.int32)
-        return borrow, limb + (borrow << LIMB_BITS)
-
-    _, limbs = jax.lax.scan(body, jnp.zeros(a.shape[:-1], dtype=jnp.int32),
-                            jnp.moveaxis(diff, -1, 0))
-    return jnp.moveaxis(limbs, 0, -1)
+        limbs.append(limb + (borrow << LIMB_BITS))
+    return jnp.stack(limbs, axis=-1)
 
 
 def gte(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a >= b elementwise over the last axis (both normalized)."""
-    # lexicographic from the most-significant limb
-    def body(state, limbs):
-        decided, result = state
-        a_l, b_l = limbs
+    """a >= b elementwise over the last axis (both normalized).
+
+    Lexicographic compare from the most-significant limb, unrolled at
+    trace time (see sub() for why no lax.scan)."""
+    decided = jnp.zeros(a.shape[:-1], dtype=bool)
+    result = jnp.ones(a.shape[:-1], dtype=bool)  # equal => True
+    for i in range(LIMBS - 1, -1, -1):
+        a_l, b_l = a[..., i], b[..., i]
         gt = a_l > b_l
         lt = a_l < b_l
         result = jnp.where(~decided & gt, True, result)
         result = jnp.where(~decided & lt, False, result)
         decided = decided | gt | lt
-        return (decided, result), None
-
-    init = (jnp.zeros(a.shape[:-1], dtype=bool),
-            jnp.ones(a.shape[:-1], dtype=bool))  # equal => True
-    (decided, result), _ = jax.lax.scan(
-        body, init,
-        (jnp.moveaxis(a, -1, 0)[::-1], jnp.moveaxis(b, -1, 0)[::-1]))
     return result
 
 

@@ -154,6 +154,50 @@ def test_replay_matches_blockchain_insert():
     assert root_a == chain.last_accepted.root
 
 
+def test_replay_windows_multiple_blocks_per_device_call(monkeypatch):
+    """Regression for VERDICT.md weak#2: replay() must batch consecutive
+    device-replayable blocks into ONE device call (the lax.scan window),
+    not issue per-block round trips."""
+    from coreth_tpu.replay import engine as engine_mod
+    genesis, gblock, blocks = build_transfer_chain(6, 8)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64, window=8)
+    calls = []
+    orig = engine._issue_window
+
+    def spy(items):
+        calls.append(len(items))
+        return orig(items)
+
+    monkeypatch.setattr(engine, "_issue_window", spy)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].header.root
+    assert engine.stats.blocks_device == 6
+    # all six consecutive transfer blocks must ride one window
+    assert calls == [6], calls
+
+
+def test_prepare_window_pads_to_pow2_not_full_window():
+    """A 1-block window must not pad out to `window` scan slots
+    (VERDICT.md weak#2: 16-slot scans for single blocks)."""
+    genesis, gblock, blocks = build_transfer_chain(3, 8)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64, window=16)
+    engine.warm_senders(blocks[0])
+    batch = engine._classify(blocks[0])
+    txds, t_idxs, _ = engine._prepare_window([(blocks[0], batch)])
+    assert txds.shape[0] == 1
+    txds2, _, _ = engine._prepare_window(
+        [(blocks[0], batch),
+         (blocks[1], engine._classify(blocks[1])),
+         (blocks[2], engine._classify(blocks[2]))])
+    assert txds2.shape[0] == 4  # 3 blocks -> pow2 bucket of 4
+
+
 def test_device_rehash_parity():
     """device_rehash == host hash on a large dirty set."""
     from coreth_tpu.mpt import SecureTrie
