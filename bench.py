@@ -107,17 +107,33 @@ def run_baseline(genesis, wire_blocks):
     return txs / dt, chain.timers.row()
 
 
-def run_tpu(genesis, wire_blocks):
+def _fresh_engine(genesis, txs_per_block):
     from coreth_tpu.replay import ReplayEngine
     from coreth_tpu.state import Database
-    from coreth_tpu.types import Block
-    blocks = [Block.decode(w) for w in wire_blocks]
     db = Database()
     gblock = genesis.to_block(db)
-    engine = ReplayEngine(genesis.config, db, gblock.root,
-                          parent_header=gblock.header,
-                          batch_pad=TXS_PER_BLOCK)
-    # warm-up: first block pays jit compile; excluded from timing
+    return ReplayEngine(genesis.config, db, gblock.root,
+                        parent_header=gblock.header,
+                        batch_pad=txs_per_block)
+
+
+def run_tpu(genesis, wire_blocks):
+    from coreth_tpu.types import Block
+
+    # Warm-up pass on throwaway blocks/engine: compiles (or cache-loads)
+    # every device executable this workload shape needs — the recover
+    # kernel bucket, the window scan buckets, the rehash kernel.  XLA
+    # compile/load is a per-process one-time cost, excluded from timing
+    # exactly like the first-block warm-up the round-1 bench did.
+    warm_blocks = [Block.decode(w) for w in wire_blocks]
+    warm = _fresh_engine(genesis, TXS_PER_BLOCK)
+    warm.replay_block(warm_blocks[0])
+    warm.replay(warm_blocks[1:])
+    assert warm.root == warm_blocks[-1].header.root
+
+    # Timed pass: fresh Block objects (no cached senders), fresh state.
+    blocks = [Block.decode(w) for w in wire_blocks]
+    engine = _fresh_engine(genesis, TXS_PER_BLOCK)
     engine.replay_block(blocks[0])
     t0 = time.monotonic()
     engine.replay(blocks[1:])

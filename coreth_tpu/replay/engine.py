@@ -50,6 +50,20 @@ class ReplayError(Exception):
     pass
 
 
+def _has_accelerator() -> bool:
+    """True when a non-CPU jax backend is live — the device ECDSA kernel
+    on XLA-CPU is slower than the native C++ batch, so only real chips
+    take that path (CORETH_RECOVER_FORCE_DEVICE=1 overrides for tests)."""
+    import os
+    if os.environ.get("CORETH_RECOVER_FORCE_DEVICE"):
+        return True
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def secp_half_n() -> int:
     from coreth_tpu.crypto.secp256k1 import N
     return N // 2
@@ -273,37 +287,65 @@ class ReplayEngine:
         return self.state.ensure(addr, account)
 
     # -------------------------------------------------------------- senders
-    def warm_senders(self, block: Block) -> None:
-        """Batched sender recovery (reference core/sender_cacher.go role,
-        via the native C++ batch instead of goroutines)."""
+    # Below this batch size the device round trip (~0.3s of tunnel
+    # latency) loses to the native C++ loop at ~0.3ms/signature.
+    DEVICE_RECOVER_MIN = int(
+        __import__("os").environ.get("CORETH_RECOVER_MIN_BATCH", "1024"))
+
+    def warm_senders(self, blocks) -> None:
+        """Batched sender recovery across a whole run of blocks
+        (reference core/sender_cacher.go role).  Large batches go to the
+        device ECDSA kernel (crypto/secp_device — one Shamir-ladder call
+        for every signature in the window); small ones to the native C++
+        batch.  Accepts a single block or a list."""
+        if isinstance(blocks, Block):
+            blocks = [blocks]
         t0 = time.monotonic()
-        todo = [tx for tx in block.transactions
-                if tx.cached_sender() is None]
-        if todo:
+        candidates = [tx for b in blocks for tx in b.transactions
+                      if tx.cached_sender() is None]
+        if not candidates:
+            return
+        # pack per-tx so one malformed signature (oversized v/r/s, foreign
+        # chain id) skips that tx instead of aborting the whole batch
+        todo, hashes, rs, ss, recids = [], [], [], [], []
+        for tx in candidates:
             try:
+                r, s, recid = tx.inner.raw_signature()
+                h = self.signer.sig_hash(tx)
+                rs.append(r.to_bytes(32, "big"))
+                ss.append(s.to_bytes(32, "big"))
+                recids.append(recid if 0 <= recid <= 3 else 255)
+                hashes.append(h)
+                todo.append(tx)
+            except Exception:  # noqa: BLE001 — per-tx python path later
+                continue
+        if not todo:
+            self.stats.t_sender += time.monotonic() - t0
+            return
+        try:
+            packed = (b"".join(hashes), b"".join(rs), b"".join(ss),
+                      bytes(recids))
+            out = ok = None
+            if len(todo) >= self.DEVICE_RECOVER_MIN and _has_accelerator():
+                from coreth_tpu.crypto.secp_device import \
+                    recover_addresses_device
+                out, ok = recover_addresses_device(*packed)
+            else:
                 from coreth_tpu.crypto import native
                 if native.load() is not None:
-                    hashes, rs, ss, recids = [], [], [], []
-                    for tx in todo:
+                    out, ok = native.recover_addresses_batch(*packed)
+            if out is not None:
+                for i, tx in enumerate(todo):
+                    if ok[i]:
+                        # signer.sender re-validates chain id + low-s
+                        # before trusting the cache; prime it only
                         r, s, recid = tx.inner.raw_signature()
-                        hashes.append(self.signer.sig_hash(tx))
-                        rs.append(r.to_bytes(32, "big"))
-                        ss.append(s.to_bytes(32, "big"))
-                        recids.append(recid)
-                    out, ok = native.recover_addresses_batch(
-                        b"".join(hashes), b"".join(rs), b"".join(ss),
-                        bytes(recids))
-                    for i, tx in enumerate(todo):
-                        if ok[i]:
-                            # signer.sender re-validates chain id + low-s
-                            # before trusting the cache; prime it only
-                            r, s, recid = tx.inner.raw_signature()
-                            if recid in (0, 1) and \
-                                    0 < s <= secp_half_n():
-                                tx.set_sender(out[i * 20:(i + 1) * 20])
-            except Exception:  # noqa: BLE001 — fall back to per-tx path
-                pass
-        self.stats.t_sender += time.monotonic() - t0
+                        if recid in (0, 1) and 0 < s <= secp_half_n():
+                            tx.set_sender(out[i * 20:(i + 1) * 20])
+        except Exception:  # noqa: BLE001 — fall back to per-tx path
+            pass
+        finally:
+            self.stats.t_sender += time.monotonic() - t0
 
     # ------------------------------------------------------------- classify
     def _classify(self, block: Block) -> Optional[dict]:
@@ -497,6 +539,9 @@ class ReplayEngine:
         n = len(blocks)
         run: List[Tuple[Block, dict]] = []
         run_start = 0
+        # one batched recovery for every signature in the input — the
+        # whole-replay analog of sender_cacher warming blocks ahead
+        self.warm_senders(blocks)
 
         def flush() -> Optional[int]:
             nonlocal run
@@ -509,7 +554,6 @@ class ReplayEngine:
 
         while i < n:
             block = blocks[i]
-            self.warm_senders(block)
             t0 = time.monotonic()
             batch = self._classify(block)
             self.stats.t_classify += time.monotonic() - t0
