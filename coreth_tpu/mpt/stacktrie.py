@@ -1,26 +1,123 @@
-"""StackTrie — ordered-insert trie builder.
+"""StackTrie — streaming ordered-insert trie builder (hash-and-drop).
 
-Role twin of reference trie/stacktrie.go (used for tx/receipt roots via
-DeriveSha, core/types/hashing.go:97, and for state-sync range rebuilds).
-This implementation reuses the structural engine from :mod:`mpt.trie`; the
-streaming early-hash optimization (hash-and-drop finished subtries) is a
-follow-up — correctness and the API contract come first.
+Behavioral twin of reference trie/stacktrie.go (544 LoC): keys MUST
+arrive in strictly increasing nibble order; whenever an insert diverges
+left of the in-progress path, the completed left sibling subtree is
+immediately collapsed to its 32-byte reference and dropped.  Memory
+stays O(depth) and every node is RLP-encoded and hashed exactly once —
+unlike the general mpt.Trie, which keeps the whole structure resident.
+
+Used for tx/receipt roots (types/hashing.py derive_sha, reference
+core/types/hashing.go:97) and for state-sync range rebuilds (reference
+sync/statesync rebuilding leaf ranges through a StackTrie).
+
+Node model (mutable lists):
+  ["L", nibbles, value]     in-progress leaf
+  ["E", nibbles, child]     in-progress extension
+  ["B", [child x 16]]       in-progress branch (no branch values: all
+                            caller key sets are prefix-free — RLP index
+                            keys and fixed-width hashed keys)
+  ["H", ref]                collapsed subtree: 32-byte hash, or the
+                            decoded RLP structure when len(rlp) < 32
 """
 
 from __future__ import annotations
 
-from coreth_tpu.mpt.trie import Trie
+from coreth_tpu import rlp
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.mpt.trie import (
+    EMPTY_ROOT, _common_prefix_len, hex_prefix, key_to_nibbles,
+)
 
 
 class StackTrie:
+    __slots__ = ("_root",)
+
     def __init__(self):
-        self._trie = Trie()
-
-    def update(self, key: bytes, value: bytes) -> None:
-        self._trie.update(key, value)
-
-    def hash(self) -> bytes:
-        return self._trie.hash()
+        self._root = None
 
     def reset(self) -> None:
-        self._trie = Trie()
+        self._root = None
+
+    # ------------------------------------------------------------- insert
+    def update(self, key: bytes, value: bytes) -> None:
+        """Insert; keys must arrive in strictly increasing order."""
+        if not value:
+            raise ValueError("stacktrie does not support empty values")
+        self._root = self._insert(self._root, key_to_nibbles(key), value)
+
+    def _insert(self, n, key, value):
+        if n is None:
+            return ["L", key, value]
+        kind = n[0]
+        if kind == "H":
+            raise ValueError("key out of order: subtree already hashed")
+        if kind == "B":
+            idx = key[0]
+            last = max(i for i in range(16) if n[1][i] is not None)
+            if idx == last:
+                n[1][idx] = self._insert(n[1][idx], key[1:], value)
+            elif idx > last:
+                n[1][last] = ["H", self._collapse(n[1][last])]
+                n[1][idx] = ["L", key[1:], value]
+            else:
+                raise ValueError("key out of order")
+            return n
+        if kind == "E":
+            cp = _common_prefix_len(n[1], key)
+            if cp == len(n[1]):
+                n[2] = self._insert(n[2], key[cp:], value)
+                return n
+            return self._split(n[1], key, cp, value, ext_child=n[2])
+        # LEAF
+        cp = _common_prefix_len(n[1], key)
+        if cp == len(n[1]) and cp == len(key):
+            raise ValueError("duplicate key")
+        return self._split(n[1], key, cp, value, leaf_value=n[2])
+
+    def _split(self, old_nibs, key, cp, value, ext_child=None,
+               leaf_value=None):
+        """Divergence at depth cp: collapse the completed old subtree
+        into a branch slot, start a new leaf to its right."""
+        old_idx = old_nibs[cp]
+        new_idx = key[cp]
+        if new_idx <= old_idx:
+            raise ValueError("key out of order")
+        if ext_child is not None:
+            old_sub = (ext_child if cp == len(old_nibs) - 1
+                       else ["E", old_nibs[cp + 1:], ext_child])
+        else:
+            old_sub = ["L", old_nibs[cp + 1:], leaf_value]
+        children = [None] * 16
+        children[old_idx] = ["H", self._collapse(old_sub)]
+        children[new_idx] = ["L", key[cp + 1:], value]
+        branch = ["B", children]
+        if cp > 0:
+            return ["E", key[:cp], branch]
+        return branch
+
+    # --------------------------------------------------------------- hash
+    def _encode(self, n) -> bytes:
+        kind = n[0]
+        if kind == "L":
+            return rlp.encode([hex_prefix(n[1], True), n[2]])
+        if kind == "E":
+            return rlp.encode([hex_prefix(n[1], False),
+                               self._collapse(n[2])])
+        items = [self._collapse(c) if c is not None else b""
+                 for c in n[1]]
+        items.append(b"")
+        return rlp.encode(items)
+
+    def _collapse(self, n):
+        """Parent-embedded reference: hash if the encoding is >= 32
+        bytes, else the decoded structure inline."""
+        if n[0] == "H":
+            return n[1]
+        enc = self._encode(n)
+        return keccak256(enc) if len(enc) >= 32 else rlp.decode(enc)
+
+    def hash(self) -> bytes:
+        if self._root is None:
+            return EMPTY_ROOT
+        return keccak256(self._encode(self._root))

@@ -13,11 +13,24 @@ from coreth_tpu import rlp
 from coreth_tpu.mpt import StackTrie
 
 
+def _encode_item(item) -> bytes:
+    return (item.encode_consensus() if hasattr(item, "encode_consensus")
+            else item.encode())
+
+
 def derive_sha(items: Sequence) -> bytes:
-    """Root over items exposing ``.encode()`` or ``.encode_consensus()``."""
+    """Root over items exposing ``.encode()`` or ``.encode_consensus()``.
+
+    Inserts in ascending RLP-key order — rlp(1..0x7f) sort below
+    rlp(0) = 0x80 which sorts below rlp(0x80...) — so the streaming
+    StackTrie sees strictly increasing keys (the same iteration trick
+    as reference core/types/hashing.go:87-110)."""
     trie = StackTrie()
-    for i, item in enumerate(items):
-        enc = (item.encode_consensus() if hasattr(item, "encode_consensus")
-               else item.encode())
-        trie.update(rlp.encode(rlp.encode_uint(i)), enc)
+    n = len(items)
+    for i in range(1, min(n, 0x80)):
+        trie.update(rlp.encode(rlp.encode_uint(i)), _encode_item(items[i]))
+    if n > 0:
+        trie.update(rlp.encode(rlp.encode_uint(0)), _encode_item(items[0]))
+    for i in range(0x80, n):
+        trie.update(rlp.encode(rlp.encode_uint(i)), _encode_item(items[i]))
     return trie.hash()

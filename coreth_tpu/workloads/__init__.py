@@ -1,0 +1,5 @@
+from coreth_tpu.workloads.erc20 import (  # noqa: F401
+    TOKEN_RUNTIME, TOKEN_CODE_HASH, TRANSFER_SELECTOR, TRANSFER_TOPIC,
+    balance_slot, transfer_calldata, parse_transfer_calldata,
+    token_genesis_account, measure_transfer_exec_gas, intrinsic_gas,
+)

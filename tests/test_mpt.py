@@ -179,3 +179,82 @@ def test_random_parity_with_model():
             assert t.hash() == fresh.hash()
     for mk, mv in model.items():
         assert t.get(mk) == mv
+
+
+# ------------------------------------------------------------- stacktrie
+
+def test_stacktrie_matches_trie_sorted_random():
+    """Streaming ordered inserts land on the generic trie's root."""
+    import random
+    from coreth_tpu.mpt import StackTrie
+    from coreth_tpu.mpt.trie import Trie
+    rng = random.Random(11)
+    keys = sorted({rng.randrange(2**64).to_bytes(8, "big")
+                   for _ in range(500)})
+    st = StackTrie()
+    t = Trie()
+    for k in keys:
+        v = (b"\x42" + k) * 3
+        st.update(k, v)
+        t.update(k, v)
+    assert st.hash() == t.hash()
+
+
+def test_stacktrie_variable_length_prefix_free_keys():
+    from coreth_tpu import rlp
+    from coreth_tpu.mpt import StackTrie
+    from coreth_tpu.mpt.trie import Trie
+    # RLP uint encodings are prefix-free and these sort ascending
+    keys = [rlp.encode(rlp.encode_uint(i)) for i in range(1, 0x80)]
+    keys += [rlp.encode(rlp.encode_uint(0))]
+    keys += [rlp.encode(rlp.encode_uint(i)) for i in range(0x80, 300)]
+    st = StackTrie()
+    t = Trie()
+    for k in keys:
+        st.update(k, b"v" * 40 + k)
+        t.update(k, b"v" * 40 + k)
+    assert st.hash() == t.hash()
+
+
+def test_stacktrie_rejects_out_of_order_and_empty():
+    import pytest
+    from coreth_tpu.mpt import StackTrie
+    st = StackTrie()
+    st.update(b"\x05", b"x")
+    with pytest.raises(ValueError):
+        st.update(b"\x03", b"y")
+    with pytest.raises(ValueError):
+        st.update(b"\x09", b"")
+
+
+def test_stacktrie_empty_and_single():
+    from coreth_tpu.mpt import StackTrie
+    from coreth_tpu.mpt.trie import Trie, EMPTY_ROOT
+    assert StackTrie().hash() == EMPTY_ROOT
+    st = StackTrie()
+    t = Trie()
+    st.update(b"\x80", b"only")
+    t.update(b"\x80", b"only")
+    assert st.hash() == t.hash()
+
+
+def test_derive_sha_sizes_cross_engine():
+    """derive_sha (StackTrie, reordered inserts) == naive Trie build
+    across the 0x7f/0x80 index-ordering boundary."""
+    from coreth_tpu import rlp as R
+    from coreth_tpu.mpt.trie import Trie
+    from coreth_tpu.types import derive_sha
+
+    class Item:
+        def __init__(self, i):
+            self.i = i
+
+        def encode(self):
+            return b"item-" + self.i.to_bytes(4, "big") + b"\xaa" * 40
+
+    for n in (0, 1, 2, 127, 128, 129, 300):
+        items = [Item(i) for i in range(n)]
+        t = Trie()
+        for i, it in enumerate(items):
+            t.update(R.encode(R.encode_uint(i)), it.encode())
+        assert derive_sha(items) == t.hash(), n
