@@ -113,29 +113,36 @@ class EVM:
 
     def _execute(self, p, caller: bytes, storage_addr: bytes,
                  code_addr: bytes, input_: bytes, gas: int, value: int,
-                 read_only: bool, snapshot: int
+                 read_only: bool, snapshot: int, op: int = 0xF1
                  ) -> Tuple[bytes, int, Optional[Exception]]:
         """Shared tail of the four call variants: run precompile or code,
         map errors to geth's (ret, gas, err) contract."""
+        tracer = self.config.tracer
+        if tracer is not None and self.depth > 0:
+            tracer.capture_enter(op, caller, code_addr, input_, gas, value)
         frame = None
         try:
             if p is not None:
                 ret, gas_left = self._run_precompile(
                     p, caller, code_addr, input_, gas, read_only)
-                return ret, gas_left, None
-            code = self.statedb.get_code(code_addr)
-            frame = Frame(caller, storage_addr, code, input_, gas, value,
-                          self.statedb.get_code_hash(code_addr))
-            ret = self.interpreter.run(frame, read_only)
-            return ret, frame.gas, None
+                out = (ret, gas_left, None)
+            else:
+                code = self.statedb.get_code(code_addr)
+                frame = Frame(caller, storage_addr, code, input_, gas,
+                              value, self.statedb.get_code_hash(code_addr))
+                ret = self.interpreter.run(frame, read_only)
+                out = (ret, frame.gas, None)
         except vmerrs.ErrExecutionReverted as e:
             self.statedb.revert_to_snapshot(snapshot)
             gas_left = frame.gas if frame is not None \
                 else getattr(e, "gas_left", 0)
-            return getattr(e, "data", b""), gas_left, e
+            out = (getattr(e, "data", b""), gas_left, e)
         except vmerrs.VMError as e:
             self.statedb.revert_to_snapshot(snapshot)
-            return b"", 0, e
+            out = (b"", 0, e)
+        if tracer is not None and self.depth > 0:
+            tracer.capture_exit(out[0], gas - out[1], out[2])
+        return out
 
     def call(self, caller: bytes, addr: bytes, input_: bytes, gas: int,
              value: int) -> Tuple[bytes, int, Optional[Exception]]:
@@ -146,11 +153,24 @@ class EVM:
             return b"", gas, vmerrs.ErrInsufficientBalance()
         snapshot = self.statedb.snapshot()
         p = self.precompile(addr)
+        tracer = self.config.tracer
         if not self.statedb.exist(addr):
             if p is None and self.rules.is_eip158 and value == 0:
-                return b"", gas, None  # touch-free no-op (evm.go:285)
+                # touch-free no-op (evm.go:285) — still traced
+                if tracer is not None and self.depth == 0:
+                    tracer.capture_start(self, caller, addr, False, input_,
+                                         gas, value)
+                    tracer.capture_end(b"", 0, None)
+                return b"", gas, None
             self.statedb.create_account(addr)
         self.transfer(caller, addr, value)
+        if tracer is not None and self.depth == 0:
+            tracer.capture_start(self, caller, addr, False, input_, gas,
+                                 value)
+            ret, gas_left, err = self._execute(
+                p, caller, addr, addr, input_, gas, value, False, snapshot)
+            tracer.capture_end(ret, gas - gas_left, err)
+            return ret, gas_left, err
         return self._execute(p, caller, addr, addr, input_, gas, value,
                              False, snapshot)
 
@@ -164,7 +184,7 @@ class EVM:
         snapshot = self.statedb.snapshot()
         p = self.precompile(addr)
         return self._execute(p, caller, caller, addr, input_, gas, value,
-                             False, snapshot)
+                             False, snapshot, op=0xF2)
 
     def delegate_call(self, parent: Frame, addr: bytes, input_: bytes,
                       gas: int) -> Tuple[bytes, int, Optional[Exception]]:
@@ -174,7 +194,7 @@ class EVM:
         snapshot = self.statedb.snapshot()
         p = self.precompile(addr)
         return self._execute(p, parent.caller, parent.address, addr, input_,
-                             gas, parent.value, False, snapshot)
+                             gas, parent.value, False, snapshot, op=0xF4)
 
     def static_call(self, caller: bytes, addr: bytes, input_: bytes,
                     gas: int) -> Tuple[bytes, int, Optional[Exception]]:
@@ -186,7 +206,7 @@ class EVM:
         self.statedb.add_balance(addr, 0)
         p = self.precompile(addr)
         return self._execute(p, caller, addr, addr, input_, gas, 0, True,
-                             snapshot)
+                             snapshot, op=0xFA)
 
     # --------------------------------------------------------------- create
     def create_address(self, caller: bytes, nonce: int) -> bytes:
@@ -237,6 +257,11 @@ class EVM:
             self.statedb.set_nonce(addr, 1)
         self.transfer(caller, addr, value)
         frame = Frame(caller, addr, init_code, b"", gas, value)
+        tracer = self.config.tracer
+        if tracer is not None and self.depth == 0:
+            tracer.capture_start(self, caller, addr, True, init_code, gas,
+                                 value)
+        ret_err: Tuple[bytes, bytes, int, Optional[Exception]]
         try:
             ret = self.interpreter.run(frame, read_only=False)
             if self.rules.is_apricot_phase3 and ret[:1] == b"\xEF":
@@ -248,13 +273,16 @@ class EVM:
                 raise vmerrs.ErrCodeStoreOutOfGas()
             frame.use_gas(deposit_gas)
             self.statedb.set_code(addr, ret)
-            return ret, addr, frame.gas, None
+            ret_err = (ret, addr, frame.gas, None)
         except vmerrs.ErrExecutionReverted as e:
             self.statedb.revert_to_snapshot(snapshot)
-            return getattr(e, "data", b""), addr, frame.gas, e
+            ret_err = (getattr(e, "data", b""), addr, frame.gas, e)
         except vmerrs.VMError as e:
             self.statedb.revert_to_snapshot(snapshot)
-            return b"", addr, 0, e
+            ret_err = (b"", addr, 0, e)
+        if tracer is not None and self.depth == 0:
+            tracer.capture_end(ret_err[0], gas - ret_err[2], ret_err[3])
+        return ret_err
 
     # ------------------------------------------------- native asset (ANT)
     def native_asset_call(self, caller: bytes, input_: bytes, gas: int,

@@ -147,34 +147,51 @@ class Interpreter:
             pc = 0
             code = frame.code
             table = self.table
+            # debug branch of the hot loop (interpreter.go:186-258):
+            # per-op CaptureState/CaptureFault when a tracer is attached
+            tracer = evm.config.tracer
             while True:
                 if pc >= len(code):
                     raise Halt()
                 op = code[pc]
                 operation = table[op]
-                if operation is None:
-                    raise vmerrs.ErrInvalidOpCode(f"opcode {op:#x}")
-                if len(stack) < operation.min_stack:
-                    raise vmerrs.ErrStackUnderflow(
-                        f"op {op:#x} stack {len(stack)}")
-                if len(stack) > operation.max_stack:
-                    raise vmerrs.ErrStackOverflow()
-                if self.read_only and operation.writes:
-                    raise vmerrs.ErrWriteProtection()
-                if operation.constant_gas:
-                    frame.use_gas(operation.constant_gas)
-                memory_size = 0
-                if operation.memory_size is not None:
-                    memory_size = operation.memory_size(stack)
-                    if memory_size > UINT64_MAX:
-                        raise vmerrs.ErrGasUintOverflow()
-                if operation.dynamic_gas is not None:
-                    dgas = operation.dynamic_gas(
-                        evm, frame, stack, memory_size)
-                    frame.use_gas(dgas)
-                if memory_size > 0:
-                    mem_extend(frame.memory, memory_size)
-                pc = operation.execute(self, frame, stack, pc)
+                gas_before = frame.gas
+                try:
+                    if operation is None:
+                        raise vmerrs.ErrInvalidOpCode(f"opcode {op:#x}")
+                    if len(stack) < operation.min_stack:
+                        raise vmerrs.ErrStackUnderflow(
+                            f"op {op:#x} stack {len(stack)}")
+                    if len(stack) > operation.max_stack:
+                        raise vmerrs.ErrStackOverflow()
+                    if self.read_only and operation.writes:
+                        raise vmerrs.ErrWriteProtection()
+                    if operation.constant_gas:
+                        frame.use_gas(operation.constant_gas)
+                    memory_size = 0
+                    if operation.memory_size is not None:
+                        memory_size = operation.memory_size(stack)
+                        if memory_size > UINT64_MAX:
+                            raise vmerrs.ErrGasUintOverflow()
+                    if operation.dynamic_gas is not None:
+                        dgas = operation.dynamic_gas(
+                            evm, frame, stack, memory_size)
+                        frame.use_gas(dgas)
+                    if memory_size > 0:
+                        mem_extend(frame.memory, memory_size)
+                    if tracer is not None:
+                        tracer.capture_state(
+                            pc, op, gas_before, gas_before - frame.gas,
+                            frame, stack, self.return_data, evm.depth)
+                    pc = operation.execute(self, frame, stack, pc)
+                except (Halt, Revert):
+                    raise
+                except vmerrs.VMError as e:
+                    if tracer is not None:
+                        tracer.capture_fault(
+                            pc, op, gas_before, gas_before - frame.gas,
+                            frame, stack, evm.depth, e)
+                    raise
         except Halt as h:
             return h.data
         except Revert as r:

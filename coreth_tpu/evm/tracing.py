@@ -1,0 +1,129 @@
+"""EVM execution tracers.
+
+Twin of the reference's EVMLogger hook surface (core/vm/interpreter.go
+:44-47 + the CaptureState/CaptureFault debug branches :186-258) and the
+struct logger (eth/tracers/logger).  A tracer is attached through
+``vm.Config.tracer``; the interpreter calls ``capture_state`` before
+every opcode executes (gas already charged, geth ordering) and
+``capture_fault`` when an opcode raises.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+class Tracer:
+    """No-op base tracer; subclass and override what you need."""
+
+    def capture_start(self, evm, origin: bytes, to: bytes, create: bool,
+                      input_: bytes, gas: int, value: int) -> None:
+        pass
+
+    def capture_state(self, pc: int, op: int, gas: int, cost: int,
+                      frame, stack: List[int], return_data: bytes,
+                      depth: int) -> None:
+        pass
+
+    def capture_fault(self, pc: int, op: int, gas: int, cost: int,
+                      frame, stack: List[int], depth: int,
+                      err: Exception) -> None:
+        pass
+
+    def capture_end(self, output: bytes, gas_used: int,
+                    err: Optional[Exception]) -> None:
+        pass
+
+    def capture_enter(self, op: int, caller: bytes, to: bytes,
+                      input_: bytes, gas: int, value: int) -> None:
+        pass
+
+    def capture_exit(self, output: bytes, gas_used: int,
+                     err: Optional[Exception]) -> None:
+        pass
+
+    def capture_tx_start(self, gas_limit: int) -> None:
+        pass
+
+    def capture_tx_end(self, rest_gas: int) -> None:
+        pass
+
+
+@dataclass
+class StructLog:
+    """One opcode record (eth/tracers/logger StructLog)."""
+    pc: int
+    op: int
+    gas: int
+    gas_cost: int
+    depth: int
+    stack: List[int]
+    memory_size: int
+    err: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        from coreth_tpu.evm.jump_table import OP_NAMES
+        return {
+            "pc": self.pc,
+            "op": OP_NAMES.get(self.op, f"opcode {self.op:#x}"),
+            "gas": self.gas,
+            "gasCost": self.gas_cost,
+            "depth": self.depth,
+            "stack": [hex(v) for v in self.stack],
+            "memSize": self.memory_size,
+            **({"error": self.err} if self.err else {}),
+        }
+
+
+@dataclass
+class StructLogger(Tracer):
+    """Records a StructLog per step (eth/tracers/logger/logger.go)."""
+    limit: int = 0
+    disable_stack: bool = False
+    logs: List[StructLog] = field(default_factory=list)
+    output: bytes = b""
+    gas_used: int = 0
+    err: Optional[Exception] = None
+
+    _stepped: bool = False  # did capture_state log the current op?
+
+    def capture_state(self, pc, op, gas, cost, frame, stack, return_data,
+                      depth):
+        if self.limit and len(self.logs) >= self.limit:
+            self._stepped = False
+            return
+        self.logs.append(StructLog(
+            pc=pc, op=op, gas=gas, gas_cost=cost, depth=depth,
+            stack=[] if self.disable_stack else list(stack),
+            memory_size=len(frame.memory)))
+        self._stepped = True
+
+    def capture_fault(self, pc, op, gas, cost, frame, stack, depth, err):
+        self.err = err
+        if self._stepped and self.logs and self.logs[-1].pc == pc:
+            self.logs[-1].err = type(err).__name__
+            return
+        if self.limit and len(self.logs) >= self.limit:
+            return  # truncated trace: record only the error itself
+        # the op faulted during its gas charge, before capture_state
+        self.logs.append(StructLog(
+            pc=pc, op=op, gas=gas, gas_cost=cost, depth=depth,
+            stack=[] if self.disable_stack else list(stack),
+            memory_size=len(frame.memory),
+            err=type(err).__name__))
+
+    def capture_end(self, output, gas_used, err):
+        self.output = output
+        self.gas_used = gas_used
+        if err is not None:
+            self.err = err
+
+    def result(self) -> dict:
+        """debug_traceTransaction-shaped result (ExecutionResult)."""
+        return {
+            "gas": self.gas_used,
+            "failed": self.err is not None,
+            "returnValue": self.output.hex(),
+            "structLogs": [l.to_dict() for l in self.logs],
+        }

@@ -41,7 +41,9 @@ class StackTrie:
 
     # ------------------------------------------------------------- insert
     def update(self, key: bytes, value: bytes) -> None:
-        """Insert; keys must arrive in strictly increasing order."""
+        """Insert; keys must arrive in strictly increasing order and be
+        prefix-free (no key may be a prefix of another) — both hold for
+        the RLP-encoded-index keys derive_sha feeds it."""
         if not value:
             raise ValueError("stacktrie does not support empty values")
         self._root = self._insert(self._root, key_to_nibbles(key), value)
@@ -49,6 +51,10 @@ class StackTrie:
     def _insert(self, n, key, value):
         if n is None:
             return ["L", key, value]
+        if not key:
+            raise ValueError(
+                "key is a prefix of an existing key (prefix-free input "
+                "required)")
         kind = n[0]
         if kind == "H":
             raise ValueError("key out of order: subtree already hashed")
@@ -79,6 +85,10 @@ class StackTrie:
                leaf_value=None):
         """Divergence at depth cp: collapse the completed old subtree
         into a branch slot, start a new leaf to its right."""
+        if cp >= len(old_nibs) or cp >= len(key):
+            raise ValueError(
+                "key is a prefix of an existing key (prefix-free input "
+                "required)")
         old_idx = old_nibs[cp]
         new_idx = key[cp]
         if new_idx <= old_idx:

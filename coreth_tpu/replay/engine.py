@@ -393,9 +393,11 @@ class ReplayEngine:
 
         The window is padded up to the next power of two of its length
         (so a 1-block call scans 1 slot, not ``self.window``) with no-op
-        all-masked-out batches; shapes are bucketed to {1,2,4,...,window}
-        to bound the number of compiled variants while never scanning
-        more than 2x the real work."""
+        all-masked-out batches, bounding the number of compiled variants
+        while never scanning more than 2x the real work.  With a
+        non-power-of-two window the top bucket exceeds it (window=12
+        compiles K=16); keep ``window`` a power of two to avoid the
+        extra padded slots."""
         self.state.flush_staged()
         K = 1
         while K < len(items):
@@ -591,6 +593,13 @@ class ReplayEngine:
         self.trie.commit()
         self.db.cache_trie(self.root, self.trie)
         statedb = StateDB(self.root, self.db)
+        if (self.parent_header is None
+                and self.config.is_apricot_phase4(block.time)):
+            # the shim cannot supply parent block_gas_cost/time, which
+            # AP4+ fee validation needs — refuse rather than mis-validate
+            raise ReplayError(
+                "ReplayEngine needs parent_header for AP4+ blocks; "
+                "construct it with parent_header=...")
         parent = self.parent_header or _HeaderShim(block)
         receipts, logs, used_gas = self.processor.process(
             block, parent, statedb)

@@ -2,8 +2,7 @@
 
 A hand-assembled minimal token contract (transfer + balanceOf over a
 balances mapping at storage slot 0, Transfer event, unchecked classic
-semantics) used by the bench, the chain makers and the replay engine's
-token fast path.  Hand assembly keeps the execution path — and thus the
+semantics).  Hand assembly keeps the execution path — and thus the
 gas schedule — small and auditable; the contract is exercised through
 the host EVM interpreter (reference semantics: core/vm/instructions.go
 SLOAD/SSTORE/LOG3, core/state/state_object.go updateTrie), which is
@@ -167,7 +166,12 @@ def measure_transfer_exec_gas(config, number: int, time: int) -> int:
     before and after, partial amount), measured by running the host
     interpreter once on a scratch state — self-calibrating against the
     exact jump-table/gas rules instead of a hand-derived constant."""
-    key = (id(config), number, time)
+    # key on fork-schedule identity, not id(config): id() values can be
+    # reused after garbage collection and gas depends only on the rules
+    rules = config.rules(number, time)
+    key = (config.chain_id,) + tuple(
+        getattr(rules, f) for f in sorted(vars(rules))
+        if f.startswith("is_"))
     cached = _EXEC_GAS_CACHE.get(key)
     if cached is not None:
         return cached
@@ -185,7 +189,6 @@ def measure_transfer_exec_gas(config, number: int, time: int) -> int:
                       (10**20).to_bytes(32, "big"))
     statedb.set_state(token, balance_slot(recip), (1).to_bytes(32, "big"))
     statedb.add_balance(sender, 10**18)
-    rules = config.rules(number, time)
     block_ctx = BlockContext(coinbase=b"\x00" * 20, number=number,
                              time=time, gas_limit=8_000_000)
     evm = EVM(block_ctx, TxContext(origin=sender, gas_price=0), statedb,

@@ -222,3 +222,55 @@ def test_depth_limit():
     ret, left, err = evm.call(CALLER, target, b"", 5_000_000, 0)
     # must terminate without blowing the python stack
     assert err is None or isinstance(err, vmerrs.ErrOutOfGas)
+
+
+def test_struct_logger_traces_opcodes():
+    """vm.Config.tracer receives per-op CaptureState + CaptureEnd
+    (interpreter.go:186-258 debug branch; eth/tracers/logger)."""
+    from coreth_tpu.evm.evm import Config
+    from coreth_tpu.evm.tracing import StructLogger
+
+    # PUSH1 2 PUSH1 3 ADD PUSH1 0 MSTORE PUSH1 32 PUSH1 0 RETURN
+    code = bytes.fromhex("600260030160005260206000f3")
+    db = StateDB(EMPTY_ROOT, Database())
+    tracer = StructLogger()
+    evm = EVM(BlockContext(number=1, time=1, gas_limit=10_000_000,
+                           base_fee=25 * 10**9),
+              TxContext(origin=CALLER, gas_price=25 * 10**9),
+              db, TEST_CHAIN_CONFIG, config=Config(tracer=tracer))
+    db.add_balance(CALLER, 10**24)
+    db.set_code(OTHER, code)
+    db.finalise(False)
+    ret, gas_left, err = evm.call(CALLER, OTHER, b"", 100_000, 0)
+    assert err is None
+    assert int.from_bytes(ret, "big") == 5
+    names = [l.to_dict()["op"] for l in tracer.logs]
+    assert names == ["PUSH1", "PUSH1", "ADD", "PUSH1", "MSTORE",
+                     "PUSH1", "PUSH1", "RETURN"]
+    # ADD pops the two pushed values
+    add_log = tracer.logs[2]
+    assert add_log.stack[-2:] == [2, 3]
+    assert tracer.gas_used == 100_000 - gas_left
+    res = tracer.result()
+    assert not res["failed"] and res["gas"] == tracer.gas_used
+
+
+def test_tracer_capture_fault_on_oog():
+    from coreth_tpu.evm.evm import Config
+    from coreth_tpu.evm.tracing import StructLogger
+
+    code = bytes.fromhex("5b600056")  # JUMPDEST PUSH1 0 JUMP — spin to OOG
+    db = StateDB(EMPTY_ROOT, Database())
+    tracer = StructLogger()
+    evm = EVM(BlockContext(number=1, time=1, gas_limit=10_000_000,
+                           base_fee=25 * 10**9),
+              TxContext(origin=CALLER, gas_price=25 * 10**9),
+              db, TEST_CHAIN_CONFIG, config=Config(tracer=tracer))
+    db.add_balance(CALLER, 10**24)
+    db.set_code(OTHER, code)
+    db.finalise(False)
+    ret, gas_left, err = evm.call(CALLER, OTHER, b"", 500, 0)
+    assert isinstance(err, vmerrs.ErrOutOfGas)
+    assert gas_left == 0
+    assert isinstance(tracer.err, vmerrs.ErrOutOfGas)
+    assert tracer.logs[-1].err == "ErrOutOfGas"
