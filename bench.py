@@ -1,18 +1,22 @@
 #!/usr/bin/env python
 """Benchmark: batched TPU replay vs the sequential host processor.
 
-Workload = BASELINE.json config[2]: a value-transfer chain (the
-reference's core/bench_test.go:45 InsertChain shape), replayed from wire
-bytes with full sender recovery and per-block state-root validation.
+Two workloads:
+- transfer (BASELINE config[2] shape): value-transfer chain, the
+  reference's core/bench_test.go:45 InsertChain shape, replayed from
+  wire bytes with full sender recovery + per-block root validation.
+- erc20 (BASELINE config[1] shape): transfer() call spam on the
+  workloads/erc20 token — the M2 minimum end-to-end slice: batched
+  storage-slot read/modify/write + Transfer logs/bloom + storage-trie
+  rehash folded into the account trie, bit-identical roots.
 
 - baseline: the sequential host path (BlockChain.insert_chain — the
-  semantic twin of the Go StateProcessor loop, the only baseline
-  runnable on this machine; the reference publishes no numbers,
-  BASELINE.md).
-- measured: coreth_tpu.replay.ReplayEngine — batched device transfer
-  step + native batched ecrecover + incremental trie rehash.
+  semantic twin of the Go StateProcessor loop; BASELINE.md records why
+  the Go reference itself cannot run here).
+- measured: coreth_tpu.replay.ReplayEngine.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE json line; the primary metric is the transfer workload,
+with the erc20 numbers carried as extra fields.
 """
 
 import json
@@ -35,33 +39,51 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 N_BLOCKS = int(os.environ.get("BENCH_BLOCKS", "24"))
 TXS_PER_BLOCK = int(os.environ.get("BENCH_TXS", "512"))
 BASELINE_BLOCKS = int(os.environ.get("BENCH_BASELINE_BLOCKS", "8"))
-CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".bench_cache",
-                     f"transfer_{N_BLOCKS}x{TXS_PER_BLOCK}.bin")
+# ~45k avg gas/tx against the 15M Cortina block gas limit caps token
+# blocks at ~300 txs; 256 keeps a pow2 batch shape
+ERC20_TXS = int(os.environ.get("BENCH_ERC20_TXS", "256"))
+ERC20_BASELINE_BLOCKS = int(
+    os.environ.get("BENCH_ERC20_BASELINE_BLOCKS", "4"))
+_DIR = os.path.dirname(os.path.abspath(__file__))
 
 GWEI = 10**9
 N_KEYS = 64
+TOKEN = bytes([0x77]) * 20
 
 
-def _genesis():
+def _txs_per_block(workload):
+    return ERC20_TXS if workload == "erc20" else TXS_PER_BLOCK
+
+
+def _cache_path(workload):
+    return os.path.join(_DIR, ".bench_cache",
+                        f"{workload}_{N_BLOCKS}x{_txs_per_block(workload)}.bin")
+
+
+def _genesis(workload):
     from coreth_tpu.chain import Genesis, GenesisAccount
     from coreth_tpu.params import TEST_CHAIN_CONFIG
     from coreth_tpu.crypto.secp256k1 import priv_to_address
     keys = [0xC0FFEE + i for i in range(N_KEYS)]
     addrs = [priv_to_address(k) for k in keys]
+    alloc = {a: GenesisAccount(balance=10**27) for a in addrs}
+    if workload == "erc20":
+        from coreth_tpu.workloads.erc20 import token_genesis_account
+        alloc[TOKEN] = token_genesis_account({a: 10**24 for a in addrs})
     genesis = Genesis(config=TEST_CHAIN_CONFIG, gas_limit=8_000_000,
-                      alloc={a: GenesisAccount(balance=10**27)
-                             for a in addrs})
+                      alloc=alloc)
     return genesis, keys, addrs
 
 
-def build_or_load_chain():
-    """Build the chain once, cache the wire bytes (signing dominates)."""
+def build_or_load_chain(workload):
+    """Build the chain once, cache the wire bytes (signing + host EVM
+    execution dominate chain construction)."""
     from coreth_tpu import rlp
     from coreth_tpu.types import Block
-    genesis, keys, addrs = _genesis()
-    if os.path.exists(CACHE):
-        blob = open(CACHE, "rb").read()
+    genesis, keys, addrs = _genesis(workload)
+    cache = _cache_path(workload)
+    if os.path.exists(cache):
+        blob = open(cache, "rb").read()
         blocks = [Block.decode(b) for b in rlp.decode(blob)]
         return genesis, blocks
     from coreth_tpu.chain import generate_chain
@@ -72,7 +94,7 @@ def build_or_load_chain():
     gblock = genesis.to_block(db)
     nonces = [0] * N_KEYS
 
-    def gen(i, bg):
+    def gen_transfer(i, bg):
         for j in range(TXS_PER_BLOCK):
             k = (i * TXS_PER_BLOCK + j) % N_KEYS
             to = bytes([0x10 + (j % 199)]) * 20
@@ -85,20 +107,38 @@ def build_or_load_chain():
             ), keys[k], CFG.chain_id))
             nonces[k] += 1
 
+    def gen_erc20(i, bg):
+        from coreth_tpu.workloads.erc20 import transfer_calldata
+        for j in range(ERC20_TXS):
+            k = (i * ERC20_TXS + j) % N_KEYS
+            # mix of repeat token holders (SSTORE reset) and a rotating
+            # pool of fresh recipients (SSTORE set)
+            if j % 3 == 0:
+                to = addrs[(k + 1) % N_KEYS]
+            else:
+                to = (0x5000 + (i * 7 + j) % 1999).to_bytes(2, "big") * 10
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=GWEI, gas_fee_cap_=2000 * GWEI, gas=100_000,
+                to=TOKEN, value=0, data=transfer_calldata(to, 10 + j),
+            ), keys[k], CFG.chain_id))
+            nonces[k] += 1
+
+    gen = gen_erc20 if workload == "erc20" else gen_transfer
     # gap=10s: one block per fee window keeps the chain under the AP5
     # gas target so the base fee stays bounded over any chain length
     blocks, _ = generate_chain(CFG, gblock, db, N_BLOCKS, gen, gap=10)
-    os.makedirs(os.path.dirname(CACHE), exist_ok=True)
-    with open(CACHE, "wb") as f:
+    os.makedirs(os.path.dirname(cache), exist_ok=True)
+    with open(cache, "wb") as f:
         f.write(rlp.encode([b.encode() for b in blocks]))
     return genesis, blocks
 
 
-def run_baseline(genesis, wire_blocks):
+def run_baseline(genesis, wire_blocks, n_blocks):
     """Sequential host insert (fresh sender cache) over a block subset."""
     from coreth_tpu.chain import BlockChain
     from coreth_tpu.types import Block
-    blocks = [Block.decode(w) for w in wire_blocks[:BASELINE_BLOCKS]]
+    blocks = [Block.decode(w) for w in wire_blocks[:n_blocks]]
     chain = BlockChain(genesis)
     t0 = time.monotonic()
     chain.insert_chain(blocks)
@@ -117,7 +157,7 @@ def _fresh_engine(genesis, txs_per_block):
                         batch_pad=txs_per_block)
 
 
-def run_tpu(genesis, wire_blocks):
+def run_tpu(genesis, wire_blocks, txs_per_block):
     from coreth_tpu.types import Block
 
     # Warm-up pass on throwaway blocks/engine: compiles (or cache-loads)
@@ -126,40 +166,50 @@ def run_tpu(genesis, wire_blocks):
     # compile/load is a per-process one-time cost, excluded from timing
     # exactly like the first-block warm-up the round-1 bench did.
     warm_blocks = [Block.decode(w) for w in wire_blocks]
-    warm = _fresh_engine(genesis, TXS_PER_BLOCK)
+    warm = _fresh_engine(genesis, txs_per_block)
     warm.replay_block(warm_blocks[0])
     warm.replay(warm_blocks[1:])
     assert warm.root == warm_blocks[-1].header.root
+    assert warm.stats.blocks_fallback == 0, warm.stats.row()
 
     # Timed pass: fresh Block objects (no cached senders), fresh state.
     blocks = [Block.decode(w) for w in wire_blocks]
-    engine = _fresh_engine(genesis, TXS_PER_BLOCK)
+    engine = _fresh_engine(genesis, txs_per_block)
     engine.replay_block(blocks[0])
     t0 = time.monotonic()
     engine.replay(blocks[1:])
     dt = time.monotonic() - t0
     txs = sum(len(b.transactions) for b in blocks[1:])
     assert engine.root == blocks[-1].header.root
+    assert engine.stats.blocks_fallback == 0, engine.stats.row()
     return txs / dt, engine.stats.row()
 
 
-def main():
-    genesis, blocks = build_or_load_chain()
+def run_workload(workload, baseline_blocks):
+    genesis, blocks = build_or_load_chain(workload)
     wire = [b.encode() for b in blocks]
-    base_tps, base_timers = run_baseline(genesis, wire)
-    tpu_tps, tpu_stats = run_tpu(genesis, wire)
+    base_tps, base_timers = run_baseline(genesis, wire, baseline_blocks)
+    tpu_tps, tpu_stats = run_tpu(genesis, wire, _txs_per_block(workload))
+    if os.environ.get("BENCH_VERBOSE"):
+        print(f"[{workload}] baseline", round(base_tps, 1), "txs/s",
+              base_timers, file=sys.stderr)
+        print(f"[{workload}] tpu", round(tpu_tps, 1), "txs/s", tpu_stats,
+              file=sys.stderr)
+    return base_tps, tpu_tps
+
+
+def main():
+    base_tps, tpu_tps = run_workload("transfer", BASELINE_BLOCKS)
+    erc20_base, erc20_tpu = run_workload("erc20", ERC20_BASELINE_BLOCKS)
     result = {
         "metric": "transfer_replay_throughput",
         "value": round(tpu_tps, 1),
         "unit": "txs/s",
         "vs_baseline": round(tpu_tps / base_tps, 2),
+        "erc20_txs_s": round(erc20_tpu, 1),
+        "erc20_vs_baseline": round(erc20_tpu / erc20_base, 2),
     }
     print(json.dumps(result))
-    if os.environ.get("BENCH_VERBOSE"):
-        print("baseline", round(base_tps, 1), "txs/s", base_timers,
-              file=sys.stderr)
-        print("tpu", round(tpu_tps, 1), "txs/s", tpu_stats,
-              file=sys.stderr)
 
 
 if __name__ == "__main__":

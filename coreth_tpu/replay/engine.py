@@ -3,19 +3,23 @@
 Re-design of the reference's sequential hot path (state_processor.go:95
 tx loop) for TPU:
 
-1. **Classify** (host): a block is device-replayable when every tx is a
-   pure value transfer — `to` set, empty calldata, 21k gas, callee has
-   no code and no multicoin flag.  Anything else routes through the
-   bit-exact host Processor (execute-validate fallback, cf. SURVEY.md
-   section 2.8).
-2. **Execute** (device): one jitted step per block — per-sender debits
-   and per-recipient credits as segment reductions over 16x16-bit limb
-   arrays (ops/u256), nonce-sequence and solvency validation included.
-   The solvency check ignores same-block credits, so success implies
-   the sequential result (credits only help); any doubt falls back.
-3. **Hash** (device): account trie updated structurally on host, then
-   level-synchronous batched keccak rehash (mpt/rehash) reproduces the
-   state root bit-identically; it is checked against the header.
+1. **Classify** (host): a block is device-replayable when every tx is
+   either a pure value transfer (`to` set, empty calldata, 21k gas,
+   callee has no code and no multicoin flag) or an ERC-20 ``transfer()``
+   call on a known-bytecode token (workloads/erc20) — exact per-tx gas
+   derived from a host-side scalar simulation of the mapping-slot
+   sequence.  Anything else routes through the bit-exact host Processor
+   (execute-validate fallback, cf. SURVEY.md section 2.8).
+2. **Execute** (device): one jitted step per block — per-sender debits,
+   per-recipient credits, and per-storage-slot token debits/credits as
+   segment reductions over 16x16-bit limb arrays (ops/u256), with
+   nonce-sequence and solvency validation included.  The solvency
+   checks ignore same-block credits, so success implies the sequential
+   result (credits only help); any doubt falls back.
+3. **Hash** (device): account + touched storage tries updated
+   structurally on host, then level-synchronous batched keccak rehash
+   (mpt/rehash) reproduces the state root bit-identically; it is
+   checked against the header.
 
 State is shared with the host path through the same state Database, so
 both engines can interleave over one chain.
@@ -38,10 +42,15 @@ from coreth_tpu.ops import u256
 from coreth_tpu.params import ChainConfig
 from coreth_tpu.params import protocol as P
 from coreth_tpu.processor.state_processor import Processor
+from coreth_tpu.processor.state_transition import intrinsic_gas
 from coreth_tpu.state import Database, StateDB
+from coreth_tpu.workloads.erc20 import (
+    TOKEN_CODE_HASH, TRANSFER_TOPIC, balance_slot,
+    measure_transfer_exec_gas, parse_transfer_calldata,
+)
 from coreth_tpu.types import (
-    Block, LatestSigner, Receipt, StateAccount, Transaction, create_bloom,
-    derive_sha,
+    Block, LatestSigner, Log, Receipt, StateAccount, Transaction,
+    create_bloom, derive_sha,
 )
 from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
 
@@ -88,8 +97,10 @@ class ReplayStats:
 # (each separate transfer pays the full tunnel round-trip latency):
 #   0 sender_idx | 1 recip_idx | 2 tx_nonce | 3 nonce_offset | 4 mask
 #   5 coinbase_idx (broadcast) | 6:22 value16 | 22:38 fee16
-#   38:54 required16
-TXD_COLS = 54
+#   38:54 required16 | 54 from_slot | 55 to_slot | 56:72 amount16
+# Native transfers carry amount16 = 0 / slots = 0 (the reserved dummy);
+# token transfers carry value16 = 0.  Both kinds batch into one step.
+TXD_COLS = 72
 
 
 def pack_txd(batch: dict, B: int, pad: int) -> np.ndarray:
@@ -103,32 +114,62 @@ def pack_txd(batch: dict, B: int, pad: int) -> np.ndarray:
     txd[:B, 6:22] = u256.pack_np(batch["values"])
     txd[:B, 22:38] = u256.pack_np(batch["fees"])
     txd[:B, 38:54] = u256.pack_np(batch["required"])
+    txd[:B, 54] = batch["from_slots"]
+    txd[:B, 55] = batch["to_slots"]
+    txd[:B, 56:72] = u256.pack_np(batch["amounts"])
     return txd
 
 
-def _gather_fetch(balances, nonces, ok, t_idx):
-    """[t_pad+1, 17] fetch tensor: touched (balance, nonce) rows + ok."""
+def _gather_fetch(balances, nonces, slot_vals, ok, t_idx, s_idx):
+    """[t_pad+s_pad+1, 17] fetch tensor: touched (balance, nonce) rows,
+    touched storage-slot value rows, and the ok flag."""
     g = jnp.concatenate([balances[t_idx],
                          nonces[t_idx][:, None]], axis=1)
+    s = jnp.concatenate([slot_vals[s_idx],
+                         jnp.zeros((s_idx.shape[0], 1), dtype=jnp.int32)],
+                        axis=1)
     ok_row = jnp.zeros((1, u256.LIMBS + 1), dtype=jnp.int32)
     ok_row = ok_row.at[0, 0].set(ok.astype(jnp.int32))
-    return jnp.concatenate([g, ok_row], axis=0)
+    return jnp.concatenate([g, s, ok_row], axis=0)
 
 
-def _step_core(balances, nonces, txd, num_accounts: int):
-    """One block of pure transfers from a packed [pad, 54] batch."""
-    return _transfer_step(
+def _step_core(balances, nonces, slot_vals, txd, num_accounts: int,
+               num_slots: int):
+    """One block of transfers (native + token) from a packed batch."""
+    nb, nn, ok = _transfer_step(
         balances, nonces, txd[:, 0], txd[:, 1], txd[:, 6:22],
         txd[:, 22:38], txd[:, 38:54], txd[:, 2], txd[:, 3],
         txd[:, 4].astype(bool), txd[0, 5], num_accounts=num_accounts)
+    sv, ok_slots = _slot_step(
+        slot_vals, txd[:, 54], txd[:, 55], txd[:, 56:72],
+        txd[:, 4].astype(bool), num_slots=num_slots)
+    return nb, nn, sv, ok & ok_slots
 
 
-_transfer_step_packed = partial(jax.jit, static_argnames=("num_accounts",))(
-    _step_core)
+@partial(jax.jit, static_argnames=("num_slots",))
+def _slot_step(slot_vals, from_slot, to_slot, amount16, mask,
+               num_slots: int):
+    """Batched ERC-20 mapping-slot read/modify/write: per-slot debit and
+    credit totals as segment sums (the device analog of the token's
+    SLOAD/SSTORE pair, reference core/vm/instructions.go opSload/opSstore
+    + core/state/state_object.go updateTrie).  The solvency check
+    ignores same-block credits, so ok=True implies the sequential
+    result, exactly like the account-balance check above."""
+    mask_i = mask.astype(jnp.int32)
+    amt = amount16 * mask_i[:, None]
+    debit_tot = u256.normalize(jax.ops.segment_sum(
+        amt, from_slot, num_segments=num_slots))
+    credit_tot = u256.normalize(jax.ops.segment_sum(
+        amt, to_slot, num_segments=num_slots))
+    solvent = u256.gte(slot_vals, debit_tot)
+    ok = jnp.all(solvent)
+    new_vals = u256.sub(u256.add(slot_vals, credit_tot), debit_tot)
+    return new_vals, ok
 
 
-@partial(jax.jit, static_argnames=("num_accounts",))
-def _transfer_window(balances, nonces, txds, t_idxs, num_accounts: int):
+@partial(jax.jit, static_argnames=("num_accounts", "num_slots"))
+def _transfer_window(balances, nonces, slot_vals, txds, t_idxs, s_idxs,
+                     num_accounts: int, num_slots: int):
     """A WINDOW of blocks in one device call: lax.scan over the packed
     per-block batches, emitting one fetch tensor per block.
 
@@ -138,14 +179,15 @@ def _transfer_window(balances, nonces, txds, t_idxs, num_accounts: int):
     scan, one download.
     """
     def body(carry, inp):
-        bal, non = carry
-        txd, t_idx = inp
-        nb, nn, ok = _step_core(bal, non, txd, num_accounts)
-        return (nb, nn), _gather_fetch(nb, nn, ok, t_idx)
+        bal, non, sv = carry
+        txd, t_idx, s_idx = inp
+        nb, nn, nsv, ok = _step_core(bal, non, sv, txd, num_accounts,
+                                     num_slots)
+        return (nb, nn, nsv), _gather_fetch(nb, nn, nsv, ok, t_idx, s_idx)
 
-    (bal, non), fetches = jax.lax.scan(
-        body, (balances, nonces), (txds, t_idxs))
-    return bal, non, fetches
+    (bal, non, sv), fetches = jax.lax.scan(
+        body, (balances, nonces, slot_vals), (txds, t_idxs, s_idxs))
+    return bal, non, sv, fetches
 
 
 @partial(jax.jit, static_argnames=("num_accounts",))
@@ -189,19 +231,36 @@ def _transfer_step(balances, nonces, sender_idx, recip_idx, value16, fee16,
 
 
 class DeviceState:
-    """Account-indexed device arrays (the flat-state / snapshot analog,
-    reference core/state/snapshot/ — here resident in HBM)."""
+    """Account- and storage-slot-indexed device arrays (the flat-state /
+    snapshot analog, reference core/state/snapshot/ — here resident in
+    HBM).  Slot index 0 is a reserved dummy that native-transfer and
+    padding rows target with amount 0."""
 
-    def __init__(self, capacity: int = 1 << 14):
+    def __init__(self, capacity: int = 1 << 14,
+                 slot_capacity: int = 1 << 14):
         self.index: Dict[bytes, int] = {}
         self.addrs: List[bytes] = []
         self.capacity = capacity
         self.balances = jnp.zeros((capacity, u256.LIMBS), dtype=jnp.int32)
         self.nonces = jnp.zeros((capacity,), dtype=jnp.int32)
-        # host-side metadata that gates device replay
+        # host-side metadata that gates device replay; roots/code_hashes
+        # preserve non-device account fields across the trie fold
         self.has_code: List[bool] = []
         self.multicoin: List[bool] = []
+        self.code_hashes: List[bytes] = []
+        self.roots: List[bytes] = []
         self._staged: List[Tuple[int, int, int]] = []
+        # storage slots: (contract, slot_key32) -> index into slot_vals
+        self.slot_capacity = slot_capacity
+        self.slot_index: Dict[Tuple[bytes, bytes], int] = {}
+        self.slot_keys: List[Tuple[bytes, bytes]] = [(b"", b"")]  # dummy 0
+        self.slot_vals = jnp.zeros((slot_capacity, u256.LIMBS),
+                                   dtype=jnp.int32)
+        # host mirror of slot values as of the last VALIDATED block —
+        # the classifier's gas-variant simulation reads/extends it
+        self.slot_host: List[int] = [0]
+        self.slots_by_contract: Dict[bytes, List[int]] = {}
+        self._staged_slots: List[Tuple[int, int]] = []
 
     def _grow(self, need: int) -> None:
         while self.capacity < need:
@@ -212,6 +271,13 @@ class DeviceState:
         self.nonces = jnp.zeros(
             (self.capacity,), dtype=jnp.int32
         ).at[:self.nonces.shape[0]].set(self.nonces)
+
+    def _grow_slots(self, need: int) -> None:
+        while self.slot_capacity < need:
+            self.slot_capacity *= 2
+        self.slot_vals = jnp.zeros(
+            (self.slot_capacity, u256.LIMBS), dtype=jnp.int32
+        ).at[:self.slot_vals.shape[0]].set(self.slot_vals)
 
     def ensure(self, addr: bytes, account: Optional[StateAccount]) -> int:
         idx = self.index.get(addr)
@@ -225,26 +291,52 @@ class DeviceState:
         if account is None:
             self.has_code.append(False)
             self.multicoin.append(False)
+            self.code_hashes.append(EMPTY_CODE_HASH)
+            self.roots.append(EMPTY_ROOT_HASH)
         else:
             self.has_code.append(account.code_hash != EMPTY_CODE_HASH)
             self.multicoin.append(account.is_multi_coin)
+            self.code_hashes.append(account.code_hash)
+            self.roots.append(account.root)
             if account.balance or account.nonce:
                 # staged; one scatter per block (a per-account .at[].set
                 # would copy the whole array each time)
                 self._staged.append((idx, account.balance, account.nonce))
         return idx
 
+    def ensure_slot(self, contract: bytes, key: bytes, value: int) -> int:
+        s_idx = self.slot_index.get((contract, key))
+        if s_idx is not None:
+            return s_idx
+        s_idx = len(self.slot_keys)
+        if s_idx >= self.slot_capacity:
+            self._grow_slots(s_idx + 1)
+        self.slot_index[(contract, key)] = s_idx
+        self.slot_keys.append((contract, key))
+        self.slot_host.append(value)
+        self.slots_by_contract.setdefault(contract, []).append(s_idx)
+        if value:
+            self._staged_slots.append((s_idx, value))
+        return s_idx
+
     _staged: List[Tuple[int, int, int]]
 
     def flush_staged(self) -> None:
-        if not self._staged:
-            return
-        idx = jnp.asarray([s[0] for s in self._staged], dtype=jnp.int32)
-        bal = u256.from_ints([s[1] for s in self._staged])
-        non = jnp.asarray([s[2] for s in self._staged], dtype=jnp.int32)
-        self.balances = self.balances.at[idx].set(bal)
-        self.nonces = self.nonces.at[idx].set(non)
-        self._staged = []
+        if self._staged:
+            idx = jnp.asarray([s[0] for s in self._staged],
+                              dtype=jnp.int32)
+            bal = u256.from_ints([s[1] for s in self._staged])
+            non = jnp.asarray([s[2] for s in self._staged],
+                              dtype=jnp.int32)
+            self.balances = self.balances.at[idx].set(bal)
+            self.nonces = self.nonces.at[idx].set(non)
+            self._staged = []
+        if self._staged_slots:
+            idx = jnp.asarray([s[0] for s in self._staged_slots],
+                              dtype=jnp.int32)
+            val = u256.from_ints([s[1] for s in self._staged_slots])
+            self.slot_vals = self.slot_vals.at[idx].set(val)
+            self._staged_slots = []
 
     def read_accounts(self, indices: List[int]) -> List[Tuple[int, int]]:
         """Pull (balance, nonce) for given indices to host."""
@@ -260,11 +352,12 @@ class ReplayEngine:
 
     def __init__(self, config: ChainConfig, db: Database, state_root: bytes,
                  parent_header=None, batch_pad: int = 1024,
-                 capacity: int = 1 << 14, window: int = 16):
+                 capacity: int = 1 << 14, window: int = 16,
+                 slot_capacity: Optional[int] = None):
         self.config = config
         self.db = db
         self.trie = db.open_trie(state_root)
-        self.state = DeviceState(capacity)
+        self.state = DeviceState(capacity, slot_capacity or capacity)
         self.signer = LatestSigner(config.chain_id)
         self.engine = DummyEngine()
         self.engine.set_config(config)
@@ -276,6 +369,12 @@ class ReplayEngine:
         # parent header of the next block to replay; needed by the
         # fallback path's engine.finalize (AP4 blockGasCost validation)
         self.parent_header = parent_header
+        # device-managed contract storage tries (token fast path), keyed
+        # by contract address; opened lazily from the account root
+        self.storage_tries: Dict[bytes, "object"] = {}
+        # classifier's view of slot values for blocks classified but not
+        # yet validated (sequential sim across a pending window)
+        self._slot_overlay: Dict[int, int] = {}
 
     # ---------------------------------------------------------------- index
     def _account(self, addr: bytes) -> int:
@@ -285,6 +384,30 @@ class ReplayEngine:
         raw = self.trie.get(addr)
         account = StateAccount.from_rlp(raw) if raw is not None else None
         return self.state.ensure(addr, account)
+
+    def _storage_trie(self, contract: bytes):
+        st = self.storage_tries.get(contract)
+        if st is None:
+            idx = self.state.index[contract]
+            st = self.db.open_trie(self.state.roots[idx])
+            self.storage_tries[contract] = st
+        return st
+
+    def _slot(self, contract: bytes, key: bytes) -> int:
+        """Device slot index for (contract, EVM-level storage key),
+        loading the current value from the contract's storage trie on
+        first touch.  Keys are partitioned exactly as the StateDB writes
+        them: bit 0 of byte 0 cleared for normal storage (the Avalanche
+        multicoin split, statedb.normalize_state_key)."""
+        from coreth_tpu.state.statedb import normalize_state_key
+        key = normalize_state_key(key)
+        s_idx = self.state.slot_index.get((contract, key))
+        if s_idx is not None:
+            return s_idx
+        from coreth_tpu import rlp
+        raw = self._storage_trie(contract).get(key)
+        value = int.from_bytes(rlp.decode(raw), "big") if raw else 0
+        return self.state.ensure_slot(contract, key, value)
 
     # -------------------------------------------------------------- senders
     # Below this batch size the device round trip (~0.3s of tunnel
@@ -349,22 +472,31 @@ class ReplayEngine:
 
     # ------------------------------------------------------------- classify
     def _classify(self, block: Block) -> Optional[dict]:
-        """Batch inputs if the block is device-replayable, else None."""
+        """Batch inputs if the block is device-replayable, else None.
+
+        Two tx shapes replay on device, freely mixed within a block:
+        pure value transfers, and ERC-20 ``transfer()`` calls on
+        contracts whose runtime is the known token (workloads/erc20).
+        For token calls the classifier derives the exact per-tx gas by
+        simulating the mapping-slot value sequence on host (scalar dict
+        updates — the O(txs) bookkeeping that replaces O(gas) host
+        interpretation) and pre-builds the Transfer log; the wide u256
+        slot arithmetic itself runs batched on device (_slot_step)."""
         base_fee = block.base_fee
+        rules = self.config.rules(block.number, block.time)
         senders, recips, values, fees, required, nonces, offsets = \
             [], [], [], [], [], [], []
+        from_slots, to_slots, amounts, gas_used, tx_logs = \
+            [], [], [], [], []
         seen_count: Dict[bytes, int] = {}
+        overlay: Dict[int, int] = {}  # this block's slot sim, uncommitted
         for tx in block.transactions:
-            if tx.to is None or tx.data or tx.gas != P.TX_GAS:
-                return None
-            if tx.access_list:
+            if tx.to is None or tx.access_list:
                 return None
             sender = self.signer.sender(tx)
             s_idx = self._account(sender)
             r_idx = self._account(tx.to)
-            if (self.state.has_code[s_idx] or self.state.has_code[r_idx]
-                    or self.state.multicoin[s_idx]
-                    or self.state.multicoin[r_idx]):
+            if self.state.has_code[s_idx] or self.state.multicoin[s_idx]:
                 return None
             if base_fee is not None:
                 if tx.gas_fee_cap < base_fee or \
@@ -373,19 +505,101 @@ class ReplayEngine:
                 price = min(tx.gas_fee_cap, base_fee + tx.gas_tip_cap)
             else:
                 price = tx.gas_price
+            if tx.data:
+                out = self._classify_token(tx, sender, r_idx, rules,
+                                           block, overlay)
+                if out is None:
+                    return None
+                f_s, t_s, amt, used, log = out
+                values.append(0)
+                from_slots.append(f_s)
+                to_slots.append(t_s)
+                amounts.append(amt)
+                tx_logs.append(log)
+            else:
+                if tx.gas != P.TX_GAS:
+                    return None
+                if self.state.has_code[r_idx] \
+                        or self.state.multicoin[r_idx]:
+                    return None
+                used = P.TX_GAS
+                values.append(tx.value)
+                from_slots.append(0)
+                to_slots.append(0)
+                amounts.append(0)
+                tx_logs.append(None)
             senders.append(s_idx)
             recips.append(r_idx)
-            values.append(tx.value)
-            fees.append(P.TX_GAS * price)
+            gas_used.append(used)
+            fees.append(used * price)
             # buyGas requirement (cap-based for typed txs)
-            required.append(P.TX_GAS * tx.gas_fee_cap + tx.value)
+            required.append(tx.gas * tx.gas_fee_cap + tx.value)
             nonces.append(tx.nonce)
             offsets.append(seen_count.get(sender, 0))
             seen_count[sender] = seen_count.get(sender, 0) + 1
         coinbase_idx = self._account(block.header.coinbase)
+        # the block classified clean: its slot writes become visible to
+        # the next block's classification within this pending window
+        self._slot_overlay.update(overlay)
         return dict(senders=senders, recips=recips, values=values,
                     fees=fees, required=required, nonces=nonces,
-                    offsets=offsets, coinbase=coinbase_idx)
+                    offsets=offsets, coinbase=coinbase_idx,
+                    from_slots=from_slots, to_slots=to_slots,
+                    amounts=amounts, gas_used=gas_used, logs=tx_logs)
+
+    def _slot_view(self, s_idx: int, overlay: Dict[int, int]) -> int:
+        """Sequential slot value as of the current classification point:
+        this block's sim, then the pending window's, then validated."""
+        v = overlay.get(s_idx)
+        if v is not None:
+            return v
+        v = self._slot_overlay.get(s_idx)
+        if v is not None:
+            return v
+        return self.state.slot_host[s_idx]
+
+    def _classify_token(self, tx, sender: bytes, r_idx: int, rules,
+                        block: Block, overlay: Dict[int, int]):
+        """Classify one ERC-20 transfer() call; returns
+        (from_slot, to_slot, amount, gas_used, Log) or None.
+
+        Gas is exact: intrinsic calldata gas + the calibrated execution
+        gas of the variant this tx hits (workloads/erc20
+        measure_transfer_exec_gas).  Post-AP1 only — with refunds alive
+        (state_transition.go:449 pre-AP1) gas would depend on the refund
+        counter, which this path does not model."""
+        if not rules.is_apricot_phase1:
+            return None
+        if self.state.code_hashes[r_idx] != TOKEN_CODE_HASH:
+            return None
+        if tx.value != 0:
+            return None
+        parsed = parse_transfer_calldata(tx.data)
+        if parsed is None:
+            return None
+        to_addr, amt = parsed
+        if to_addr == sender:
+            return None  # self-transfer hits a different SSTORE sequence
+        token = tx.to
+        f_s = self._slot(token, balance_slot(sender))
+        t_s = self._slot(token, balance_slot(to_addr))
+        fv = self._slot_view(f_s, overlay)
+        tv = self._slot_view(t_s, overlay)
+        if fv < amt:
+            return None  # would revert sequentially -> host path
+        variant = "noop" if amt == 0 else ("set" if tv == 0 else "reset")
+        exec_gas = measure_transfer_exec_gas(
+            self.config, block.number, block.time, variant)
+        used = intrinsic_gas(tx.data, [], False, rules) + exec_gas
+        if tx.gas < used:
+            return None  # would OOG mid-execution -> status-0 receipt
+        overlay[f_s] = fv - amt
+        overlay[t_s] = (tv + amt) & ((1 << 256) - 1)  # unchecked ADD wraps
+        log = Log(address=token,
+                  topics=[TRANSFER_TOPIC, b"\x00" * 12 + sender,
+                          b"\x00" * 12 + to_addr],
+                  data=amt.to_bytes(32, "big"))
+        return f_s, t_s, amt, used, log
 
     # ---------------------------------------------------------------- replay
     def _prepare_window(self, items: List[Tuple[Block, dict]]):
@@ -404,7 +618,9 @@ class ReplayEngine:
             K *= 2
         pad = self.batch_pad
         t_pad = 256
+        s_pad = 8
         touched_lists = []
+        slot_lists = []
         for block, batch in items:
             B = len(block.transactions)
             while pad < B:
@@ -414,29 +630,42 @@ class ReplayEngine:
             touched_lists.append(touched)
             while t_pad < len(touched):
                 t_pad *= 2
+            slots = sorted((set(batch["from_slots"])
+                            | set(batch["to_slots"])) - {0})
+            slot_lists.append(slots)
+            while s_pad < len(slots):
+                s_pad *= 2
         txds = np.zeros((K, pad, TXD_COLS), dtype=np.int32)
         t_idxs = np.zeros((K, t_pad), dtype=np.int32)
+        s_idxs = np.zeros((K, s_pad), dtype=np.int32)
         for k, (block, batch) in enumerate(items):
             B = len(block.transactions)
             txds[k] = pack_txd(batch, B, pad)
             t_idxs[k, :len(touched_lists[k])] = touched_lists[k]
-        return txds, t_idxs, touched_lists
+            s_idxs[k, :len(slot_lists[k])] = slot_lists[k]
+        return txds, t_idxs, s_idxs, touched_lists, slot_lists
 
     def _issue_window(self, items: List[Tuple[Block, dict]]) -> dict:
         """One device call for a whole run of transfer blocks: upload the
         stacked batches, lax.scan the steps, download one stacked fetch
         tensor.  Round-trip latency amortizes over the window."""
         t0 = time.monotonic()
-        txds, t_idxs, touched_lists = self._prepare_window(items)
-        prev = (self.state.balances, self.state.nonces)
-        new_bal, new_non, fetches = _transfer_window(
-            prev[0], prev[1], jnp.asarray(txds), jnp.asarray(t_idxs),
-            num_accounts=self.state.capacity)
+        txds, t_idxs, s_idxs, touched_lists, slot_lists = \
+            self._prepare_window(items)
+        prev = (self.state.balances, self.state.nonces,
+                self.state.slot_vals)
+        new_bal, new_non, new_sv, fetches = _transfer_window(
+            prev[0], prev[1], prev[2], jnp.asarray(txds),
+            jnp.asarray(t_idxs), jnp.asarray(s_idxs),
+            num_accounts=self.state.capacity,
+            num_slots=self.state.slot_capacity)
         self.state.balances = new_bal
         self.state.nonces = new_non
+        self.state.slot_vals = new_sv
         self.stats.t_device += time.monotonic() - t0
         return dict(items=items, prev=prev, fetches=fetches,
-                    touched_lists=touched_lists)
+                    touched_lists=touched_lists, slot_lists=slot_lists,
+                    t_pad=t_idxs.shape[1])
 
     def _complete_window(self, win: dict, blocks: List[Block],
                          start_idx: int) -> Optional[int]:
@@ -450,8 +679,11 @@ class ReplayEngine:
         for k, (block, batch) in enumerate(items):
             if arr[k, -1, 0] != 1:
                 return self._recover_window(win, arr, k, blocks, start_idx)
-            self._validate_and_advance(block, arr[k],
-                                       win["touched_lists"][k])
+            self._validate_and_advance(block, batch, arr[k],
+                                       win["touched_lists"][k],
+                                       win["slot_lists"][k],
+                                       win["t_pad"])
+        self._slot_overlay.clear()  # slot_host is authoritative again
         return None
 
     def _recover_window(self, win, arr, k: int, blocks, start_idx: int) -> int:
@@ -460,30 +692,43 @@ class ReplayEngine:
         above; restore device arrays to the window start, re-apply the
         valid prefix on device, then run block k through the exact host
         path.  Returns the next block index to resume issuing from."""
-        self.state.balances, self.state.nonces = win["prev"]
+        self._slot_overlay.clear()  # discard the pending window's sim
+        (self.state.balances, self.state.nonces,
+         self.state.slot_vals) = win["prev"]
         if k > 0:
             items = win["items"][:k]
-            txds, t_idxs, _ = self._prepare_window(items)
-            new_bal, new_non, _ = _transfer_window(
+            txds, t_idxs, s_idxs, _, _ = self._prepare_window(items)
+            new_bal, new_non, new_sv, _ = _transfer_window(
                 self.state.balances, self.state.nonces,
-                jnp.asarray(txds), jnp.asarray(t_idxs),
-                num_accounts=self.state.capacity)
+                self.state.slot_vals, jnp.asarray(txds),
+                jnp.asarray(t_idxs), jnp.asarray(s_idxs),
+                num_accounts=self.state.capacity,
+                num_slots=self.state.slot_capacity)
             self.state.balances = new_bal
             self.state.nonces = new_non
+            self.state.slot_vals = new_sv
         self._fallback(blocks[start_idx + k])
         return start_idx + k + 1
 
-    def _validate_and_advance(self, block: Block, fetched: np.ndarray,
-                              touched: List[int]) -> None:
+    def _validate_and_advance(self, block: Block, batch: dict,
+                              fetched: np.ndarray, touched: List[int],
+                              touched_slots: List[int],
+                              t_pad: int) -> None:
         """Host-side consensus checks + trie fold for one device block."""
+        from coreth_tpu import rlp
         B = len(block.transactions)
-        used_gas = P.TX_GAS * B
-        if used_gas != block.header.gas_used:
+        gas_list = batch["gas_used"]
+        receipts = []
+        cum = 0
+        for i, tx in enumerate(block.transactions):
+            cum += gas_list[i]
+            log = batch["logs"][i]
+            receipts.append(Receipt(
+                tx_type=tx.tx_type, status=1, cumulative_gas_used=cum,
+                tx_hash=tx.hash(), gas_used=gas_list[i],
+                logs=[log] if log is not None else []))
+        if cum != block.header.gas_used:
             raise ReplayError("gas used mismatch")
-        receipts = [Receipt(tx_type=tx.tx_type, status=1,
-                            cumulative_gas_used=P.TX_GAS * (i + 1),
-                            tx_hash=tx.hash(), gas_used=P.TX_GAS)
-                    for i, tx in enumerate(block.transactions)]
         if derive_sha(receipts) != block.header.receipt_hash:
             raise ReplayError("receipt root mismatch")
         if create_bloom(receipts) != block.header.bloom:
@@ -493,18 +738,45 @@ class ReplayEngine:
                 block.base_fee, block.header.block_gas_cost,
                 block.transactions, receipts, None)
         t0 = time.monotonic()
+        # fold touched storage slots into their contract tries, rehash,
+        # and pick up the new storage roots before the account fold
+        if touched_slots:
+            slot_vals = u256.to_ints(
+                fetched[t_pad:t_pad + len(touched_slots), :16])
+            changed = {}
+            for i, s_idx in enumerate(touched_slots):
+                contract, key = self.state.slot_keys[s_idx]
+                v = slot_vals[i]
+                self.state.slot_host[s_idx] = v
+                st = self._storage_trie(contract)
+                if v == 0:
+                    st.delete(key)
+                else:
+                    st.update(key, rlp.encode(
+                        v.to_bytes(32, "big").lstrip(b"\x00")))
+                changed[contract] = st
+            for contract, st in changed.items():
+                self.state.roots[self.state.index[contract]] = \
+                    device_rehash(st)
         n_touched = len(touched)
         balances = u256.to_ints(fetched[:n_touched, :16])
         nonces = fetched[:n_touched, 16]
         for i, idx in enumerate(touched):
             addr = self.state.addrs[idx]
             balance, nonce = balances[i], int(nonces[i])
-            if balance == 0 and nonce == 0:
+            code_hash = self.state.code_hashes[idx]
+            storage_root = self.state.roots[idx]
+            if (balance == 0 and nonce == 0
+                    and code_hash == EMPTY_CODE_HASH
+                    and storage_root == EMPTY_ROOT_HASH
+                    and not self.state.multicoin[idx]):
                 # touched but empty: EIP-158 deletion semantics
                 self.trie.delete(addr)
             else:
-                self.trie.update(
-                    addr, StateAccount(nonce=nonce, balance=balance).rlp())
+                self.trie.update(addr, StateAccount(
+                    nonce=nonce, balance=balance, root=storage_root,
+                    code_hash=code_hash,
+                    is_multi_coin=self.state.multicoin[idx]).rlp())
         root = device_rehash(self.trie)
         self.stats.t_trie += time.monotonic() - t0
         if root != block.header.root:
@@ -592,6 +864,9 @@ class ReplayEngine:
         t0 = time.monotonic()
         self.trie.commit()
         self.db.cache_trie(self.root, self.trie)
+        # storage tries the device path touched must be readable too
+        for st in self.storage_tries.values():
+            self.db.cache_trie(st.commit(), st)
         statedb = StateDB(self.root, self.db)
         if (self.parent_header is None
                 and self.config.is_apricot_phase4(block.time)):
@@ -613,6 +888,8 @@ class ReplayEngine:
         statedb.commit(delete_empty_objects=True)
         # refresh engine trie + device copies of touched accounts (one
         # batched scatter via the staging buffer)
+        from coreth_tpu import rlp as _rlp
+        self._slot_overlay.clear()
         self.trie = self.db.open_trie(root)
         self.state.flush_staged()
         for addr in list(statedb._objects):
@@ -626,6 +903,22 @@ class ReplayEngine:
             self.state.has_code[idx] = \
                 account.code_hash != EMPTY_CODE_HASH
             self.state.multicoin[idx] = account.is_multi_coin
+            self.state.code_hashes[idx] = account.code_hash
+            old_root = self.state.roots[idx]
+            self.state.roots[idx] = account.root
+            if addr in self.storage_tries and account.root != old_root:
+                # the host path rewrote this contract's storage: reload
+                # every tracked slot from the committed trie
+                del self.storage_tries[addr]
+                st = self._storage_trie(addr)
+                for s_idx in self.state.slots_by_contract.get(addr, []):
+                    key = self.state.slot_keys[s_idx][1]
+                    raw_v = st.get(key)
+                    v = int.from_bytes(_rlp.decode(raw_v), "big") \
+                        if raw_v else 0
+                    if v != self.state.slot_host[s_idx]:
+                        self.state.slot_host[s_idx] = v
+                        self.state._staged_slots.append((s_idx, v))
         self.state.flush_staged()
         self.root = root
         self.parent_header = block.header
@@ -635,9 +928,12 @@ class ReplayEngine:
         return root
 
     def commit(self) -> bytes:
-        """Persist the engine trie so host StateDBs can open the state."""
+        """Persist the engine tries so host StateDBs can open the state."""
         root = self.trie.commit()
         self.db.cache_trie(root, self.trie)
+        for st in self.storage_tries.values():
+            srot = st.commit()
+            self.db.cache_trie(srot, st)
         return root
 
 

@@ -161,15 +161,25 @@ def intrinsic_gas(data: bytes, rules) -> int:
 _EXEC_GAS_CACHE: Dict[tuple, int] = {}
 
 
-def measure_transfer_exec_gas(config, number: int, time: int) -> int:
-    """Execution gas of one happy-path transfer (both slots nonzero
-    before and after, partial amount), measured by running the host
-    interpreter once on a scratch state — self-calibrating against the
-    exact jump-table/gas rules instead of a hand-derived constant."""
+def measure_transfer_exec_gas(config, number: int, time: int,
+                              variant: str = "reset") -> int:
+    """Execution gas of one transfer() call, measured by running the
+    host interpreter once on a scratch state — self-calibrating against
+    the exact jump-table/gas rules instead of a hand-derived constant.
+
+    Variants (the only gas classes a successful non-self transfer can
+    hit post-AP1, where refunds are disabled so zeroing the from-slot
+    costs the same as a partial spend):
+      - "reset": both slots nonzero before, partial amount (SSTORE
+        nonzero->nonzero on both slots)
+      - "set":   to-slot zero before (SSTORE zero->nonzero, EIP-2929
+        SSTORE_SET on the credit side)
+      - "noop":  amount == 0 (both SSTOREs write the current value)
+    """
     # key on fork-schedule identity, not id(config): id() values can be
     # reused after garbage collection and gas depends only on the rules
     rules = config.rules(number, time)
-    key = (config.chain_id,) + tuple(
+    key = (config.chain_id, variant) + tuple(
         getattr(rules, f) for f in sorted(vars(rules))
         if f.startswith("is_"))
     cached = _EXEC_GAS_CACHE.get(key)
@@ -187,8 +197,15 @@ def measure_transfer_exec_gas(config, number: int, time: int) -> int:
     statedb.set_code(token, TOKEN_RUNTIME)
     statedb.set_state(token, balance_slot(sender),
                       (10**20).to_bytes(32, "big"))
-    statedb.set_state(token, balance_slot(recip), (1).to_bytes(32, "big"))
+    if variant != "set":
+        statedb.set_state(token, balance_slot(recip),
+                          (1).to_bytes(32, "big"))
     statedb.add_balance(sender, 10**18)
+    # commit + reopen so SSTORE sees real committed "original" values
+    # (EIP-2200 original-value gas depends on them; a fresh object's
+    # origins all read zero and would miscost the reset paths by 2800)
+    pre_root = statedb.commit(False)
+    statedb = StateDB(pre_root, db)
     block_ctx = BlockContext(coinbase=b"\x00" * 20, number=number,
                              time=time, gas_limit=8_000_000)
     evm = EVM(block_ctx, TxContext(origin=sender, gas_price=0), statedb,
@@ -196,8 +213,9 @@ def measure_transfer_exec_gas(config, number: int, time: int) -> int:
     statedb.prepare(rules, sender, block_ctx.coinbase, token,
                     list(rules.active_precompiles), [])
     gas_limit = 200_000
+    amount = 0 if variant == "noop" else 1000
     ret, gas_left, err = evm.call(sender, token,
-                                  transfer_calldata(recip, 1000),
+                                  transfer_calldata(recip, amount),
                                   gas_limit, 0)
     if err is not None:
         raise RuntimeError(f"token gas probe failed: {err}")

@@ -189,9 +189,9 @@ def test_prepare_window_pads_to_pow2_not_full_window():
                           capacity=256, batch_pad=64, window=16)
     engine.warm_senders(blocks[0])
     batch = engine._classify(blocks[0])
-    txds, t_idxs, _ = engine._prepare_window([(blocks[0], batch)])
+    txds, t_idxs, _, _, _ = engine._prepare_window([(blocks[0], batch)])
     assert txds.shape[0] == 1
-    txds2, _, _ = engine._prepare_window(
+    txds2, _, _, _, _ = engine._prepare_window(
         [(blocks[0], batch),
          (blocks[1], engine._classify(blocks[1])),
          (blocks[2], engine._classify(blocks[2]))])
@@ -216,3 +216,202 @@ def test_device_rehash_parity():
         t1.update(k, b"\x99" * 40)
         t2.update(k, b"\x99" * 40)
     assert device_rehash(t1, min_batch=64) == t2.hash()
+
+
+# ------------------------------------------------------------ ERC-20 device
+
+TOKEN = bytes([0x77]) * 20
+
+
+def build_token_chain(n_blocks, txs_per_block, gen_tx=None):
+    """Chain whose blocks are transfer() calls on the workloads/erc20
+    token (BASELINE config[1] shape); headers/receipts come from the
+    bit-exact host processor via generate_chain."""
+    from coreth_tpu.workloads.erc20 import (
+        token_genesis_account, transfer_calldata)
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[TOKEN] = token_genesis_account(
+        {a: 10**18 for a in ADDRS})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    def default_gen(i, bg):
+        for j in range(txs_per_block):
+            k = (i * txs_per_block + j) % len(KEYS)
+            # mix fresh recipients (SSTORE set) and token holders (reset)
+            if j % 3 == 0:
+                to = ADDRS[(k + 1) % len(KEYS)]
+            else:
+                to = bytes([0x50 + (j % 40)]) * 20
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=100_000,
+                to=TOKEN, value=0,
+                data=transfer_calldata(to, 10 + j),
+            ), KEYS[k], CFG.chain_id))
+            nonces[k] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, db, n_blocks,
+                               gen_tx or default_gen, gap=2)
+    return genesis, gblock, blocks, nonces
+
+
+def test_replay_token_transfers_on_device():
+    """M2 slice: token blocks replay on device with bit-identical roots
+    (the root check inside _validate_and_advance), zero fallbacks."""
+    genesis, gblock, blocks, _ = build_token_chain(4, 16)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].root
+    assert engine.stats.blocks_device == 4
+    assert engine.stats.blocks_fallback == 0
+    # committed state is readable by a host StateDB, including slots
+    from coreth_tpu.state import StateDB
+    from coreth_tpu.workloads.erc20 import balance_slot
+    engine.commit()
+    statedb = StateDB(root, db)
+    total = sum(
+        int.from_bytes(statedb.get_state(TOKEN, balance_slot(a)), "big")
+        for a in ADDRS)
+    assert total <= len(ADDRS) * 10**18  # senders paid out to fresh addrs
+
+
+def test_replay_token_zero_amount_noop_variant():
+    from coreth_tpu.workloads.erc20 import transfer_calldata
+
+    def gen(i, bg):
+        for j in range(6):
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=i * 6 + j,
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=100_000,
+                to=TOKEN, value=0,
+                data=transfer_calldata(ADDRS[1], 0 if j % 2 else 7),
+            ), KEYS[0], CFG.chain_id))
+
+    genesis, gblock, blocks, _ = build_token_chain(2, 6, gen_tx=gen)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].root
+    assert engine.stats.blocks_device == 2
+
+
+def test_replay_mixed_native_and_token_block():
+    """Native value transfers and token calls batch into ONE device
+    step (unified txd layout)."""
+    from coreth_tpu.workloads.erc20 import transfer_calldata
+
+    def gen(i, bg):
+        for j in range(8):
+            k = j % 4
+            nonce = i * 2 + j // 4
+            if j % 2 == 0:
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=CFG.chain_id, nonce=nonce,
+                    gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                    gas=21_000, to=bytes([0x60 + j]) * 20, value=123,
+                ), KEYS[k], CFG.chain_id))
+            else:
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=CFG.chain_id, nonce=nonce,
+                    gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                    gas=100_000, to=TOKEN, value=0,
+                    data=transfer_calldata(bytes([0x61 + j]) * 20, 5),
+                ), KEYS[k], CFG.chain_id))
+
+    genesis, gblock, blocks, _ = build_token_chain(2, 8, gen_tx=gen)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].root
+    assert engine.stats.blocks_device == 2
+    assert engine.stats.blocks_fallback == 0
+
+
+def test_replay_token_insufficient_falls_back_then_resumes():
+    """A would-revert transfer routes its block through the host path
+    (receipt status 0 there), and later token blocks return to the
+    device with refreshed slot values."""
+    from coreth_tpu.workloads.erc20 import transfer_calldata
+
+    def gen(i, bg):
+        if i == 1:
+            # overdraw KEYS[6]'s token balance to force the host-path
+            # fallback (classifier sees the sequential revert)
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=0,
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=100_000,
+                to=TOKEN, value=0,
+                data=transfer_calldata(ADDRS[0], 10**30),
+            ), KEYS[6], CFG.chain_id))
+        else:
+            n = {0: 0, 2: 1}[i]
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=n,
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=100_000,
+                to=TOKEN, value=0,
+                data=transfer_calldata(ADDRS[1], 1000),
+            ), KEYS[0], CFG.chain_id))
+
+    genesis, gblock, blocks, _ = build_token_chain(3, 1, gen_tx=gen)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].root
+    assert engine.stats.blocks_fallback == 1   # the overdraw block
+    assert engine.stats.blocks_device == 2
+
+
+def test_replay_mid_window_failure_recovery():
+    """A block that is sequentially valid but fails the conservative
+    device check (sender spends credits received earlier in the same
+    block) triggers the rewind/re-apply/fallback/resume path at k>0
+    (_recover_window), producing the exact sequential result."""
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDRS[0]: GenesisAccount(balance=10**24),
+                             ADDRS[1]: GenesisAccount(balance=10**17),
+                             ADDRS[2]: GenesisAccount(balance=10**24)})
+    db0 = Database()
+    gblock = genesis.to_block(db0)
+    big = 5 * 10**23  # far exceeds ADDRS[1]'s own 1e17 balance
+
+    def gen(i, bg):
+        if i == 1:
+            # A -> B big, then B -> C bigger-than-B's-pre-block balance:
+            # valid sequentially, insolvent under the conservative check
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=1, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDRS[1],
+                value=big), KEYS[0], CFG.chain_id))
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=0, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDRS[2],
+                value=big // 2), KEYS[1], CFG.chain_id))
+        else:
+            nonce = {0: 0, 2: 2}[i]
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonce, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000,
+                to=bytes([0x42 + i]) * 20, value=777),
+                KEYS[0], CFG.chain_id))
+
+    blocks, _ = generate_chain(CFG, gblock, db0, 3, gen, gap=2)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64, window=16)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].root
+    assert engine.stats.blocks_fallback == 1   # the insolvent-check block
+    assert engine.stats.blocks_device == 2     # prefix + resumed tail
