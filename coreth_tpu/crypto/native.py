@@ -48,6 +48,20 @@ def load():
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p]
     lib.coreth_ecrecover_batch.restype = None
+    lib.coreth_recover_prep.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p]
+    lib.coreth_recover_prep.restype = None
+    lib.coreth_recover_finish.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p]
+    lib.coreth_recover_finish.restype = None
+    lib.coreth_baseline_replay.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_double)]
+    lib.coreth_baseline_replay.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -95,3 +109,44 @@ def install() -> bool:
     _k.set_impl(keccak256_native)
     _s.set_recover_impl(recover_address_native)
     return True
+
+
+def baseline_replay(tx_records: bytes, block_offsets, roots: bytes,
+                    coinbases: bytes, accounts: bytes, n_accounts: int):
+    """Run the compiled sequential transfer processor (native/baseline.cc
+    — the Go-proxy baseline; see BASELINE.md).  Returns (rc, phases)
+    where rc==0 means every block's state root matched and phases is
+    [t_sender, t_exec, t_trie] seconds."""
+    lib = _require()
+    n_blocks = len(block_offsets) - 1
+    off = (ctypes.c_uint64 * len(block_offsets))(*block_offsets)
+    phases = (ctypes.c_double * 3)()
+    rc = lib.coreth_baseline_replay(
+        tx_records, off, n_blocks, roots, coinbases, accounts,
+        n_accounts, phases)
+    return rc, list(phases)
+
+
+def recover_prep(hashes: bytes, rs: bytes, ss: bytes, recids: bytes):
+    """C++ host prep for the device recovery kernel: range checks, x
+    coordinate, and u1/u2 scalars via one Montgomery batch inversion.
+    Returns (xs_le33, u1_le32, u2_le32, ok) packed bytes."""
+    lib = _require()
+    n = len(recids)
+    xs = ctypes.create_string_buffer(33 * n)
+    u1 = ctypes.create_string_buffer(32 * n)
+    u2 = ctypes.create_string_buffer(32 * n)
+    ok = ctypes.create_string_buffer(n)
+    lib.coreth_recover_prep(hashes, rs, ss, recids, n, xs, u1, u2, ok)
+    return xs.raw, u1.raw, u2.raw, ok.raw
+
+
+def recover_finish(rows: bytes, n: int, ok_in: bytes):
+    """C++ finish for the device recovery kernel: batched Jacobian->
+    affine conversion + keccak address derivation.  Returns (addrs, ok)
+    where ok[i]==2 marks ladder-collision rows for host re-run."""
+    lib = _require()
+    out = ctypes.create_string_buffer(20 * n)
+    ok = ctypes.create_string_buffer(n)
+    lib.coreth_recover_finish(rows, n, ok_in, out, ok)
+    return out.raw, ok.raw

@@ -69,64 +69,147 @@ def _pad_pow2(n: int, floor: int = 64) -> int:
 
 # Largest single kernel launch: batches beyond this are chunked so
 # padding waste, HBM footprint, and the set of compiled shape variants
-# all stay bounded (pow2 buckets 64..16384 — at most 9 executables).
+# all stay bounded (pow2 buckets 64..4096 — at most 7 executables).
+# Measured on the tunneled v5e chip: 2048-chunks are dispatch-bound
+# (0.14 ms/sig), 4096 and 8192 both reach 0.083 ms/sig, and a single
+# 16384 launch loses to pow2 padding waste (0.11 ms/sig) — so 4096.
 MAX_CHUNK = int(__import__("os").environ.get(
-    "CORETH_RECOVER_MAX_CHUNK", str(16384)))
+    "CORETH_RECOVER_MAX_CHUNK", str(4096)))
+
+
+def issue_recover(hashes: bytes, rs: bytes, ss: bytes,
+                  recids: bytes) -> list:
+    """Host prep + async kernel dispatch for a packed signature batch.
+
+    Returns a list of per-chunk contexts; pass to complete_recover to
+    block on the device results and finish on host.  The kernel calls
+    are dispatched asynchronously (jax), so the caller can do host work
+    — or enqueue more device work — while the ladder runs."""
+    n = len(recids)
+    ctxs = []
+    for lo in range(0, n, MAX_CHUNK):
+        hi = min(lo + MAX_CHUNK, n)
+        ctxs.append(_issue_chunk(
+            hashes[32 * lo:32 * hi], rs[32 * lo:32 * hi],
+            ss[32 * lo:32 * hi], recids[lo:hi]))
+    return ctxs
+
+
+def complete_recover(ctxs: list) -> Tuple[bytes, bytes]:
+    """Block on issued chunks; returns (addresses, ok) packed bytes."""
+    addrs = bytearray()
+    okb = bytearray()
+    for ctx in ctxs:
+        a, o = _complete_chunk(ctx)
+        addrs += a
+        okb += o
+    return bytes(addrs), bytes(okb)
 
 
 def recover_addresses_device(hashes: bytes, rs: bytes, ss: bytes,
                              recids: bytes) -> Tuple[bytes, bytes]:
     """Batched recovery over packed buffers; returns (addresses, ok)."""
+    return complete_recover(issue_recover(hashes, rs, ss, recids))
+
+
+def _issue_chunk(hashes: bytes, rs: bytes, ss: bytes, recids: bytes):
     from coreth_tpu.ops import secp as S
 
     n = len(recids)
     if n == 0:
-        return b"", b""
-    if n > MAX_CHUNK:
-        addrs = bytearray()
-        okb = bytearray()
-        for lo in range(0, n, MAX_CHUNK):
-            hi = min(lo + MAX_CHUNK, n)
-            a, o = recover_addresses_device(
-                hashes[32 * lo:32 * hi], rs[32 * lo:32 * hi],
-                ss[32 * lo:32 * hi], recids[lo:hi])
-            addrs += a
-            okb += o
-        return bytes(addrs), bytes(okb)
-    r_l = [int.from_bytes(rs[32 * i:32 * i + 32], "big") for i in range(n)]
-    s_l = [int.from_bytes(ss[32 * i:32 * i + 32], "big") for i in range(n)]
-    z_l = [int.from_bytes(hashes[32 * i:32 * i + 32], "big")
-           for i in range(n)]
+        return None
+    # host prep in C++ when available (range checks + the u1/u2 batch
+    # inversion — Python bigint math would sit on the critical path),
+    # pure-python fallback otherwise
+    from coreth_tpu.crypto import native
+    prep = native.recover_prep(hashes, rs, ss, recids) \
+        if native.load() is not None else None
+    if prep is not None:
+        xs_le, u1_le, u2_le, okb = prep
+        ok = [bool(b) for b in okb]
+        pad = _pad_pow2(n)
+        x_arr = np.zeros((pad, 33), dtype=np.uint8)
+        x_arr[:n] = np.frombuffer(xs_le, dtype=np.uint8).reshape(n, 33)
+        u1_arr = np.zeros((pad, 8), dtype=np.int32)
+        u2_arr = np.zeros((pad, 8), dtype=np.int32)
+        u1_arr[:n] = np.frombuffer(u1_le, dtype="<u4").reshape(
+            n, 8).astype(np.int32)
+        u2_arr[:n] = np.frombuffer(u2_le, dtype="<u4").reshape(
+            n, 8).astype(np.int32)
+    else:
+        r_l = [int.from_bytes(rs[32 * i:32 * i + 32], "big")
+               for i in range(n)]
+        s_l = [int.from_bytes(ss[32 * i:32 * i + 32], "big")
+               for i in range(n)]
+        z_l = [int.from_bytes(hashes[32 * i:32 * i + 32], "big")
+               for i in range(n)]
+        ok = [True] * n
+        xs = [0] * n
+        for i in range(n):
+            r, s, recid = r_l[i], s_l[i], recids[i]
+            if not (0 < r < N and 0 < s < N and recid <= 3):
+                ok[i] = False
+                continue
+            x = r + N if recid & 2 else r
+            if x >= P:
+                ok[i] = False
+                continue
+            xs[i] = x
+        live = [i for i in range(n) if ok[i]]
+        rinv = dict(zip(live, _batch_inv([r_l[i] for i in live], N)))
+        u1s = [0] * n
+        u2s = [0] * n
+        for i in live:
+            u1s[i] = (-z_l[i] * rinv[i]) % N
+            u2s[i] = (s_l[i] * rinv[i]) % N
+        pad = _pad_pow2(n)
+        padz = [0] * (pad - n)
+        x_arr = S.fe_bytes_np(xs + padz)
+        u1_arr = _words_le(u1s + padz)
+        u2_arr = _words_le(u2s + padz)
 
-    ok = [True] * n
-    xs = [0] * n
-    for i in range(n):
-        r, s, recid = r_l[i], s_l[i], recids[i]
-        if not (0 < r < N and 0 < s < N and recid <= 3):
-            ok[i] = False
-            continue
-        x = r + N if recid & 2 else r
-        if x >= P:
-            ok[i] = False
-            continue
-        xs[i] = x
-
-    live = [i for i in range(n) if ok[i]]
-    rinv = dict(zip(live, _batch_inv([r_l[i] for i in live], N)))
-    u1s = [0] * n
-    u2s = [0] * n
-    for i in live:
-        u1s[i] = (-z_l[i] * rinv[i]) % N
-        u2s[i] = (s_l[i] * rinv[i]) % N
-
-    # --- device: sqrt + G+R table + Shamir ladder, one call ------------
-    pad = _pad_pow2(n)
-    padz = [0] * (pad - n)
+    # --- device: sqrt + G+R table + Shamir ladder, async dispatch ------
     parity = np.frombuffer(recids, dtype=np.uint8).astype(np.int32) & 1
     parity = np.concatenate([parity, np.zeros(pad - n, np.int32)])
-    out = np.asarray(S.recover_kernel(
-        S.fe_bytes_np(xs + padz), parity,
-        _words_le(u1s + padz), _words_le(u2s + padz)))[:n]
+    dev_out = S.recover_kernel(x_arr, parity, u1_arr, u2_arr)
+    return dict(n=n, dev_out=dev_out, ok=ok, hashes=hashes, rs=rs, ss=ss,
+                recids=recids)
+
+
+def _redo_collision(hashes, rs, ss, recids, i, addrs, okb):
+    """Ladder doubling-collision row: exact host re-run (rare)."""
+    try:
+        addr = _ref.recover_address_py(
+            hashes[32 * i:32 * i + 32],
+            int.from_bytes(rs[32 * i:32 * i + 32], "big"),
+            int.from_bytes(ss[32 * i:32 * i + 32], "big"), recids[i])
+    except ValueError:
+        return
+    addrs[20 * i:20 * i + 20] = addr
+    okb[i] = 1
+
+
+def _complete_chunk(ctx) -> Tuple[bytes, bytes]:
+    if ctx is None:
+        return b"", b""
+    n = ctx["n"]
+    ok = ctx["ok"]
+    hashes, rs, ss = ctx["hashes"], ctx["rs"], ctx["ss"]
+    recids = ctx["recids"]
+    out = np.asarray(ctx["dev_out"])[:n]
+
+    from coreth_tpu.crypto import native
+    if native.load() is not None:
+        # C++ finish: batched Z inversion + affine + keccak
+        rows = out.tobytes()
+        addrs_b, okb_b = native.recover_finish(rows, n, bytes(ok))
+        addrs = bytearray(addrs_b)
+        okb = bytearray(okb_b)
+        for i in range(n):
+            if okb[i] == 2:
+                okb[i] = 0
+                _redo_collision(hashes, rs, ss, recids, i, addrs, okb)
+        return bytes(addrs), bytes(okb)
 
     inf = out[:, 99].astype(bool)
     bad = out[:, 100].astype(bool)
@@ -134,8 +217,8 @@ def recover_addresses_device(hashes: bytes, rs: bytes, ss: bytes,
 
     # --- host: to affine (one batch inversion) + keccak ----------------
     zj = {}
-    for i in live:
-        if residue[i] and not inf[i] and not bad[i]:
+    for i in range(n):
+        if ok[i] and residue[i] and not inf[i] and not bad[i]:
             z = int.from_bytes(out[i, 66:99].tobytes(), "little")
             if z:
                 zj[i] = z
@@ -150,14 +233,7 @@ def recover_addresses_device(hashes: bytes, rs: bytes, ss: bytes,
         if not residue[i]:
             continue                 # x not on curve
         if bad[i]:
-            # ladder hit a doubling collision: exact host path
-            try:
-                addr = _ref.recover_address_py(
-                    hashes[32 * i:32 * i + 32], r_l[i], s_l[i], recids[i])
-            except ValueError:
-                continue
-            addrs[20 * i:20 * i + 20] = addr
-            okb[i] = 1
+            _redo_collision(hashes, rs, ss, recids, i, addrs, okb)
             continue
         if i not in zinv:
             continue                 # u1*G + u2*R = infinity: invalid

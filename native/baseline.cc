@@ -1,0 +1,407 @@
+// Compiled sequential value-transfer processor — the Go-proxy baseline.
+//
+// The ≥20x north-star target (BASELINE.md) is defined against the Go
+// reference's single-threaded StateProcessor, but no Go toolchain exists
+// in this image (and there is no network egress to install one), so the
+// reference cannot be built here.  This file is the closest honest
+// stand-in: a single-threaded, compiled (C++ -O3) replay of the same
+// transfer workload doing the same per-tx and per-block work the Go hot
+// path does (reference core/state_processor.go:95 loop +
+// core/state/statedb.go IntermediateRoot):
+//
+//   per tx:    ecrecover (libsecp-style ladder, secp256k1.cc) -> sender,
+//              nonce check, balance-requirement check, balance moves
+//   per block: fold touched accounts into a secure Merkle-Patricia trie
+//              (keccak-hashed keys, RLP account encoding, memoized
+//              incremental rehash — the hasher.go/statedb analog) and
+//              compare the root against the block header.
+//
+// Exposed via the C ABI for bench.py.  Big-int balances are unsigned
+// __int128 — ample for the bench workload; inputs above 2^127 are
+// rejected so the Python caller can fall back.
+
+#include <cstdint>
+#include <algorithm>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" void coreth_keccak256(const uint8_t*, uint64_t, uint8_t*);
+extern "C" int coreth_ecrecover(const uint8_t*, const uint8_t*,
+                                const uint8_t*, int, uint8_t*);
+
+namespace {
+
+using u128 = unsigned __int128;
+using Bytes = std::vector<uint8_t>;
+
+// ------------------------------------------------------------------ RLP
+
+void rlp_append_len(Bytes& out, size_t len, uint8_t short_base,
+                    uint8_t long_base) {
+  if (len < 56) {
+    out.push_back(short_base + (uint8_t)len);
+  } else {
+    uint8_t be[8];
+    int n = 0;
+    size_t v = len;
+    while (v) {
+      be[n++] = (uint8_t)(v & 0xff);
+      v >>= 8;
+    }
+    out.push_back(long_base + n);
+    for (int i = n - 1; i >= 0; --i) out.push_back(be[i]);
+  }
+}
+
+void rlp_string(Bytes& out, const uint8_t* data, size_t len) {
+  if (len == 1 && data[0] < 0x80) {
+    out.push_back(data[0]);
+    return;
+  }
+  rlp_append_len(out, len, 0x80, 0xb7);
+  out.insert(out.end(), data, data + len);
+}
+
+void rlp_uint(Bytes& out, u128 v) {
+  uint8_t be[16];
+  int n = 0;
+  while (v) {
+    be[n++] = (uint8_t)(v & 0xff);
+    v >>= 8;
+  }
+  // big-endian, no leading zeros; zero encodes as empty string
+  uint8_t tmp[16];
+  for (int i = 0; i < n; ++i) tmp[i] = be[n - 1 - i];
+  rlp_string(out, tmp, n);
+}
+
+Bytes rlp_list(const Bytes& payload) {
+  Bytes out;
+  rlp_append_len(out, payload.size(), 0xc0, 0xf7);
+  out.insert(out.end(), payload.begin(), payload.end());
+  return out;
+}
+
+// keccak256(rlp("")) / keccak256(rlp(empty list)) constants
+const uint8_t EMPTY_ROOT[32] = {
+    0x56, 0xe8, 0x1f, 0x17, 0x1b, 0xcc, 0x55, 0xa6, 0xff, 0x83, 0x45,
+    0xe6, 0x92, 0xc0, 0xf8, 0x6e, 0x5b, 0x48, 0xe0, 0x1b, 0x99, 0x6c,
+    0xad, 0xc0, 0x01, 0x62, 0x2f, 0xb5, 0xe3, 0x63, 0xb4, 0x21};
+const uint8_t EMPTY_CODE[32] = {
+    0xc5, 0xd2, 0x46, 0x01, 0x86, 0xf7, 0x23, 0x3c, 0x92, 0x7e, 0x7d,
+    0xb2, 0xdc, 0xc7, 0x03, 0xc0, 0xe5, 0x00, 0xb6, 0x53, 0xca, 0x82,
+    0x27, 0x3b, 0x7b, 0xfa, 0xd8, 0x04, 0x5d, 0x85, 0xa4, 0x70};
+
+Bytes account_rlp(u128 balance, uint64_t nonce) {
+  Bytes payload;
+  rlp_uint(payload, nonce);
+  rlp_uint(payload, balance);
+  rlp_string(payload, EMPTY_ROOT, 32);
+  rlp_string(payload, EMPTY_CODE, 32);
+  rlp_uint(payload, 0);  // is_multi_coin
+  return rlp_list(payload);
+}
+
+// ------------------------------------------------------- secure MPT
+
+// Node kinds; keys are 64 uniform-depth nibbles (keccak-hashed
+// addresses) so the trie only ever needs leaf/ext/branch inserts into
+// prefix-free keys — exactly the shape statedb's account trie has.
+struct Node {
+  enum Kind { LEAF, EXT, BRANCH } kind;
+  Bytes path;                      // leaf/ext nibbles
+  Bytes value;                     // leaf value
+  std::unique_ptr<Node> child;     // ext child
+  std::unique_ptr<Node> kids[16];  // branch children
+  // memo: rlp encoding + ref (hash or inline); dirty => recompute
+  Bytes enc;
+  Bytes ref;  // 32-byte hash, or inline rlp (< 32 bytes)
+  bool dirty = true;
+
+  explicit Node(Kind k) : kind(k) {}
+};
+
+Bytes hex_prefix(const Bytes& nibbles, bool leaf) {
+  Bytes out;
+  uint8_t flag = leaf ? 2 : 0;
+  if (nibbles.size() % 2) {
+    out.push_back((uint8_t)(((flag | 1) << 4) | nibbles[0]));
+    for (size_t i = 1; i + 1 < nibbles.size() + 1; i += 2)
+      out.push_back((uint8_t)((nibbles[i] << 4) | nibbles[i + 1]));
+  } else {
+    out.push_back((uint8_t)(flag << 4));
+    for (size_t i = 0; i + 1 < nibbles.size() + 1 && i < nibbles.size();
+         i += 2)
+      out.push_back((uint8_t)((nibbles[i] << 4) | nibbles[i + 1]));
+  }
+  return out;
+}
+
+struct Trie {
+  std::unique_ptr<Node> root;
+
+  void insert(const uint8_t* nibbles, size_t depth, const Bytes& value) {
+    root = insert_node(std::move(root), nibbles, depth, value);
+  }
+
+  std::unique_ptr<Node> insert_node(std::unique_ptr<Node> n,
+                                    const uint8_t* key, size_t len,
+                                    const Bytes& value) {
+    if (!n) {
+      auto leaf = std::make_unique<Node>(Node::LEAF);
+      leaf->path.assign(key, key + len);
+      leaf->value = value;
+      return leaf;
+    }
+    n->dirty = true;
+    if (n->kind == Node::BRANCH) {
+      uint8_t idx = key[0];
+      n->kids[idx] =
+          insert_node(std::move(n->kids[idx]), key + 1, len - 1, value);
+      return n;
+    }
+    // common prefix with leaf/ext path
+    size_t cp = 0;
+    while (cp < n->path.size() && cp < len && n->path[cp] == key[cp]) ++cp;
+    if (n->kind == Node::LEAF) {
+      if (cp == n->path.size() && cp == len) {
+        n->value = value;  // overwrite
+        return n;
+      }
+    } else if (cp == n->path.size()) {  // ext fully matched
+      n->child = insert_node(std::move(n->child), key + cp, len - cp, value);
+      return n;
+    }
+    // split at cp
+    auto branch = std::make_unique<Node>(Node::BRANCH);
+    // old side
+    uint8_t old_idx = n->path[cp];
+    if (n->kind == Node::LEAF) {
+      auto old_leaf = std::make_unique<Node>(Node::LEAF);
+      old_leaf->path.assign(n->path.begin() + cp + 1, n->path.end());
+      old_leaf->value = std::move(n->value);
+      branch->kids[old_idx] = std::move(old_leaf);
+    } else {
+      if (cp + 1 == n->path.size()) {
+        branch->kids[old_idx] = std::move(n->child);
+      } else {
+        auto old_ext = std::make_unique<Node>(Node::EXT);
+        old_ext->path.assign(n->path.begin() + cp + 1, n->path.end());
+        old_ext->child = std::move(n->child);
+        branch->kids[old_idx] = std::move(old_ext);
+      }
+    }
+    // new side (uniform-depth keys => never exhausted at a split)
+    auto new_leaf = std::make_unique<Node>(Node::LEAF);
+    new_leaf->path.assign(key + cp + 1, key + len);
+    new_leaf->value = value;
+    branch->kids[key[cp]] = std::move(new_leaf);
+    if (cp > 0) {
+      auto ext = std::make_unique<Node>(Node::EXT);
+      ext->path.assign(key, key + cp);
+      ext->child = std::move(branch);
+      return ext;
+    }
+    return branch;
+  }
+
+  // memoized encode: fills enc/ref, clears dirty
+  const Bytes& encode(Node* n) {
+    if (!n->dirty && !n->ref.empty()) return n->ref;
+    Bytes payload;
+    if (n->kind == Node::LEAF) {
+      Bytes hp = hex_prefix(n->path, true);
+      rlp_string(payload, hp.data(), hp.size());
+      rlp_string(payload, n->value.data(), n->value.size());
+    } else if (n->kind == Node::EXT) {
+      Bytes hp = hex_prefix(n->path, false);
+      rlp_string(payload, hp.data(), hp.size());
+      const Bytes& cref = encode(n->child.get());
+      if (cref.size() == 32) {
+        rlp_string(payload, cref.data(), 32);
+      } else {
+        payload.insert(payload.end(), cref.begin(), cref.end());
+      }
+    } else {
+      for (int i = 0; i < 16; ++i) {
+        if (!n->kids[i]) {
+          payload.push_back(0x80);
+          continue;
+        }
+        const Bytes& cref = encode(n->kids[i].get());
+        if (cref.size() == 32) {
+          rlp_string(payload, cref.data(), 32);
+        } else {
+          payload.insert(payload.end(), cref.begin(), cref.end());
+        }
+      }
+      payload.push_back(0x80);  // empty branch value
+    }
+    n->enc = rlp_list(payload);
+    if (n->enc.size() >= 32) {
+      n->ref.resize(32);
+      coreth_keccak256(n->enc.data(), n->enc.size(), n->ref.data());
+    } else {
+      n->ref = n->enc;  // inline
+    }
+    n->dirty = false;
+    return n->ref;
+  }
+
+  void hash_root(uint8_t out[32]) {
+    if (!root) {
+      std::memcpy(out, EMPTY_ROOT, 32);
+      return;
+    }
+    const Bytes& ref = encode(root.get());
+    if (ref.size() == 32) {
+      std::memcpy(out, ref.data(), 32);
+    } else {
+      coreth_keccak256(root->enc.data(), root->enc.size(), out);
+    }
+  }
+};
+
+struct AddrHash {
+  size_t operator()(const std::string& k) const {
+    size_t h;
+    std::memcpy(&h, k.data(), sizeof(h));
+    return h;
+  }
+};
+
+struct Account {
+  u128 balance = 0;
+  uint64_t nonce = 0;
+};
+
+u128 load_u128_be32(const uint8_t* p, bool* too_big) {
+  for (int i = 0; i < 16; ++i)
+    if (p[i]) *too_big = true;
+  u128 v = 0;
+  for (int i = 16; i < 32; ++i) v = (v << 8) | p[i];
+  if (p[16] & 0x80) *too_big = true;  // keep headroom for sums
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Packed tx record layout (byte offsets):
+//   sighash 0:32 | r 32:64 | s 64:96 | recid 96 | to 97:117
+//   | value 117:149 | fee 149:181 | required 181:213 | nonce 213:221
+//   => 221 bytes per record
+// accounts: addr20 | balance32 | nonce8 => 60 bytes
+// Returns 0 on success; 1 root mismatch; 2 invalid sig; 3 nonce/balance
+// check failed; 4 unsupported big value.
+int coreth_baseline_replay(const uint8_t* txs, const uint64_t* block_off,
+                           uint64_t n_blocks, const uint8_t* roots,
+                           const uint8_t* coinbases,
+                           const uint8_t* genesis_accounts,
+                           uint64_t n_accounts, double* phases) {
+  constexpr size_t REC = 221;
+  std::unordered_map<std::string, Account, AddrHash> state;
+  state.reserve(1 << 14);
+  bool too_big = false;
+  for (uint64_t i = 0; i < n_accounts; ++i) {
+    const uint8_t* p = genesis_accounts + 60 * i;
+    Account a;
+    a.balance = load_u128_be32(p + 20, &too_big);
+    uint64_t nonce = 0;
+    for (int j = 0; j < 8; ++j) nonce = (nonce << 8) | p[52 + j];
+    a.nonce = nonce;
+    state.emplace(std::string((const char*)p, 20), a);
+  }
+  if (too_big) return 4;
+
+  // seed the trie with genesis accounts (hashed keys)
+  Trie trie;
+  uint8_t nib[64], hk[32];
+  for (auto& kv : state) {
+    coreth_keccak256((const uint8_t*)kv.first.data(), 20, hk);
+    for (int i = 0; i < 32; ++i) {
+      nib[2 * i] = hk[i] >> 4;
+      nib[2 * i + 1] = hk[i] & 0x0f;
+    }
+    trie.insert(nib, 64, account_rlp(kv.second.balance, kv.second.nonce));
+  }
+  uint8_t root[32];
+  trie.hash_root(root);
+
+  double t_sender = 0, t_exec = 0, t_trie = 0;
+  auto now = []() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + 1e-9 * ts.tv_nsec;
+  };
+
+  std::vector<std::string> touched;
+  for (uint64_t b = 0; b < n_blocks; ++b) {
+    touched.clear();
+    std::string coinbase((const char*)(coinbases + 20 * b), 20);
+    for (uint64_t t = block_off[b]; t < block_off[b + 1]; ++t) {
+      const uint8_t* r = txs + REC * t;
+      // --- sender recovery (the sender_cacher.go work, sequential)
+      double t0 = now();
+      uint8_t sender[20];
+      if (!coreth_ecrecover(r, r + 32, r + 64, r[96], sender)) return 2;
+      t_sender += now() - t0;
+      // --- state transition (state_transition.go TransitionDb scalar)
+      t0 = now();
+      std::string from((const char*)sender, 20);
+      std::string to((const char*)(r + 97), 20);
+      bool big = false;
+      u128 value = load_u128_be32(r + 117, &big);
+      u128 fee = load_u128_be32(r + 149, &big);
+      // required (buyGas pre-check, gas_limit*cap + value) is passed
+      // precomputed; still compared against the live balance here
+      u128 required = load_u128_be32(r + 181, &big);
+      if (big) return 4;
+      uint64_t tx_nonce = 0;
+      for (int i = 0; i < 8; ++i) tx_nonce = (tx_nonce << 8) | r[213 + i];
+      Account& fa = state[from];
+      if (fa.nonce != tx_nonce) return 3;
+      if (fa.balance < required || fa.balance < value + fee) return 3;
+      fa.nonce += 1;
+      fa.balance -= value + fee;
+      state[to].balance += value;
+      state[coinbase].balance += fee;
+      touched.push_back(from);
+      touched.push_back(to);
+      t_exec += now() - t0;
+    }
+    touched.push_back(coinbase);
+    // --- per-block trie fold + incremental rehash (IntermediateRoot)
+    // dedupe first: the statedb analog folds a deduped dirty set, and
+    // duplicate folds would inflate this baseline's trie phase
+    double t0 = now();
+    std::sort(touched.begin(), touched.end());
+    touched.erase(std::unique(touched.begin(), touched.end()),
+                  touched.end());
+    for (const auto& addr : touched) {
+      const Account& a = state[addr];
+      coreth_keccak256((const uint8_t*)addr.data(), 20, hk);
+      for (int i = 0; i < 32; ++i) {
+        nib[2 * i] = hk[i] >> 4;
+        nib[2 * i + 1] = hk[i] & 0x0f;
+      }
+      trie.insert(nib, 64, account_rlp(a.balance, a.nonce));
+    }
+    trie.hash_root(root);
+    t_trie += now() - t0;
+    if (std::memcmp(root, roots + 32 * b, 32) != 0) return 1;
+  }
+  if (phases) {
+    phases[0] = t_sender;
+    phases[1] = t_exec;
+    phases[2] = t_trie;
+  }
+  return 0;
+}
+
+}  // extern "C"

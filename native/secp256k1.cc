@@ -13,6 +13,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 extern "C" void coreth_keccak256(const uint8_t*, uint64_t, uint8_t*);
 
@@ -483,6 +485,152 @@ int coreth_ecrecover(const uint8_t* hash32, const uint8_t* r32,
   return 1;
 }
 
+// Host-side prep for the DEVICE recovery kernel (crypto/secp_device):
+// validates ranges, computes x = r (+n) and the scalars
+// u1 = -z/r, u2 = s/r mod n with ONE Montgomery batch inversion.
+// Outputs: xs 33-byte LE each, u1/u2 32-byte LE each, ok bytes.
+// Keeps the Python driver off the critical path (bigint modmuls).
+void coreth_recover_prep(const uint8_t* hashes, const uint8_t* rs,
+                         const uint8_t* ss, const uint8_t* recids,
+                         uint64_t n, uint8_t* xs_le33, uint8_t* u1_le32,
+                         uint8_t* u2_le32, uint8_t* ok) {
+  std::vector<U256> r_l(n), prefix(n);
+  std::vector<uint64_t> live;
+  live.reserve(n);
+  U256 acc = ONE;
+  std::memset(xs_le33, 0, 33 * n);
+  std::memset(u1_le32, 0, 32 * n);
+  std::memset(u2_le32, 0, 32 * n);
+  for (uint64_t i = 0; i < n; ++i) {
+    ok[i] = 0;
+    U256 r, s;
+    load_be(r, rs + 32 * i);
+    load_be(s, ss + 32 * i);
+    r_l[i] = r;
+    if (recids[i] > 3 || is_zero(r) || is_zero(s)) continue;
+    if (cmp(r, ORDER) >= 0 || cmp(s, ORDER) >= 0) continue;
+    U256 x = r;
+    if (recids[i] & 2) {
+      if (add_raw(x, r, ORDER)) continue;
+      if (cmp(x, PRIME) >= 0) continue;
+    }
+    // store x as 33-byte little-endian
+    uint8_t be[32];
+    store_be(be, x);
+    for (int j = 0; j < 32; ++j) xs_le33[33 * i + j] = be[31 - j];
+    ok[i] = 1;
+    U256 t;
+    sc_mul(t, acc, r, ORDER);
+    acc = t;
+    prefix[i] = acc;
+    live.push_back(i);
+  }
+  if (live.empty()) return;
+  U256 inv;
+  sc_inv(inv, acc);
+  for (size_t k = live.size(); k-- > 0;) {
+    uint64_t i = live[k];
+    U256 rinv;
+    if (k == 0) {
+      rinv = inv;
+    } else {
+      sc_mul(rinv, inv, prefix[live[k - 1]], ORDER);
+    }
+    U256 t;
+    sc_mul(t, inv, r_l[i], ORDER);
+    inv = t;
+    // u2 = s/r ; u1 = -(z/r)
+    U256 s, z, u1, u2;
+    load_be(s, ss + 32 * i);
+    load_be(z, hashes + 32 * i);
+    while (cmp(z, ORDER) >= 0) {
+      U256 t2;
+      sub_raw(t2, z, ORDER);
+      z = t2;
+    }
+    sc_mul(u2, s, rinv, ORDER);
+    sc_mul(u1, z, rinv, ORDER);
+    if (!is_zero(u1)) {
+      U256 t2;
+      sub_raw(t2, ORDER, u1);
+      u1 = t2;
+    }
+    uint8_t be[32];
+    store_be(be, u1);
+    for (int j = 0; j < 32; ++j) u1_le32[32 * i + j] = be[31 - j];
+    store_be(be, u2);
+    for (int j = 0; j < 32; ++j) u2_le32[32 * i + j] = be[31 - j];
+  }
+}
+
+// Finish for the device kernel: rows = X(33)||Y(33)||Z(33)||flags(3)
+// little-endian Jacobian coordinates (102 bytes/row).  Batch-inverts Z
+// mod p, converts to affine, keccaks to addresses.  Rows whose flags
+// mark a ladder doubling-collision get ok=2 so the Python driver can
+// re-run them on the exact path.
+void coreth_recover_finish(const uint8_t* rows, uint64_t n,
+                           const uint8_t* ok_in, uint8_t* out20,
+                           uint8_t* ok) {
+  auto load_le33 = [](U256& v, const uint8_t* p) {
+    uint8_t be[32];
+    for (int j = 0; j < 32; ++j) be[j] = p[31 - j];
+    load_be(v, be);
+  };
+  std::vector<U256> z_l(n), prefix(n);
+  std::vector<uint64_t> fin;
+  fin.reserve(n);
+  U256 acc = ONE;
+  for (uint64_t i = 0; i < n; ++i) {
+    ok[i] = 0;
+    const uint8_t* row = rows + 102 * i;
+    uint8_t inf = row[99], bad = row[100], residue = row[101];
+    if (!ok_in[i] || !residue) continue;
+    if (bad) {
+      ok[i] = 2;  // caller re-runs on the exact host path
+      continue;
+    }
+    if (inf) continue;
+    U256 z;
+    load_le33(z, row + 66);
+    if (is_zero(z)) continue;
+    z_l[i] = z;
+    U256 t;
+    fe_mul(t, acc, z);
+    acc = t;
+    prefix[i] = acc;
+    fin.push_back(i);
+  }
+  if (fin.empty()) return;
+  U256 inv;
+  fe_inv(inv, acc);
+  for (size_t k = fin.size(); k-- > 0;) {
+    uint64_t i = fin[k];
+    U256 zinv;
+    if (k == 0) {
+      zinv = inv;
+    } else {
+      fe_mul(zinv, inv, prefix[fin[k - 1]]);
+    }
+    U256 t;
+    fe_mul(t, inv, z_l[i]);
+    inv = t;
+    const uint8_t* row = rows + 102 * i;
+    U256 xj, yj, zi2, ax, ay;
+    load_le33(xj, row);
+    load_le33(yj, row + 33);
+    fe_sqr(zi2, zinv);
+    fe_mul(ax, xj, zi2);
+    fe_mul(t, zi2, zinv);
+    fe_mul(ay, yj, t);
+    uint8_t pub[64], digest[32];
+    store_be(pub, ax);
+    store_be(pub + 32, ay);
+    coreth_keccak256(pub, 64, digest);
+    std::memcpy(out20 + 20 * i, digest + 12, 20);
+    ok[i] = 1;
+  }
+}
+
 // Test hook: field multiplication mod p over big-endian 32-byte operands.
 // Exists so the carry-fold edge cases of fe_mul stay regression-tested from
 // Python (see tests/test_crypto.py).
@@ -497,12 +645,31 @@ void coreth_test_fe_mul(const uint8_t* a32, const uint8_t* b32,
 
 // Batched recovery: packed 32-byte hashes / r / s, recid bytes.
 // out: packed 20-byte addresses; ok[i] = 1 on success.
+// Strided across hardware threads — the C++ twin of the reference's
+// GOMAXPROCS sender cacher (core/sender_cacher.go:49-80).  Degenerates
+// to the sequential loop on single-core hosts.
 void coreth_ecrecover_batch(const uint8_t* hashes, const uint8_t* rs,
                             const uint8_t* ss, const uint8_t* recids,
                             uint64_t n, uint8_t* out, uint8_t* ok) {
-  for (uint64_t i = 0; i < n; ++i)
-    ok[i] = (uint8_t)coreth_ecrecover(hashes + 32 * i, rs + 32 * i,
-                                      ss + 32 * i, recids[i], out + 20 * i);
+  unsigned nthreads = std::thread::hardware_concurrency();
+  if (nthreads < 2 || n < 2 * nthreads) {
+    for (uint64_t i = 0; i < n; ++i)
+      ok[i] = (uint8_t)coreth_ecrecover(hashes + 32 * i, rs + 32 * i,
+                                        ss + 32 * i, recids[i],
+                                        out + 20 * i);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  for (unsigned w = 0; w < nthreads; ++w) {
+    workers.emplace_back([=]() {
+      for (uint64_t i = w; i < n; i += nthreads)
+        ok[i] = (uint8_t)coreth_ecrecover(hashes + 32 * i, rs + 32 * i,
+                                          ss + 32 * i, recids[i],
+                                          out + 20 * i);
+    });
+  }
+  for (auto& t : workers) t.join();
 }
 
 }  // extern "C"
