@@ -183,7 +183,6 @@ class BlockChain:
             r.block_hash = block.hash()
             r.transaction_index = i
         self._blocks[block.hash()] = _Entry(block, receipts)
-        self._preferred = block
         self.timers.total += _time.monotonic() - t_start
         self.timers.blocks += 1
 
@@ -205,6 +204,11 @@ class BlockChain:
                 "accepted block is not a child of the last accepted block")
         entry.status = "accepted"
         self._canonical[block.number] = block_hash
+        # preference follows acceptance unless consensus moved it to a
+        # competing branch already (SetPreference is the external
+        # authority — insert never touches it, blockchain.go:980)
+        if self._preferred.hash() == block.parent_hash:
+            self._preferred = block
         self.last_accepted = block
 
     def reject(self, block_hash: bytes) -> None:

@@ -1,0 +1,19 @@
+"""Avalanche VM integration layer — the snowman plugin boundary.
+
+Twin of reference plugin/ (vm.go, block.go, main.go): the consensus
+engine drives the chain exclusively through this surface —
+initialize / buildBlock / parseBlock / getBlock / setPreference on the
+VM, and Verify / Accept / Reject on blocks — optionally across a
+process boundary via the local-socket RPC service (service.py, the
+rpcchainvm.Serve twin).
+"""
+
+from coreth_tpu.plugin.block import PluginBlock, Status
+from coreth_tpu.plugin.vm import VM
+from coreth_tpu.plugin.genesis_json import parse_genesis_json
+from coreth_tpu.plugin.service import VMClient, VMServer, serve
+
+__all__ = [
+    "PluginBlock", "Status", "VM", "VMClient", "VMServer",
+    "parse_genesis_json", "serve",
+]

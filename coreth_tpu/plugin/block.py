@@ -1,0 +1,78 @@
+"""snowman.Block adapter around types.Block.
+
+Twin of reference plugin/evm/block.go: Verify = validate + insert into
+the chain without committing (the chain keeps it as a processing
+sibling); Accept / Reject are the consensus decisions
+(block.go:177/:269/:325).  Block IDs are the 32-byte block hashes.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from coreth_tpu.types import Block
+
+
+class Status(enum.Enum):
+    UNKNOWN = "unknown"
+    PROCESSING = "processing"
+    ACCEPTED = "accepted"
+    REJECTED = "rejected"
+
+
+class PluginBlock:
+    """One consensus-facing block (plugin/evm/block.go:149)."""
+
+    def __init__(self, vm, block: Block):
+        self.vm = vm
+        self.block = block
+        self.status = Status.UNKNOWN
+
+    # ------------------------------------------------------------ identity
+    @property
+    def id(self) -> bytes:
+        return self.block.hash()
+
+    @property
+    def parent_id(self) -> bytes:
+        return self.block.header.parent_hash
+
+    @property
+    def height(self) -> int:
+        return self.block.number
+
+    @property
+    def timestamp(self) -> int:
+        return self.block.time
+
+    def bytes(self) -> bytes:
+        return self.block.encode()
+
+    # ----------------------------------------------------------- consensus
+    def verify(self) -> None:
+        """Syntactic + semantic verification and insertion as a
+        processing block (block.go:325 Verify -> :366 verify ->
+        InsertBlockManual with writes).  Re-verifying a decided block
+        is a legal snowman call and must not resurrect it to
+        processing (block.go status check)."""
+        if self.status in (Status.ACCEPTED, Status.REJECTED):
+            return
+        self.vm.chain.insert_block(self.block)
+        self.status = Status.PROCESSING
+        self.vm._register(self)
+
+    def accept(self) -> None:
+        """Consensus accepted this block (block.go:177)."""
+        self.vm.chain.accept(self.id)
+        self.status = Status.ACCEPTED
+        self.vm._on_accept(self)
+
+    def reject(self) -> None:
+        """Consensus rejected this block (block.go:269)."""
+        self.vm.chain.reject(self.id)
+        self.status = Status.REJECTED
+
+    def __repr__(self) -> str:  # debugging aid
+        return (f"PluginBlock(height={self.height}, "
+                f"id={self.id.hex()[:12]}, status={self.status.value})")

@@ -1,0 +1,204 @@
+"""Local-socket RPC boundary for the VM — the rpcchainvm twin.
+
+Twin of reference plugin/main.go:33 (rpcchainvm.Serve): the consensus
+engine lives in another process and drives the VM over a wire protocol.
+Here the transport is a unix domain socket carrying newline-delimited
+JSON frames ({"id", "method", "params"} -> {"id", "result"} |
+{"id", "error"}); byte-valued fields travel hex-encoded.  The method
+surface mirrors the snowman ChainVM + Block interfaces:
+
+  initialize, buildBlock, parseBlock, getBlock, setPreference,
+  lastAccepted, issueTx, blockVerify, blockAccept, blockReject,
+  blockStatus, mempoolStats, health, shutdown
+
+VMServer hosts a VM instance; VMClient is the in-Python consensus-side
+stub (the role AvalancheGo's rpcchainvm client plays).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from coreth_tpu.plugin.vm import VM, VMError
+from coreth_tpu.types import Transaction
+
+
+def _blk_info(blk) -> dict:
+    return {
+        "id": blk.id.hex(),
+        "parentId": blk.parent_id.hex(),
+        "height": blk.height,
+        "timestamp": blk.timestamp,
+        "status": blk.status.value,
+        "bytes": blk.bytes().hex(),
+    }
+
+
+class VMServer:
+    """Serves one VM over a unix socket (rpcchainvm.Serve role)."""
+
+    def __init__(self, vm: Optional[VM] = None):
+        self.vm = vm or VM()
+        self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
+        self._thread: Optional[threading.Thread] = None
+        # one VM, many connections: the real rpcchainvm relies on the
+        # VM's internal locks; this VM has none, so serialize here
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ dispatch
+    def handle(self, method: str, params: dict):
+        vm = self.vm
+        if method == "initialize":
+            vm.initialize(params["genesisBytes"],
+                          bytes.fromhex(params.get("configBytes", "")))
+            return _blk_info(vm.last_accepted())
+        if method == "buildBlock":
+            return _blk_info(vm.build_block())
+        if method == "parseBlock":
+            return _blk_info(vm.parse_block(bytes.fromhex(params["bytes"])))
+        if method == "getBlock":
+            return _blk_info(vm.get_block(bytes.fromhex(params["id"])))
+        if method == "setPreference":
+            vm.set_preference(bytes.fromhex(params["id"]))
+            return {}
+        if method == "lastAccepted":
+            return _blk_info(vm.last_accepted())
+        if method == "issueTx":
+            vm.issue_tx(Transaction.decode(bytes.fromhex(params["tx"])))
+            return {}
+        if method == "blockVerify":
+            blk = vm.get_block(bytes.fromhex(params["id"]))
+            blk.verify()
+            return _blk_info(blk)
+        if method == "blockAccept":
+            blk = vm.get_block(bytes.fromhex(params["id"]))
+            blk.accept()
+            return _blk_info(blk)
+        if method == "blockReject":
+            blk = vm.get_block(bytes.fromhex(params["id"]))
+            blk.reject()
+            return _blk_info(blk)
+        if method == "blockStatus":
+            return {"status":
+                    vm.get_block(bytes.fromhex(params["id"])).status.value}
+        if method == "mempoolStats":
+            pending, queued = vm.mempool_stats()
+            return {"pending": pending, "queued": queued}
+        if method == "pollEngineMessage":
+            return {"message":
+                    vm.to_engine.popleft() if vm.to_engine else None}
+        if method == "health":
+            return vm.health()
+        if method == "shutdown":
+            vm.shutdown()
+            return {}
+        raise VMError(f"unknown method {method!r}")
+
+    # ----------------------------------------------------------- transport
+    def serve(self, path: str) -> None:
+        """Bind the socket and serve in a daemon thread."""
+        if os.path.exists(path):
+            os.unlink(path)
+        handle = self.handle
+
+        lock = self._lock
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):  # noqa: A003
+                for line in self.rfile:
+                    req = None
+                    try:
+                        req = json.loads(line)
+                        with lock:
+                            result = handle(req["method"],
+                                            req.get("params", {}))
+                        resp = {"id": req.get("id"), "result": result}
+                    except Exception as e:  # noqa: BLE001 — wire error
+                        rid = req.get("id") if isinstance(req, dict) \
+                            else None
+                        resp = {"id": rid,
+                                "error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        self._server = socketserver.ThreadingUnixStreamServer(path, Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def serve(vm: VM, path: str) -> VMServer:
+    """Serve `vm` at the unix-socket `path` (plugin/main.go:33 role)."""
+    server = VMServer(vm)
+    server.serve(path)
+    return server
+
+
+class VMClient:
+    """Consensus-side stub speaking the wire protocol."""
+
+    def __init__(self, path: str):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(path)
+        self._file = self.sock.makefile("rwb")
+        self._next_id = 0
+
+    def call(self, method: str, **params):
+        self._next_id += 1
+        frame = {"id": self._next_id, "method": method, "params": params}
+        self._file.write((json.dumps(frame) + "\n").encode())
+        self._file.flush()
+        resp = json.loads(self._file.readline())
+        if "error" in resp:
+            raise VMError(resp["error"])
+        return resp["result"]
+
+    # convenience wrappers mirroring the ChainVM surface
+    def initialize(self, genesis_json: str):
+        return self.call("initialize", genesisBytes=genesis_json)
+
+    def build_block(self):
+        return self.call("buildBlock")
+
+    def parse_block(self, data: bytes):
+        return self.call("parseBlock", bytes=data.hex())
+
+    def get_block(self, block_id: bytes):
+        return self.call("getBlock", id=block_id.hex())
+
+    def set_preference(self, block_id: bytes):
+        return self.call("setPreference", id=block_id.hex())
+
+    def last_accepted(self):
+        return self.call("lastAccepted")
+
+    def issue_tx(self, tx_bytes: bytes):
+        return self.call("issueTx", tx=tx_bytes.hex())
+
+    def block_verify(self, block_id: bytes):
+        return self.call("blockVerify", id=block_id.hex())
+
+    def block_accept(self, block_id: bytes):
+        return self.call("blockAccept", id=block_id.hex())
+
+    def block_reject(self, block_id: bytes):
+        return self.call("blockReject", id=block_id.hex())
+
+    def poll_engine_message(self):
+        return self.call("pollEngineMessage")["message"]
+
+    def close(self) -> None:
+        self._file.close()
+        self.sock.close()

@@ -1,0 +1,146 @@
+"""The snowman ChainVM facade.
+
+Twin of reference plugin/evm/vm.go: Initialize (:368) wires the chain,
+tx pool and miner from genesis bytes; buildBlock (:1262) assembles a
+block from the mempool; parseBlock (:1317) / getBlock (:1347) /
+SetPreference (:1359) complete the consensus-facing surface.  Blocks
+returned from here are PluginBlock adapters whose Verify/Accept/Reject
+drive the underlying BlockChain.
+
+The engine-notification channel (`to_engine`) carries PendingTxs
+messages the way plugin/evm/block_builder.go:91 signals AvalancheGo to
+call BuildBlock.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Union
+
+from coreth_tpu.chain import BlockChain
+from coreth_tpu.miner import Miner
+from coreth_tpu.plugin.block import PluginBlock, Status
+from coreth_tpu.plugin.genesis_json import parse_genesis_json
+from coreth_tpu.txpool import TxPool
+from coreth_tpu.types import Block, Transaction
+
+PENDING_TXS = "PendingTxs"  # the message on the toEngine channel
+
+
+class VMError(Exception):
+    pass
+
+
+class VM:
+    """Consensus-driven EVM execution engine (vm.go:242)."""
+
+    def __init__(self, clock=_time.time):
+        self.clock = clock
+        self.initialized = False
+        self.chain: Optional[BlockChain] = None
+        self.txpool: Optional[TxPool] = None
+        self.miner: Optional[Miner] = None
+        self._blocks: Dict[bytes, PluginBlock] = {}
+        self.to_engine: Deque[str] = deque()
+        self.preferred_id: Optional[bytes] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def initialize(self, genesis_bytes: Union[bytes, str, dict],
+                   config_bytes: bytes = b"") -> None:
+        """VM.Initialize (vm.go:368): decode genesis, build the chain
+        stack.  config_bytes (the per-chain JSON config, vm.go:379) is
+        accepted and currently ignored field-by-field."""
+        if self.initialized:
+            raise VMError("already initialized")
+        genesis = parse_genesis_json(genesis_bytes)
+        self.chain = BlockChain(genesis)
+        self.txpool = TxPool(genesis.config, self.chain)
+        self.miner = Miner(genesis.config, self.chain, self.txpool,
+                           engine=self.chain.engine, clock=self.clock)
+        g = self.chain.genesis_block
+        gb = PluginBlock(self, g)
+        gb.status = Status.ACCEPTED
+        self._blocks[gb.id] = gb
+        self.preferred_id = gb.id
+        self.initialized = True
+
+    def shutdown(self) -> None:
+        self.initialized = False
+
+    def health(self) -> dict:
+        return {"healthy": self.initialized}
+
+    # -------------------------------------------------------------- blocks
+    def _require_init(self) -> None:
+        if not self.initialized:
+            raise VMError("vm not initialized")
+
+    def _register(self, blk: PluginBlock) -> None:
+        self._blocks[blk.id] = blk
+
+    def _on_accept(self, blk: PluginBlock) -> None:
+        # drop included txs from the pool (txpool reset loop analog)
+        self.txpool.reset()
+
+    def build_block(self) -> PluginBlock:
+        """buildBlock (vm.go:1262): assemble from pending txs and verify
+        immediately (the built block enters processing state)."""
+        self._require_init()
+        pending, _ = self.txpool.stats()
+        if pending == 0:
+            raise VMError("no pending transactions")
+        block = self.miner.generate_block()
+        blk = PluginBlock(self, block)
+        blk.verify()
+        return blk
+
+    def parse_block(self, data: bytes) -> PluginBlock:
+        """parseBlock (vm.go:1317): decode wire bytes; returns the
+        cached adapter when the block is already known."""
+        self._require_init()
+        block = Block.decode(data)
+        existing = self._blocks.get(block.hash())
+        if existing is not None:
+            return existing
+        blk = PluginBlock(self, block)
+        self._blocks[blk.id] = blk
+        return blk
+
+    def get_block(self, block_id: bytes) -> PluginBlock:
+        """getBlock (vm.go:1347)."""
+        self._require_init()
+        blk = self._blocks.get(block_id)
+        if blk is None:
+            raise VMError(f"block {block_id.hex()} not found")
+        return blk
+
+    def set_preference(self, block_id: bytes) -> None:
+        """SetPreference (vm.go:1359): the chain head used for building."""
+        self._require_init()
+        self.chain.set_preference(block_id)
+        self.preferred_id = block_id
+        # re-anchor the pool on the new head (the reference resets the
+        # pool on head events; without this the miner would build from
+        # pending state computed against the old branch)
+        self.txpool.reset()
+
+    def last_accepted(self) -> PluginBlock:
+        self._require_init()
+        return self._blocks[self.chain.last_accepted.hash()]
+
+    # ------------------------------------------------------------- mempool
+    def issue_tx(self, tx: Transaction) -> None:
+        """Feed a transaction into the pool and, on success, signal the
+        consensus engine to build (block_builder.go:129
+        signalTxsReady)."""
+        self._require_init()
+        errs = self.txpool.add_remotes([tx])
+        if errs and errs[0] is not None:
+            raise errs[0]
+        if not self.to_engine or self.to_engine[-1] != PENDING_TXS:
+            self.to_engine.append(PENDING_TXS)
+
+    def mempool_stats(self):
+        self._require_init()
+        return self.txpool.stats()

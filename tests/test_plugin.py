@@ -1,0 +1,205 @@
+"""Plugin/VM boundary: snowman VM facade + Block adapter + RPC service.
+
+Mirrors the reference's full-VM-without-a-cluster strategy
+(plugin/evm/vm_test.go GenesisVM :241): boot a complete VM from genesis
+JSON, feed txs, and simulate consensus by calling
+buildBlock/parseBlock/Verify/Accept/Reject directly — and through the
+local-socket service (the rpcchainvm boundary twin).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.plugin import (
+    PluginBlock, Status, VM, VMClient, parse_genesis_json, serve,
+)
+from coreth_tpu.plugin.vm import VMError
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+
+GWEI = 10**9
+KEY = 0xBADD00D5
+ADDR = priv_to_address(KEY)
+KEY2 = 0xFACE
+ADDR2 = priv_to_address(KEY2)
+CHAIN_ID = 43111
+
+
+def genesis_json() -> str:
+    """Genesis with every Avalanche phase active from epoch 0 (the
+    TEST_CHAIN_CONFIG shape, serialized the way AvalancheGo hands the
+    VM its genesis bytes)."""
+    config = {
+        "chainId": CHAIN_ID,
+        "homesteadBlock": 0, "eip150Block": 0, "eip155Block": 0,
+        "eip158Block": 0, "byzantiumBlock": 0,
+        "constantinopleBlock": 0, "petersburgBlock": 0,
+        "istanbulBlock": 0, "muirGlacierBlock": 0,
+        "apricotPhase1BlockTimestamp": 0,
+        "apricotPhase2BlockTimestamp": 0,
+        "apricotPhase3BlockTimestamp": 0,
+        "apricotPhase4BlockTimestamp": 0,
+        "apricotPhase5BlockTimestamp": 0,
+        "apricotPhasePre6BlockTimestamp": 0,
+        "apricotPhase6BlockTimestamp": 0,
+        "apricotPhasePost6BlockTimestamp": 0,
+        "banffBlockTimestamp": 0,
+        "cortinaBlockTimestamp": 0,
+        "durangoBlockTimestamp": 0,
+    }
+    return json.dumps({
+        "config": config,
+        "alloc": {ADDR.hex(): {"balance": hex(10**24)},
+                  ADDR2.hex(): {"balance": hex(10**24)}},
+        "gasLimit": hex(8_000_000),
+        "timestamp": "0x0",
+    })
+
+
+def make_tx(nonce: int, key=KEY, value=1000):
+    return sign_tx(DynamicFeeTx(
+        chain_id_=CHAIN_ID, nonce=nonce, gas_tip_cap_=GWEI,
+        gas_fee_cap_=300 * GWEI, gas=21_000, to=b"\x42" * 20,
+        value=value), key, CHAIN_ID)
+
+
+def genesis_vm(clock=None) -> VM:
+    vm = VM(**({"clock": clock} if clock else {}))
+    vm.initialize(genesis_json())
+    return vm
+
+
+def test_vm_initialize_and_last_accepted():
+    vm = genesis_vm()
+    last = vm.last_accepted()
+    assert last.height == 0
+    assert last.status == Status.ACCEPTED
+    assert vm.get_block(last.id) is last
+    with pytest.raises(VMError):
+        vm.initialize(genesis_json())  # double init refused
+
+
+def test_vm_build_verify_accept_cycle():
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm = genesis_vm(clock)
+    with pytest.raises(VMError):
+        vm.build_block()  # empty mempool
+    vm.issue_tx(make_tx(0))
+    assert vm.to_engine and vm.to_engine[0] == "PendingTxs"
+    blk = vm.build_block()
+    assert blk.status == Status.PROCESSING
+    assert blk.height == 1
+    vm.set_preference(blk.id)
+    blk.accept()
+    assert blk.status == Status.ACCEPTED
+    assert vm.last_accepted().id == blk.id
+    # included tx left the mempool
+    assert vm.mempool_stats() == (0, 0)
+
+
+def test_vm_parse_block_roundtrip_and_second_vm():
+    """A block built by one VM parses, verifies and accepts on another
+    VM booted from the same genesis (the two-node simulation shape,
+    vm_test.go / syncervm_test.go)."""
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm1 = genesis_vm(clock)
+    vm2 = genesis_vm(clock)
+    vm1.issue_tx(make_tx(0))
+    built = vm1.build_block()
+    wire = built.bytes()
+
+    parsed = vm2.parse_block(wire)
+    assert parsed.id == built.id
+    assert parsed.status == Status.UNKNOWN
+    parsed.verify()
+    assert parsed.status == Status.PROCESSING
+    parsed.accept()
+    assert vm2.last_accepted().id == built.id
+    # parse of a known block returns the cached adapter
+    assert vm2.parse_block(wire) is parsed
+
+
+def test_vm_reject_sibling():
+    """Two competing siblings: accepting one rejects the other
+    (consensus decides; the chain keeps both as processing until then)."""
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm = genesis_vm(clock)
+    vm.issue_tx(make_tx(0))
+    a = vm.build_block()
+    # competing sibling: same height, different coinbase extra tx mix
+    vm.issue_tx(make_tx(0, key=KEY2))
+    b = vm.build_block()
+    assert a.id != b.id
+    assert a.height == b.height == 1
+    a.accept()
+    b.reject()
+    assert a.status == Status.ACCEPTED
+    assert b.status == Status.REJECTED
+    assert vm.last_accepted().id == a.id
+
+
+def test_vm_service_over_socket(tmp_path):
+    """Drive the full cycle through the rpcchainvm-twin local-socket
+    service: initialize -> issueTx -> buildBlock -> parse on a second
+    served VM -> verify -> accept."""
+    sock1 = str(tmp_path / "vm1.sock")
+    server = serve(VM(), sock1)
+    try:
+        client = VMClient(sock1)
+        genesis_info = client.initialize(genesis_json())
+        assert genesis_info["height"] == 0
+        tx = make_tx(0)
+        client.issue_tx(tx.encode())
+        assert client.poll_engine_message() == "PendingTxs"
+        built = client.build_block()
+        assert built["status"] == "processing"
+        assert built["height"] == 1
+        client.set_preference(bytes.fromhex(built["id"]))
+        accepted = client.block_accept(bytes.fromhex(built["id"]))
+        assert accepted["status"] == "accepted"
+        last = client.last_accepted()
+        assert last["id"] == built["id"]
+        # errors cross the wire as failures, not hangs
+        with pytest.raises(VMError):
+            client.build_block()  # empty mempool again
+        client.close()
+    finally:
+        server.close()
+
+
+def test_parse_genesis_json_storage_and_code():
+    g = parse_genesis_json(json.dumps({
+        "config": {"chainId": 7},
+        "alloc": {
+            "11" * 20: {"balance": "0x64", "nonce": "0x1",
+                        "code": "0x6001",
+                        "storage": {"0x01": "0x02"}},
+        },
+        "gasLimit": "0x1000",
+    }))
+    assert g.config.chain_id == 7
+    acct = g.alloc[b"\x11" * 20]
+    assert acct.balance == 100 and acct.nonce == 1
+    assert acct.code == b"\x60\x01"
+    assert acct.storage[(1).to_bytes(32, "big")] == (2).to_bytes(32, "big")
+    assert g.config.apricot_phase1_time is None  # fork keys absent
