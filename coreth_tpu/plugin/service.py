@@ -127,7 +127,13 @@ class VMServer:
                         (json.dumps(resp) + "\n").encode())
                     self.wfile.flush()
 
-        self._server = socketserver.ThreadingUnixStreamServer(path, Handler)
+        class Server(socketserver.ThreadingUnixStreamServer):
+            # handler threads block in rfile reads while clients hold
+            # their sockets open; non-daemon threads would deadlock
+            # server_close() and interpreter exit
+            daemon_threads = True
+
+        self._server = Server(path, Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
         self._thread.start()

@@ -1,0 +1,299 @@
+"""Atomic transactions: wire format, semantic verify, EVMStateTransfer,
+atomic trie indexing, shared-memory application.
+
+End-to-end shape mirrors the reference's vm_test.go import/export
+tests: seed shared memory with an X-chain UTXO, build a signed
+ImportTx, assemble a block carrying it as ExtData via the engine
+callbacks, re-validate that block on a second chain sharing the same
+memory hub, accept it, and watch the UTXO disappear + the EVM balance
+appear — bit-identical roots throughout.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.atomic import (
+    AtomicBackend, AtomicTrie, ChainContext, EVMInput, EVMOutput, Memory,
+    TransferableInput, TransferableOutput, Tx, UnsignedExportTx,
+    UnsignedImportTx, UTXO, X2C_RATE, decode_ext_data, encode_ext_data,
+    make_callbacks, short_id,
+)
+from coreth_tpu.atomic.shared_memory import Element, Requests
+from coreth_tpu.atomic.tx import AtomicTxError
+from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount, generate_chain
+from coreth_tpu.consensus.engine import DummyEngine
+from coreth_tpu.crypto import secp256k1 as secp
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.state import Database
+
+KEY = 0xA70A11C
+ADDR = priv_to_address(KEY)
+CTX = ChainContext()
+GWEI = 10**9
+
+
+def _short_addr(priv: int) -> bytes:
+    # derive the short id from the public key of priv
+    from coreth_tpu.crypto.secp256k1 import _g_mul, _to_affine
+    return short_id(_to_affine(_g_mul(priv)))
+
+
+def seed_import_utxo(memory: Memory, amount: int, owner_priv: int):
+    """Put one AVAX UTXO owned by `owner_priv` into the C-chain's
+    inbound view from the X chain."""
+    out = TransferableOutput(asset_id=CTX.avax_asset_id, amount=amount,
+                            addrs=[_short_addr(owner_priv)])
+    utxo = UTXO(tx_id=b"\x99" * 32, output_index=0, out=out)
+    sm_x = memory.new_shared_memory(CTX.x_chain_id)
+    req = Requests(put_requests=[Element(utxo.input_id(), utxo.encode(),
+                                         out.addrs)])
+    sm_x.apply({CTX.chain_id: req})
+    return utxo
+
+
+def make_import_tx(utxo: UTXO, to: bytes, amount: int) -> Tx:
+    unsigned = UnsignedImportTx(
+        network_id=CTX.network_id, blockchain_id=CTX.chain_id,
+        source_chain=CTX.x_chain_id,
+        imported_inputs=[TransferableInput(
+            tx_id=utxo.tx_id, output_index=utxo.output_index,
+            asset_id=utxo.out.asset_id, amount=utxo.out.amount,
+            sig_indices=[0])],
+        outs=[EVMOutput(address=to, amount=amount,
+                        asset_id=CTX.avax_asset_id)])
+    tx = Tx(unsigned)
+    tx.sign([[KEY]])
+    return tx
+
+
+def test_wire_roundtrip():
+    utxo = UTXO(b"\x01" * 32, 3, TransferableOutput(
+        asset_id=b"\x02" * 32, amount=777, addrs=[b"\x03" * 20]))
+    assert UTXO.decode(utxo.encode()).out.amount == 777
+    tx = make_import_tx(utxo, ADDR, 700)
+    data = tx.encode()
+    tx2 = Tx.decode(data)
+    assert tx2.encode() == data
+    assert isinstance(tx2.unsigned, UnsignedImportTx)
+    assert tx2.unsigned.outs[0].address == ADDR
+    assert tx2.id() == tx.id()
+    # ext data wrapping
+    blob = encode_ext_data([tx])
+    txs = decode_ext_data(blob)
+    assert len(txs) == 1 and txs[0].id() == tx.id()
+    assert decode_ext_data(b"") == []
+
+
+def test_recover_signers_short_id():
+    utxo = UTXO(b"\x01" * 32, 0, TransferableOutput(
+        asset_id=CTX.avax_asset_id, amount=10, addrs=[_short_addr(KEY)]))
+    tx = make_import_tx(utxo, ADDR, 9)
+    signers = tx.recover_signers()
+    assert signers == [[_short_addr(KEY)]]
+
+
+def _chain_with_atomics(memory: Memory, pending_holder: list):
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDR: GenesisAccount(balance=10**20)})
+    db = Database()
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    cb = make_callbacks(backend, CFG,
+                        pending_atomic_txs=lambda: pending_holder)
+    engine = DummyEngine(cb=cb)
+    engine.set_config(CFG)
+    chain = BlockChain(genesis, db=db, engine=engine)
+    return chain, backend, genesis, db
+
+
+def test_import_tx_end_to_end():
+    """Build an ExtData block from an ImportTx, validate it on a second
+    chain sharing the memory hub, accept, and verify every effect."""
+    memory = Memory()
+    import_amount = 5_000_000_000  # nAVAX
+    utxo = seed_import_utxo(memory, import_amount, KEY)
+    # burn enough AVAX for the AP5 fixed + dynamic fee
+    credited = import_amount - 5_000_000  # burn covers fixed+dynamic fee
+    tx = make_import_tx(utxo, ADDR, credited)
+
+    pending = [tx]
+    chain_a, backend_a, genesis, _ = _chain_with_atomics(memory, pending)
+    # build the block via the miner path (FinalizeAndAssemble packs
+    # ExtData through on_finalize_and_assemble)
+    from coreth_tpu.miner import Miner
+    from coreth_tpu.txpool import TxPool
+    import itertools
+    clock = itertools.count(1000, 10).__next__
+    pool = TxPool(CFG, chain_a)
+    miner = Miner(CFG, chain_a, pool, engine=chain_a.engine, clock=clock)
+    block = miner.generate_block()
+    assert block.ext_data() != b""
+    pending.clear()
+
+    # second chain, same memory hub: validates + accepts the wire block
+    chain_b, backend_b, _, db_b = _chain_with_atomics(memory, [])
+    chain_b.insert_block(block)
+    chain_b.accept(block.hash())
+    root = backend_b.accept(block.hash())
+    # EVM balance credited at the x2c rate
+    statedb = chain_b.state_at(block.root)
+    assert statedb.get_balance(ADDR) == 10**20 + credited * X2C_RATE
+    # consumed UTXO is gone from the inbound view
+    sm = memory.new_shared_memory(CTX.chain_id)
+    with pytest.raises(KeyError):
+        sm.get(CTX.x_chain_id, [utxo.input_id()])
+    # the atomic trie indexed the height
+    assert backend_b.trie.get(block.number) is not None
+    assert root == backend_b.trie.root()
+
+
+def test_import_insufficient_burn_rejected():
+    memory = Memory()
+    utxo = seed_import_utxo(memory, 1_000, KEY)
+    tx = make_import_tx(utxo, ADDR, 1_000)  # burns nothing
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    rules = CFG.rules(1, 1000)
+    with pytest.raises(AtomicTxError, match="insufficient AVAX burned"):
+        backend.semantic_verify(tx, base_fee=25 * GWEI, rules=rules)
+
+
+def test_import_foreign_utxo_rejected():
+    """Signature by a key that does not own the UTXO fails verify."""
+    memory = Memory()
+    utxo = seed_import_utxo(memory, 5_000_000_000, 0xDEAD)  # other owner
+    tx = make_import_tx(utxo, ADDR, 1_000)  # signed by KEY
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    rules = CFG.rules(1, 1000)
+    with pytest.raises(AtomicTxError, match="not owned"):
+        backend.semantic_verify(tx, base_fee=None, rules=rules)
+
+
+def test_export_tx_state_transfer_and_utxo_creation():
+    """ExportTx debits the EVM account (nonce-guarded), and accept
+    lands a spendable UTXO in the destination chain's inbound space."""
+    memory = Memory()
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    from coreth_tpu.mpt import EMPTY_ROOT
+    from coreth_tpu.state import StateDB
+    statedb = StateDB(EMPTY_ROOT, Database())
+    statedb.add_balance(ADDR, 10 * X2C_RATE * X2C_RATE)
+
+    export_amount = 3 * X2C_RATE  # nAVAX
+    unsigned = UnsignedExportTx(
+        network_id=CTX.network_id, blockchain_id=CTX.chain_id,
+        destination_chain=CTX.x_chain_id,
+        ins=[EVMInput(address=ADDR, amount=4 * X2C_RATE,
+                      asset_id=CTX.avax_asset_id, nonce=0)],
+        exported_outputs=[TransferableOutput(
+            asset_id=CTX.avax_asset_id, amount=export_amount,
+            addrs=[_short_addr(KEY)])])
+    tx = Tx(unsigned)
+    tx.sign([[KEY]])
+
+    unsigned.evm_state_transfer(CTX, statedb)
+    assert statedb.get_balance(ADDR) == \
+        10 * X2C_RATE * X2C_RATE - 4 * X2C_RATE * X2C_RATE
+    assert statedb.get_nonce(ADDR) == 1
+    # wrong nonce now fails
+    with pytest.raises(AtomicTxError, match="invalid nonce"):
+        unsigned.evm_state_transfer(CTX, statedb)
+
+    backend.insert_txs(b"\xB1" * 32, 1, [tx])
+    backend.accept(b"\xB1" * 32)
+    # destination chain sees the new UTXO, indexed by owner trait
+    sm_x = memory.new_shared_memory(CTX.x_chain_id)
+    found = sm_x.indexed(CTX.chain_id, [_short_addr(KEY)])
+    assert len(found) == 1
+    utxo = UTXO.decode(found[0])
+    assert utxo.out.amount == export_amount
+    assert utxo.tx_id == tx.id()
+
+
+def test_atomic_trie_commit_interval():
+    trie = AtomicTrie(commit_interval=4)
+    req = {b"\x58" * 32: Requests(remove_requests=[b"\x01" * 32])}
+    for h in (1, 2, 3):
+        trie.update_trie(h, req)
+        committed, _ = trie.accept_trie(h)
+        assert not committed
+    trie.update_trie(4, req)
+    committed, root = trie.accept_trie(4)
+    assert committed
+    assert trie.last_committed_height == 4
+    # reopen from the committed root: indexed heights resolve
+    reopened = AtomicTrie(node_db=trie.node_db, root=root)
+    for h in (1, 2, 3, 4):
+        assert reopened.get(h) is not None
+    assert reopened.get(9) is None
+
+
+def test_reject_discards_pending_atomic_state():
+    memory = Memory()
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    utxo = seed_import_utxo(memory, 5_000_000_000, KEY)
+    tx = make_import_tx(utxo, ADDR, 1)
+    backend.insert_txs(b"\xB2" * 32, 1, [tx])
+    backend.reject(b"\xB2" * 32)
+    # nothing applied: the UTXO is still there, trie unindexed
+    sm = memory.new_shared_memory(CTX.chain_id)
+    assert sm.get(CTX.x_chain_id, [utxo.input_id()])
+    assert backend.trie.get(1) is None
+
+
+def test_export_unsigned_rejected():
+    """An export with no/foreign credentials must fail semantic verify
+    (PublicKeyToEthAddress ownership check, export_tx.go)."""
+    memory = Memory()
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    rules = CFG.rules(1, 1000)
+    unsigned = UnsignedExportTx(
+        network_id=CTX.network_id, blockchain_id=CTX.chain_id,
+        destination_chain=CTX.x_chain_id,
+        ins=[EVMInput(address=ADDR, amount=4 * X2C_RATE,
+                      asset_id=CTX.avax_asset_id, nonce=0)],
+        exported_outputs=[TransferableOutput(
+            asset_id=CTX.avax_asset_id, amount=X2C_RATE,
+            addrs=[_short_addr(KEY)])])
+    tx = Tx(unsigned, creds=[])  # unsigned entirely
+    with pytest.raises(AtomicTxError, match="credential count"):
+        backend.semantic_verify(tx, base_fee=None, rules=rules)
+    tx.sign([[0xDEAD]])  # signed by a key that is NOT the debited addr
+    with pytest.raises(AtomicTxError, match="not signed by its address"):
+        backend.semantic_verify(tx, base_fee=None, rules=rules)
+    tx.sign([[KEY]])  # the owner: passes
+    backend.semantic_verify(tx, base_fee=None, rules=rules)
+
+
+def test_import_duplicate_input_rejected():
+    memory = Memory()
+    utxo = seed_import_utxo(memory, 5_000_000_000, KEY)
+    unsigned = UnsignedImportTx(
+        network_id=CTX.network_id, blockchain_id=CTX.chain_id,
+        source_chain=CTX.x_chain_id,
+        imported_inputs=[TransferableInput(
+            tx_id=utxo.tx_id, output_index=0,
+            asset_id=utxo.out.asset_id, amount=utxo.out.amount,
+            sig_indices=[0])] * 2,  # same UTXO twice
+        outs=[EVMOutput(address=ADDR, amount=9_000_000_000,
+                        asset_id=CTX.avax_asset_id)])
+    tx = Tx(unsigned)
+    tx.sign([[KEY], [KEY]])
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    with pytest.raises(AtomicTxError, match="duplicate input"):
+        backend.semantic_verify(tx, None, CFG.rules(1, 1000))
+
+
+def test_import_empty_credential_rejected():
+    """creds=[[]] (right credential count, zero sigs) must not bypass
+    the ownership check."""
+    memory = Memory()
+    utxo = seed_import_utxo(memory, 5_000_000_000, 0xDEAD)
+    tx = make_import_tx(utxo, ADDR, 1_000)
+    tx.creds = [[]]
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    with pytest.raises(AtomicTxError, match="signature count"):
+        backend.semantic_verify(tx, None, CFG.rules(1, 1000))
