@@ -5,8 +5,7 @@ Twin of reference plugin/evm/atomic_backend.go (:28 AtomicBackend,
 :420 InsertTxs, :252 ApplyToSharedMemory) and atomic_state.go: every
 verified block's atomic operations are tracked per block hash; Accept
 writes them into the height-indexed AtomicTrie and applies them to
-SharedMemory (with a crash-recovery cursor so a partially applied
-batch resumes); Reject discards them.
+SharedMemory; Reject discards them.
 
 make_callbacks() wires the ConsensusCallbacks the dummy engine invokes
 during block processing (vm.go:986 onExtraStateChange): decode ExtData,
@@ -62,9 +61,6 @@ class AtomicBackend:
         self.trie = trie or AtomicTrie()
         # blockHash -> (height, requests) for verified, undecided blocks
         self._pending: Dict[bytes, Tuple[int, Dict[bytes, Requests]]] = {}
-        # crash-recovery cursor: the height whose ops are mid-apply
-        # (ApplyToSharedMemory resume point, atomic_backend.go:373)
-        self.apply_cursor: Optional[int] = None
 
     # -------------------------------------------------------------- verify
     def semantic_verify(self, tx: Tx, base_fee: Optional[int],
@@ -86,8 +82,12 @@ class AtomicBackend:
             if len(signers) != len(tx.unsigned.imported_inputs):
                 raise AtomicTxError("credential count mismatch")
             keys = [i.input_id() for i in tx.unsigned.imported_inputs]
-            utxo_bytes = self.shared_memory.get(
-                tx.unsigned.source_chain, keys)
+            try:
+                utxo_bytes = self.shared_memory.get(
+                    tx.unsigned.source_chain, keys)
+            except KeyError as e:
+                raise AtomicTxError(
+                    f"missing UTXO {e.args[0]}") from None
             for inp, raw, sigs in zip(tx.unsigned.imported_inputs,
                                       utxo_bytes, signers):
                 utxo = UTXO.decode(raw)
@@ -95,6 +95,13 @@ class AtomicBackend:
                     raise AtomicTxError("asset mismatch")
                 if utxo.out.amount != inp.amount:
                     raise AtomicTxError("amount mismatch")
+                # secp256k1fx VerifyTransfer: spendable only when the
+                # locktime has no hold and exactly threshold sigs sign
+                if utxo.out.locktime != 0:
+                    raise AtomicTxError("UTXO is locktimed")
+                if len(inp.sig_indices) != utxo.out.threshold:
+                    raise AtomicTxError(
+                        "signature indices != UTXO threshold")
                 if len(sigs) != len(inp.sig_indices):
                     raise AtomicTxError("signature count mismatch")
                 for sig_idx, addr in zip(inp.sig_indices, sigs):
@@ -131,9 +138,7 @@ class AtomicBackend:
             return self.trie.root()
         self.trie.update_trie(height, requests)
         self.trie.accept_trie(height)
-        self.apply_cursor = height
         self.shared_memory.apply(requests)
-        self.apply_cursor = None
         return self.trie.root()
 
     def reject(self, block_hash: bytes) -> None:

@@ -67,12 +67,20 @@ class SharedMemory:
         in the PEER's inbound view (exports)."""
         for peer_chain, req in requests.items():
             inbound = self.memory._space(peer_chain, self.chain_id)
+            in_traits = self.memory._traits(peer_chain, self.chain_id)
+            in_rev = self.memory._key_traits(peer_chain, self.chain_id)
             for k in req.remove_requests:
                 inbound.pop(k, None)
+                for t in in_rev.pop(k, []):
+                    lst = in_traits.get(t)
+                    if lst and k in lst:
+                        lst.remove(k)
             out_space = self.memory._space(self.chain_id, peer_chain)
             out_traits = self.memory._traits(self.chain_id, peer_chain)
+            out_rev = self.memory._key_traits(self.chain_id, peer_chain)
             for el in req.put_requests:
                 out_space[el.key] = el.value
+                out_rev[el.key] = list(el.traits)
                 for t in el.traits:
                     out_traits.setdefault(t, []).append(el.key)
 
@@ -85,12 +93,18 @@ class Memory:
         self._spaces: Dict[Tuple[bytes, bytes], Dict[bytes, bytes]] = {}
         self._trait_idx: Dict[Tuple[bytes, bytes],
                               Dict[bytes, List[bytes]]] = {}
+        # reverse map key -> traits so removes can prune the index
+        self._key_trait_idx: Dict[Tuple[bytes, bytes],
+                                  Dict[bytes, List[bytes]]] = {}
 
     def _space(self, from_chain: bytes, to_chain: bytes):
         return self._spaces.setdefault((from_chain, to_chain), {})
 
     def _traits(self, from_chain: bytes, to_chain: bytes):
         return self._trait_idx.setdefault((from_chain, to_chain), {})
+
+    def _key_traits(self, from_chain: bytes, to_chain: bytes):
+        return self._key_trait_idx.setdefault((from_chain, to_chain), {})
 
     def new_shared_memory(self, chain_id: bytes) -> SharedMemory:
         return SharedMemory(self, chain_id)

@@ -54,7 +54,29 @@ class _Entry:
 
 class BlockChain:
     def __init__(self, genesis: Genesis, db: Optional[Database] = None,
-                 engine: Optional[DummyEngine] = None):
+                 engine: Optional[DummyEngine] = None,
+                 chain_kv=None, commit_interval: int = 4096,
+                 archive: bool = False):
+        """chain_kv: optional rawdb.KVStore making the chain durable —
+        accepted blocks/receipts/canonical index persist immediately,
+        trie nodes every `commit_interval` accepts (state_manager.go
+        policy); reopening on the same store resumes at the last
+        accepted block, re-executing any tail whose trie state was not
+        yet flushed (blockchain.go:1750 reprocessState)."""
+        self.chain_kv = chain_kv
+        self.trie_writer = None
+        if chain_kv is not None:
+            if db is not None:
+                raise ValueError(
+                    "pass either db or chain_kv, not both: the durable "
+                    "chain owns its Database via PersistentNodeDict")
+            from coreth_tpu.rawdb import (
+                PersistentCodeDict, PersistentNodeDict, TrieWriter)
+            nodes = PersistentNodeDict(chain_kv)
+            db = Database(node_db=nodes,
+                          code_db=PersistentCodeDict(chain_kv))
+            self.trie_writer = TrieWriter(chain_kv, nodes,
+                                          commit_interval, archive)
         self.db = db if db is not None else Database()
         self.config: ChainConfig = genesis.config
         self.engine = engine or DummyEngine()
@@ -68,6 +90,58 @@ class BlockChain:
         self.last_accepted: Block = g
         self._preferred: Block = g
         self.timers = PhaseTimers()
+        if chain_kv is not None:
+            self._load_last_state()
+
+    # ---------------------------------------------------------- durability
+    def _load_last_state(self) -> None:
+        """loadLastState + reprocessState (blockchain.go:685, :1750):
+        resume at the persisted last-accepted block, re-executing any
+        accepted tail whose trie state never reached disk."""
+        from coreth_tpu.rawdb import schema
+        g = self.genesis_block
+        if schema.read_last_accepted(self.chain_kv) is None:
+            # fresh database: persist genesis + its state
+            schema.write_block(self.chain_kv, g)
+            schema.write_canonical_hash(self.chain_kv, 0, g.hash())
+            schema.write_last_accepted(self.chain_kv, g.hash())
+            self.trie_writer.force_flush(0, g.root)
+            return
+        last_hash = schema.read_last_accepted(self.chain_kv)
+        last = schema.read_block_by_hash(self.chain_kv, last_hash)
+        if last is None:
+            raise BadBlockError("missing last accepted block body")
+        _, flushed_height = schema.read_last_flushed_root(self.chain_kv)
+        flushed_height = flushed_height or 0
+        # walk the canonical chain from the last flushed state forward,
+        # re-executing into memory (insert_block reads parent state
+        # through the disk-backed node dict)
+        for height in range(flushed_height, last.number + 1):
+            h = schema.read_canonical_hash(self.chain_kv, height)
+            block = schema.read_block(self.chain_kv, height, h)
+            if block is None:
+                raise BadBlockError(f"missing canonical block {height}")
+            self._canonical[height] = h
+            if height == 0 or h == g.hash():
+                continue
+            if height <= flushed_height:
+                # state already on disk: resident without re-execution
+                self._blocks[h] = _Entry(block, status="accepted")
+            else:
+                self.insert_block(block)
+                self._blocks[h].status = "accepted"
+            self.last_accepted = block
+            self._preferred = block
+        # canonical index below the flushed height stays on disk only;
+        # get_block_by_number falls back to the store
+
+    def close(self) -> None:
+        """Flush every pending trie node + the store (clean shutdown)."""
+        if self.trie_writer is not None:
+            self.trie_writer.force_flush(self.last_accepted.number,
+                                         self.last_accepted.root)
+        if self.chain_kv is not None:
+            self.chain_kv.close()
 
     # ------------------------------------------------------------- accessors
     def current_block(self) -> Block:
@@ -75,14 +149,37 @@ class BlockChain:
 
     def get_block(self, block_hash: bytes) -> Optional[Block]:
         entry = self._blocks.get(block_hash)
-        return entry.block if entry else None
+        if entry is not None:
+            return entry.block
+        if self.chain_kv is not None:
+            from coreth_tpu.rawdb import schema
+            return schema.read_block_by_hash(self.chain_kv, block_hash)
+        return None
 
     def get_block_by_number(self, number: int) -> Optional[Block]:
         h = self._canonical.get(number)
-        return self._blocks[h].block if h else None
+        if h is not None and h in self._blocks:
+            return self._blocks[h].block
+        if self.chain_kv is not None:
+            from coreth_tpu.rawdb import schema
+            h = h or schema.read_canonical_hash(self.chain_kv, number)
+            if h is not None:
+                return schema.read_block(self.chain_kv, number, h)
+        return None
 
     def get_receipts(self, block_hash: bytes) -> Optional[List[Receipt]]:
         entry = self._blocks.get(block_hash)
+        if entry is not None and entry.receipts:
+            return entry.receipts
+        if self.chain_kv is not None:
+            from coreth_tpu.rawdb import schema
+            from coreth_tpu.types.receipt import decode_consensus_receipt
+            num = schema.read_block_number(self.chain_kv, block_hash)
+            if num is not None:
+                raw = schema.read_raw_receipts(self.chain_kv, num,
+                                               block_hash)
+                if raw is not None:
+                    return [decode_consensus_receipt(r) for r in raw]
         return entry.receipts if entry else None
 
     def has_state(self, root: bytes) -> bool:
@@ -210,6 +307,17 @@ class BlockChain:
         if self._preferred.hash() == block.parent_hash:
             self._preferred = block
         self.last_accepted = block
+        if self.chain_kv is not None:
+            from coreth_tpu.rawdb import schema
+            schema.write_block(self.chain_kv, block)
+            schema.write_canonical_hash(self.chain_kv, block.number,
+                                        block_hash)
+            if entry.receipts is not None:
+                schema.write_receipts(self.chain_kv, block,
+                                      entry.receipts)
+            schema.write_last_accepted(self.chain_kv, block_hash)
+            self.trie_writer.accept_trie(block.number, block.root)
+            self.chain_kv.flush()
 
     def reject(self, block_hash: bytes) -> None:
         """Reject (blockchain.go:1074)."""

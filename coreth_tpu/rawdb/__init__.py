@@ -1,0 +1,19 @@
+"""Durable chain storage: KV stores, schema accessors, node backing.
+
+Twin of reference core/rawdb/ + the leveldb seam (plugin/evm/
+database.go).  FileDB is the on-disk store (append-only log with
+crash-safe reopen); schema.py holds the typed accessors; PersistentNodeDict bridges trie code (which expects a mapping) to a
+KVStore with deferred flushing for the commit-interval policy
+(core/state_manager.go).
+"""
+
+from coreth_tpu.rawdb.kv import FileDB, KVStore, MemDB
+from coreth_tpu.rawdb import schema
+from coreth_tpu.rawdb.state_manager import (
+    PersistentCodeDict, PersistentNodeDict, TrieWriter)
+
+__all__ = [
+    "FileDB", "KVStore", "MemDB", "PersistentCodeDict",
+    "PersistentNodeDict",
+    "TrieWriter", "schema",
+]

@@ -1,0 +1,123 @@
+"""Chain database schema + typed accessors.
+
+Twin of reference core/rawdb/schema.go + accessors_chain.go: one KV
+namespace holding headers, bodies, receipts, the canonical number ->
+hash index, the hash -> number index, code, and the acceptor pointers.
+Key layout follows the reference byte-for-byte in spirit:
+
+  'h' ++ num8 ++ hash   -> header RLP
+  'H' ++ hash           -> num8 (headerNumberPrefix)
+  'h' ++ num8 ++ 'n'    -> canonical hash (headerHashSuffix)
+  'b' ++ num8 ++ hash   -> body (block RLP incl. extdata)
+  'r' ++ num8 ++ hash   -> receipts RLP (consensus encoding)
+  'c' ++ code_hash      -> contract code
+  'LastAcceptedKey'     -> hash of the last accepted block
+  'LastRoot'            -> last trie root flushed to disk + its height
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from coreth_tpu import rlp
+from coreth_tpu.rawdb.kv import KVStore
+from coreth_tpu.types import Block, Receipt
+
+HEADER_PREFIX = b"h"
+HEADER_NUMBER_PREFIX = b"H"
+HEADER_HASH_SUFFIX = b"n"
+BODY_PREFIX = b"b"
+RECEIPTS_PREFIX = b"r"
+CODE_PREFIX = b"c"
+LAST_ACCEPTED_KEY = b"LastAcceptedKey"
+LAST_ROOT_KEY = b"LastRoot"
+
+
+def _num8(n: int) -> bytes:
+    return n.to_bytes(8, "big")
+
+
+# --------------------------------------------------------------- blocks
+
+def write_block(kv: KVStore, block: Block) -> None:
+    h = block.hash()
+    num = _num8(block.number)
+    kv.put(BODY_PREFIX + num + h, block.encode())
+    kv.put(HEADER_NUMBER_PREFIX + h, num)
+
+
+def read_block(kv: KVStore, number: int, block_hash: bytes
+               ) -> Optional[Block]:
+    raw = kv.get(BODY_PREFIX + _num8(number) + block_hash)
+    return Block.decode(raw) if raw is not None else None
+
+
+def read_block_number(kv: KVStore, block_hash: bytes) -> Optional[int]:
+    raw = kv.get(HEADER_NUMBER_PREFIX + block_hash)
+    return int.from_bytes(raw, "big") if raw is not None else None
+
+
+def read_block_by_hash(kv: KVStore, block_hash: bytes) -> Optional[Block]:
+    num = read_block_number(kv, block_hash)
+    if num is None:
+        return None
+    return read_block(kv, num, block_hash)
+
+
+# ------------------------------------------------------------ canonical
+
+def write_canonical_hash(kv: KVStore, number: int,
+                         block_hash: bytes) -> None:
+    kv.put(HEADER_PREFIX + _num8(number) + HEADER_HASH_SUFFIX, block_hash)
+
+
+def read_canonical_hash(kv: KVStore, number: int) -> Optional[bytes]:
+    return kv.get(HEADER_PREFIX + _num8(number) + HEADER_HASH_SUFFIX)
+
+
+# ------------------------------------------------------------- receipts
+
+def write_receipts(kv: KVStore, block: Block,
+                   receipts: List[Receipt]) -> None:
+    payload = rlp.encode([r.encode_consensus() for r in receipts])
+    kv.put(RECEIPTS_PREFIX + _num8(block.number) + block.hash(), payload)
+
+
+def read_raw_receipts(kv: KVStore, number: int,
+                      block_hash: bytes) -> Optional[List[bytes]]:
+    raw = kv.get(RECEIPTS_PREFIX + _num8(number) + block_hash)
+    if raw is None:
+        return None
+    return list(rlp.decode(raw))
+
+
+# ----------------------------------------------------------------- code
+
+def write_code(kv: KVStore, code_hash: bytes, code: bytes) -> None:
+    kv.put(CODE_PREFIX + code_hash, code)
+
+
+def read_code(kv: KVStore, code_hash: bytes) -> Optional[bytes]:
+    return kv.get(CODE_PREFIX + code_hash)
+
+
+# --------------------------------------------------------- accept state
+
+def write_last_accepted(kv: KVStore, block_hash: bytes) -> None:
+    kv.put(LAST_ACCEPTED_KEY, block_hash)
+
+
+def read_last_accepted(kv: KVStore) -> Optional[bytes]:
+    return kv.get(LAST_ACCEPTED_KEY)
+
+
+def write_last_flushed_root(kv: KVStore, root: bytes,
+                            height: int) -> None:
+    kv.put(LAST_ROOT_KEY, root + _num8(height))
+
+
+def read_last_flushed_root(kv: KVStore):
+    raw = kv.get(LAST_ROOT_KEY)
+    if raw is None:
+        return None, None
+    return raw[:32], int.from_bytes(raw[32:], "big")

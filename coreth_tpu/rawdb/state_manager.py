@@ -1,0 +1,135 @@
+"""Commit-interval trie persistence policy.
+
+Twin of reference core/state_manager.go (:74 NewTrieWriter, :115
+cappedMemoryTrieWriter): accepted blocks' trie nodes live in memory and
+are flushed to the durable store only every `commit_interval` accepts
+(4096 on mainnet); a crash between flushes loses at most
+commit_interval blocks of trie state, which reopen re-executes
+(core/blockchain.go:1750 reprocessState).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from coreth_tpu.rawdb.kv import KVStore
+from coreth_tpu.rawdb import schema
+
+
+class PersistentNodeDict(dict):
+    """Trie-node mapping with a KVStore behind it: reads fall through
+    to disk, writes stay in memory on a pending list until flush()
+    copies them down (the deferred side of the commit interval)."""
+
+    PREFIX = b"n"
+
+    def __init__(self, kv: KVStore):
+        super().__init__()
+        self.kv = kv
+        self.pending: List[bytes] = []
+
+    def get(self, key, default=None):
+        if dict.__contains__(self, key):
+            return dict.__getitem__(self, key)
+        v = self.kv.get(self.PREFIX + key)
+        if v is not None:
+            dict.__setitem__(self, key, v)
+            return v
+        return default
+
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key.hex())
+        return v
+
+    def __contains__(self, key):
+        return self.get(key) is not None
+
+    def __setitem__(self, key, value):
+        if not dict.__contains__(self, key):
+            self.pending.append(key)
+        dict.__setitem__(self, key, value)
+
+    def flush(self) -> int:
+        """Write pending nodes to the store; returns the count."""
+        n = 0
+        for key in self.pending:
+            v = dict.get(self, key)
+            if v is not None:
+                self.kv.put(self.PREFIX + key, v)
+                n += 1
+        self.pending = []
+        return n
+
+
+class PersistentCodeDict(dict):
+    """Contract-code mapping over a KVStore ('c' prefix, matching
+    schema.CODE_PREFIX): write-through (code is small and immutable),
+    read-through on miss — so deployed code survives restart."""
+
+    PREFIX = b"c"
+
+    def __init__(self, kv: KVStore):
+        super().__init__()
+        self.kv = kv
+
+    def get(self, key, default=None):
+        if dict.__contains__(self, key):
+            return dict.__getitem__(self, key)
+        v = self.kv.get(self.PREFIX + key)
+        if v is not None:
+            dict.__setitem__(self, key, v)
+            return v
+        return default
+
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key.hex())
+        return v
+
+    def __contains__(self, key):
+        return self.get(key) is not None
+
+    def __setitem__(self, key, value):
+        dict.__setitem__(self, key, value)
+        self.kv.put(self.PREFIX + key, value)
+
+    def items(self):
+        # live view is the union of memory and store; memory wins
+        seen = set()
+        for k, v in dict.items(self):
+            seen.add(k)
+            yield k, v
+        for k, v in self.kv.items():
+            if k[:1] == self.PREFIX and k[1:] not in seen:
+                yield k[1:], v
+
+
+class TrieWriter:
+    """Decides when accepted trie roots reach disk
+    (state_manager.go:74)."""
+
+    def __init__(self, kv: KVStore, nodes: PersistentNodeDict,
+                 commit_interval: int = 4096, archive: bool = False):
+        self.kv = kv
+        self.nodes = nodes
+        self.commit_interval = commit_interval
+        self.archive = archive
+
+    def accept_trie(self, height: int, root: bytes) -> bool:
+        """Called per accepted block; flushes at the interval (or every
+        block in archive mode).  Returns True when a flush happened."""
+        if not self.archive and (self.commit_interval == 0
+                                 or height % self.commit_interval != 0):
+            return False
+        self.nodes.flush()
+        schema.write_last_flushed_root(self.kv, root, height)
+        self.kv.flush()
+        return True
+
+    def force_flush(self, height: int, root: bytes) -> None:
+        self.nodes.flush()
+        schema.write_last_flushed_root(self.kv, root, height)
+        self.kv.flush()

@@ -17,9 +17,13 @@ from coreth_tpu.types.account import EMPTY_CODE_HASH
 
 
 class Database:
-    def __init__(self):
-        self.node_db: Dict[bytes, bytes] = {}
-        self.code_db: Dict[bytes, bytes] = {}
+    def __init__(self, node_db=None, code_db=None):
+        # any mutable mapping works; rawdb.PersistentNodeDict gives the
+        # disk-backed variant with deferred flushing
+        self.node_db: Dict[bytes, bytes] = \
+            node_db if node_db is not None else {}
+        self.code_db: Dict[bytes, bytes] = \
+            code_db if code_db is not None else {}
         self.trie_cache: Dict[bytes, SecureTrie] = {}
         self.max_cached_tries = 128
 

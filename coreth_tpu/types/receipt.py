@@ -77,6 +77,28 @@ class Receipt:
         return bytes([self.tx_type]) + payload
 
 
+def decode_consensus_receipt(data: bytes) -> "Receipt":
+    """Decode the consensus encoding (typed-prefix + RLP) back into a
+    Receipt.  Only consensus fields are recoverable (status, cumulative
+    gas, logs); derived fields stay at defaults — enough for rawdb
+    reads and receipt-root recomputation."""
+    tx_type = 0
+    if data and data[0] < 0x80:
+        tx_type = data[0]
+        data = data[1:]
+    items = rlp.decode(data)
+    status_item, cum_gas, _bloom, logs = items
+    logs_out = [Log(address=l[0], topics=list(l[1]), data=l[2])
+                for l in logs]
+    r = Receipt(tx_type=tx_type, cumulative_gas_used=rlp.decode_uint(cum_gas),
+                logs=logs_out)
+    if len(status_item) == 32:
+        r.post_state = status_item
+    else:
+        r.status = rlp.decode_uint(status_item)
+    return r
+
+
 def bloom9(value: bytes) -> int:
     """Bloom bits for one value as an int (reference bloom9.go:139-159).
 
