@@ -1,0 +1,59 @@
+"""Trie iterators.
+
+Twin of reference trie/iterator.go: a depth-first NodeIterator over
+the resolved structure (yielding path, node kind, hash-or-None, and
+leaf values), plus range-bounded leaf iteration used by the sync
+handlers to answer LeafsRequests.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+from coreth_tpu.mpt.trie import (
+    BRANCH, EXT, LEAF, Trie, key_to_nibbles,
+)
+
+
+def nodes(trie: Trie) -> Iterator[Tuple[bytes, str, Optional[bytes]]]:
+    """DFS over (path_nibbles, kind, hash) for every resolved node;
+    hash is None for inline (<32 byte) nodes."""
+    def walk(node, prefix: bytes):
+        node = trie._resolve(node)
+        if node is None:
+            return
+        encoded, ref = trie._encode_node(node, None)
+        h = ref if isinstance(ref, bytes) and len(ref) == 32 else None
+        kind = node[0]
+        yield prefix, kind, h
+        if kind == EXT:
+            yield from walk(node[2], prefix + node[1])
+        elif kind == BRANCH:
+            for i, c in enumerate(node[1]):
+                if c is not None:
+                    yield from walk(c, prefix + bytes([i]))
+
+    yield from walk(trie.root, b"")
+
+
+def nibbles_to_key(nibbles: bytes) -> bytes:
+    """Inverse of key_to_nibbles for even-length nibble paths."""
+    if len(nibbles) % 2:
+        raise ValueError("odd nibble path has no byte key")
+    return bytes((nibbles[i] << 4) | nibbles[i + 1]
+                 for i in range(0, len(nibbles), 2))
+
+
+def leaves(trie: Trie, start: bytes = b"",
+           limit: Optional[int] = None) -> Iterator[Tuple[bytes, bytes]]:
+    """(key, value) pairs in key order, beginning at `start`
+    (inclusive) — the shape sync/handlers/leafs_request.go walks."""
+    start_nibs = key_to_nibbles(start) if start else b""
+    count = 0
+    for nibs, value in trie.items():
+        if nibs < start_nibs:
+            continue
+        yield nibbles_to_key(nibs), value
+        count += 1
+        if limit is not None and count >= limit:
+            return

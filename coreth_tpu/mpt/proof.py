@@ -1,0 +1,274 @@
+"""Merkle proofs: single-key proofs and range proofs.
+
+Twin of reference trie/proof.go (Prove :36, VerifyProof :100,
+VerifyRangeProof :383).  Range proofs are the state-sync workhorse:
+given a root, a contiguous run of (key, value) leaves, and edge proofs
+for the boundaries, the verifier rebuilds a skeleton trie from the
+proofs, *removes every node inside the claimed range* (so omissions
+cannot hide behind hash references), re-inserts the supplied pairs,
+and accepts iff the recomputed root matches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.mpt.trie import (
+    BRANCH, EXT, HASHREF, LEAF, MissingNodeError, Trie, _MEMO,
+    key_to_nibbles,
+)
+
+
+class BadProofError(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ prove
+
+def prove(trie: Trie, key: bytes) -> List[bytes]:
+    """Collect the RLP encodings of every hashed node on the path from
+    the root towards `key` (trie/proof.go:36 Prove).  Inline (<32 byte)
+    nodes ride embedded in their parents; the root is always included.
+    Works for absent keys too (the path to the divergence point)."""
+    nibbles = key_to_nibbles(key)
+    proof: List[bytes] = []
+    node = trie.root
+    first = True
+    while node is not None:
+        node = trie._resolve(node)
+        if node is None:
+            break
+        encoded, _ref = trie._encode_node(node, None)
+        if first or len(encoded) >= 32:
+            proof.append(encoded)
+        first = False
+        kind = node[0]
+        if kind == LEAF:
+            break
+        if kind == EXT:
+            if nibbles[:len(node[1])] != node[1]:
+                break
+            nibbles = nibbles[len(node[1]):]
+            node = node[2]
+            continue
+        if not nibbles:
+            break
+        nxt = node[1][nibbles[0]]
+        nibbles = nibbles[1:]
+        node = nxt
+    return proof
+
+
+def _proof_db(proof: List[bytes]) -> Dict[bytes, bytes]:
+    return {keccak256(p): p for p in proof}
+
+
+def verify_proof(root: bytes, key: bytes,
+                 proof: List[bytes]) -> Optional[bytes]:
+    """Value of `key` under `root` given its proof, or None when the
+    proof shows absence; raises BadProofError on a broken proof
+    (trie/proof.go:100 VerifyProof)."""
+    if root == EMPTY_ROOT:
+        if proof:
+            raise BadProofError("proof for the empty trie")
+        return None
+    db = _proof_db(proof)
+    if root not in db:
+        raise BadProofError("proof does not include the root node")
+    t = Trie(root_hash=root, db=db)
+    try:
+        return t.get(key)
+    except MissingNodeError as e:
+        raise BadProofError(f"incomplete proof: missing {e}") from None
+
+
+# ------------------------------------------------------------ range proof
+
+def _cmp(a: bytes, b: bytes) -> int:
+    return (a > b) - (a < b)
+
+
+def _invalidate(node):
+    node[_MEMO] = None
+    return node
+
+
+def _unset_ge(trie: Trie, node, l: bytes):
+    """Remove every key >= l from the subtree (left-edge cleanup)."""
+    node = trie._resolve(node)
+    if node is None:
+        return None
+    kind = node[0]
+    if kind == BRANCH:
+        if not l:
+            return None  # every key here is >= the exhausted bound
+        for i in range(l[0] + 1, 16):
+            node[1][i] = None
+        node[1][l[0]] = _unset_ge(trie, node[1][l[0]], l[1:])
+        return _invalidate(node)
+    p = node[1]
+    if kind == EXT:
+        if p == l[:len(p)]:
+            node[2] = _unset_ge(trie, node[2], l[len(p):])
+            if node[2] is None:
+                return None
+            return _invalidate(node)
+        return None if p > l[:len(p)] else node
+    # leaf
+    return None if _cmp(p, l) >= 0 else node
+
+
+def _unset_le(trie: Trie, node, r: bytes):
+    """Remove every key <= r from the subtree (right-edge cleanup)."""
+    node = trie._resolve(node)
+    if node is None:
+        return None
+    kind = node[0]
+    if kind == BRANCH:
+        if not r:
+            # only the (unused in secure tries) branch value can be <= r
+            node[2] = b""
+            return _invalidate(node)
+        for i in range(0, r[0]):
+            node[1][i] = None
+        node[1][r[0]] = _unset_le(trie, node[1][r[0]], r[1:])
+        return _invalidate(node)
+    p = node[1]
+    if kind == EXT:
+        if p == r[:len(p)]:
+            node[2] = _unset_le(trie, node[2], r[len(p):])
+            if node[2] is None:
+                return None
+            return _invalidate(node)
+        return None if p < r[:len(p)] else node
+    # leaf
+    return None if _cmp(p, r) <= 0 else node
+
+
+def _unset_range(trie: Trie, node, l: bytes, r: bytes):
+    """Remove every key in the closed range [l, r] (l < r) from the
+    skeleton, so only the supplied pairs can reconstitute it."""
+    node = trie._resolve(node)
+    if node is None:
+        return None
+    kind = node[0]
+    if kind == BRANCH:
+        if not l or not r:
+            raise BadProofError("boundary key shorter than trie depth")
+        li, ri = l[0], r[0]
+        if li == ri:
+            node[1][li] = _unset_range(trie, node[1][li], l[1:], r[1:])
+            return _invalidate(node)
+        for i in range(li + 1, ri):
+            node[1][i] = None
+        node[1][li] = _unset_ge(trie, node[1][li], l[1:])
+        node[1][ri] = _unset_le(trie, node[1][ri], r[1:])
+        return _invalidate(node)
+    p = node[1]
+    lp, rp = l[:len(p)], r[:len(p)]
+    if kind == EXT:
+        if p == lp and p == rp:
+            node[2] = _unset_range(trie, node[2], l[len(p):], r[len(p):])
+            if node[2] is None:
+                return None
+            return _invalidate(node)
+        if p == lp:            # subtree max < r: only left bound binds
+            node[2] = _unset_ge(trie, node[2], l[len(p):])
+            if node[2] is None:
+                return None
+            return _invalidate(node)
+        if p == rp:            # subtree min > l: only right bound binds
+            node[2] = _unset_le(trie, node[2], r[len(p):])
+            if node[2] is None:
+                return None
+            return _invalidate(node)
+        return None if lp < p < rp else node
+    # leaf: inside the closed range -> removed (pairs re-add it)
+    return None if _cmp(p, l) >= 0 and _cmp(p, r) <= 0 else node
+
+
+def _has_right_element(trie: Trie, nibbles: bytes) -> bool:
+    """Any key strictly greater than `nibbles` under the skeleton?
+    (proof.go hasRightElement)"""
+    node = trie.root
+    while node is not None:
+        node = trie._resolve(node)
+        if node is None:
+            return False
+        kind = node[0]
+        if kind == LEAF:
+            return _cmp(node[1], nibbles) > 0
+        if kind == EXT:
+            p = node[1]
+            if p == nibbles[:len(p)]:
+                nibbles = nibbles[len(p):]
+                node = node[2]
+                continue
+            return p > nibbles[:len(p)]
+        if not nibbles:
+            return any(c is not None for c in node[1])
+        for i in range(nibbles[0] + 1, 16):
+            if node[1][i] is not None:
+                return True
+        node = node[1][nibbles[0]]
+        nibbles = nibbles[1:]
+    return False
+
+
+def verify_range_proof(root: bytes, first_key: bytes, keys: List[bytes],
+                       values: List[bytes],
+                       proof: Optional[List[bytes]]) -> bool:
+    """VerifyRangeProof (trie/proof.go:383).
+
+    keys must be monotonically increasing raw trie keys (already
+    keccak-hashed for secure tries), all >= first_key.  Returns True
+    when more elements exist to the right of the range; raises
+    BadProofError when the proof does not check out.
+
+    proof=None asserts the pairs are the WHOLE trie.
+    """
+    if len(keys) != len(values):
+        raise BadProofError("key/value count mismatch")
+    for i in range(1, len(keys)):
+        if keys[i - 1] >= keys[i]:
+            raise BadProofError("keys out of order")
+    if keys and keys[0] < first_key:
+        raise BadProofError("range starts before first_key")
+
+    if proof is None:
+        # no-proof mode: the pairs claim to be the entire trie
+        t = Trie()
+        for k, v in zip(keys, values):
+            t.update(k, v)
+        if t.hash() != root:
+            raise BadProofError("full-range root mismatch")
+        return False
+
+    db = _proof_db(proof)
+    if root not in db:
+        raise BadProofError("proof does not include the root node")
+    t = Trie(root_hash=root, db=db)
+    first_nibs = key_to_nibbles(first_key)
+
+    try:
+        if not keys:
+            # absence proof: firstKey resolves to nothing and nothing
+            # exists to its right
+            if t.get(first_key) is not None:
+                raise BadProofError("empty range but first_key exists")
+            if _has_right_element(t, first_nibs):
+                raise BadProofError(
+                    "empty range but elements exist past first_key")
+            return False
+        last_nibs = key_to_nibbles(keys[-1])
+        more = _has_right_element(t, last_nibs)
+        t.root = _unset_range(t, t.root, first_nibs, last_nibs)
+        for k, v in zip(keys, values):
+            t.update(k, v)
+        if t.hash() != root:
+            raise BadProofError("range root mismatch")
+        return more
+    except MissingNodeError as e:
+        raise BadProofError(f"incomplete proof: missing {e}") from None

@@ -1,0 +1,169 @@
+"""Merkle proofs + iterators: single proofs, range proofs (incl.
+adversarial omission/extra/tamper), DFS node iteration.
+
+Mirrors the reference trie/proof_test.go strategy: random tries,
+random ranges, and mutation cases that MUST fail.
+"""
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.mpt.iterator import leaves, nibbles_to_key, nodes
+from coreth_tpu.mpt.proof import (
+    BadProofError, prove, verify_proof, verify_range_proof,
+)
+from coreth_tpu.mpt.trie import Trie
+
+RNG = random.Random(42)
+
+
+def build_trie(n=200, seed=1):
+    rng = random.Random(seed)
+    t = Trie()
+    pairs = {}
+    for _ in range(n):
+        k = keccak256(rng.randbytes(8))  # uniform 32-byte keys
+        v = rng.randbytes(rng.randint(1, 40))
+        t.update(k, v)
+        pairs[k] = v
+    return t, dict(sorted(pairs.items()))
+
+
+def test_prove_and_verify_present_keys():
+    t, pairs = build_trie(120)
+    root = t.hash()
+    for k, v in list(pairs.items())[:20]:
+        proof = prove(t, k)
+        assert verify_proof(root, k, proof) == v
+
+
+def test_prove_absent_key():
+    t, pairs = build_trie(60)
+    root = t.hash()
+    absent = keccak256(b"definitely-absent")
+    assert absent not in pairs
+    proof = prove(t, absent)
+    assert verify_proof(root, absent, proof) is None
+
+
+def test_verify_proof_rejects_tampering():
+    t, pairs = build_trie(50)
+    root = t.hash()
+    k = next(iter(pairs))
+    proof = prove(t, k)
+    bad = [proof[0]] + [p[:-1] + bytes([p[-1] ^ 1]) for p in proof[1:]]
+    with pytest.raises(BadProofError):
+        verify_proof(root, k, bad)
+
+
+def test_range_proof_random_ranges():
+    t, pairs = build_trie(200)
+    root = t.hash()
+    keys = list(pairs)
+    for trial in range(12):
+        lo = RNG.randrange(0, len(keys) - 2)
+        hi = RNG.randrange(lo + 1, len(keys))
+        rkeys = keys[lo:hi]
+        rvals = [pairs[k] for k in rkeys]
+        proof = prove(t, rkeys[0]) + prove(t, rkeys[-1])
+        more = verify_range_proof(root, rkeys[0], rkeys, rvals, proof)
+        assert more == (hi < len(keys))
+
+
+def test_range_proof_single_key():
+    t, pairs = build_trie(80)
+    root = t.hash()
+    k = list(pairs)[37]
+    proof = prove(t, k)
+    more = verify_range_proof(root, k, [k], [pairs[k]], proof + proof)
+    assert more is True
+
+
+def test_range_proof_whole_trie_no_proof():
+    t, pairs = build_trie(64)
+    root = t.hash()
+    more = verify_range_proof(root, list(pairs)[0], list(pairs),
+                              list(pairs.values()), None)
+    assert more is False
+    with pytest.raises(BadProofError):
+        verify_range_proof(root, list(pairs)[0], list(pairs)[:-1],
+                           list(pairs.values())[:-1], None)
+
+
+def test_range_proof_detects_omission():
+    """Dropping a middle key from the range MUST break the proof —
+    the property that makes range sync trustless."""
+    t, pairs = build_trie(150)
+    root = t.hash()
+    keys = list(pairs)[20:60]
+    vals = [pairs[k] for k in keys]
+    proof = prove(t, keys[0]) + prove(t, keys[-1])
+    verify_range_proof(root, keys[0], keys, vals, proof)  # sanity
+    with pytest.raises(BadProofError):
+        verify_range_proof(root, keys[0], keys[:15] + keys[16:],
+                           vals[:15] + vals[16:], proof)
+
+
+def test_range_proof_detects_extra_and_tampered():
+    t, pairs = build_trie(150)
+    root = t.hash()
+    keys = list(pairs)[10:40]
+    vals = [pairs[k] for k in keys]
+    proof = prove(t, keys[0]) + prove(t, keys[-1])
+    # extra fabricated key inside the range
+    fake_key = bytes(keys[5][:-1]) + bytes([keys[5][-1] ^ 1])
+    ins = sorted(keys + [fake_key])
+    fake_vals = [pairs.get(k, b"\x01") for k in ins]
+    with pytest.raises(BadProofError):
+        verify_range_proof(root, ins[0], ins, fake_vals, proof)
+    # tampered value
+    bad_vals = list(vals)
+    bad_vals[7] = b"\xEE"
+    with pytest.raises(BadProofError):
+        verify_range_proof(root, keys[0], keys, bad_vals, proof)
+
+
+def test_range_proof_empty_range_absence():
+    t, pairs = build_trie(90)
+    root = t.hash()
+    top = max(pairs)
+    beyond = bytes([min(top[0] + 1, 255)]) + top[1:]
+    if beyond in pairs or beyond <= top:
+        beyond = b"\xff" * 32
+    proof = prove(t, beyond)
+    more = verify_range_proof(root, beyond, [], [], proof)
+    assert more is False
+    # an empty range claimed below existing keys must fail
+    low = b"\x00" * 32
+    proof_low = prove(t, low)
+    with pytest.raises(BadProofError):
+        verify_range_proof(root, low, [], [], proof_low)
+
+
+def test_node_and_leaf_iterators():
+    t, pairs = build_trie(50)
+    # reload from committed nodes only: iteration must resolve from db
+    t2 = Trie(root_hash=t.commit(), db=t.db)
+    got = dict(leaves(t2))
+    assert got == pairs
+    # bounded iteration
+    keys = list(pairs)
+    mid = keys[25]
+    tail = dict(leaves(t2, start=mid))
+    assert list(tail) == keys[25:]
+    part = list(leaves(t2, start=mid, limit=5))
+    assert len(part) == 5
+    # node iterator: every hashed node it reports exists in the db
+    n_hashed = 0
+    for path, kind, h in nodes(t2):
+        if h is not None:
+            assert h in t2.db
+            n_hashed += 1
+    assert n_hashed >= len(pairs)  # every leaf here encodes >= 32 bytes
