@@ -1,0 +1,27 @@
+"""State sync: download a whole world state by verified leaf ranges.
+
+Twin of reference sync/ (client/client.go, statesync/state_syncer.go,
+handlers/leafs_request.go) + plugin/evm/message: a syncing node walks
+the remote account trie in contiguous ranges, each response carrying
+edge Merkle proofs verified locally (mpt/proof.verify_range_proof), and
+recursively fetches storage tries (deduped by root) and contract code.
+Progress markers make the whole process resumable after a crash.
+
+The transport seam is a callable (request -> response); tests wire two
+nodes' handlers together directly, the way the reference fakes its
+message channel (syncervm_test.go:621).
+"""
+
+from coreth_tpu.sync.messages import (
+    BlockRequest, BlockResponse, CodeRequest, CodeResponse, LeafsRequest,
+    LeafsResponse,
+)
+from coreth_tpu.sync.handlers import SyncHandler
+from coreth_tpu.sync.client import SyncClient
+from coreth_tpu.sync.statesync import StateSyncer
+
+__all__ = [
+    "BlockRequest", "BlockResponse", "CodeRequest", "CodeResponse",
+    "LeafsRequest", "LeafsResponse", "StateSyncer", "SyncClient",
+    "SyncHandler",
+]

@@ -1,0 +1,86 @@
+"""Server side of state sync.
+
+Twin of reference sync/handlers/ (leafs_request.go:76 OnLeafsRequest —
+walk the requested trie from `start`, cap the page, attach edge range
+proofs; block_request.go — serve ancestor bodies; code requests by
+hash).  Serves straight from a chain's Database/rawdb stores.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from coreth_tpu.mpt.iterator import leaves
+from coreth_tpu.mpt.proof import prove
+from coreth_tpu.mpt.trie import Trie
+from coreth_tpu.sync.messages import (
+    BlockRequest, BlockResponse, CodeRequest, CodeResponse, LeafsRequest,
+    LeafsResponse, decode_message,
+)
+
+MAX_LEAFS = 1024
+
+
+class SyncHandler:
+    """Answers sync requests for one chain (network_handler.go role)."""
+
+    def __init__(self, db, chain=None):
+        """db: state Database (node_db + code_db); chain: optional
+        BlockChain for block requests."""
+        self.db = db
+        self.chain = chain
+
+    # ------------------------------------------------------------- dispatch
+    def handle(self, raw: bytes) -> bytes:
+        msg = decode_message(raw)
+        if isinstance(msg, LeafsRequest):
+            return self.on_leafs_request(msg).encode()
+        if isinstance(msg, CodeRequest):
+            return self.on_code_request(msg).encode()
+        if isinstance(msg, BlockRequest):
+            return self.on_block_request(msg).encode()
+        raise ValueError(f"unexpected message {type(msg).__name__}")
+
+    # -------------------------------------------------------------- leaves
+    def on_leafs_request(self, req: LeafsRequest) -> LeafsResponse:
+        limit = min(req.limit, MAX_LEAFS)
+        trie = Trie(root_hash=req.root, db=self.db.node_db)
+        keys: List[bytes] = []
+        vals: List[bytes] = []
+        more = False
+        for k, v in leaves(trie, start=req.start, limit=limit + 1):
+            if len(keys) == limit:
+                more = True
+                break
+            keys.append(k)
+            vals.append(v)
+        proof: List[bytes] = []
+        if req.start or more:
+            # edge proofs: the start bound and the last served key
+            # (leafs_request.go:335 range proofs)
+            proof = prove(trie, req.start if req.start
+                          else (keys[0] if keys else b"\x00" * 32))
+            if keys:
+                proof = proof + prove(trie, keys[-1])
+        return LeafsResponse(keys, vals, more, proof)
+
+    # ---------------------------------------------------------------- code
+    def on_code_request(self, req: CodeRequest) -> CodeResponse:
+        return CodeResponse(
+            [self.db.code_db.get(h, b"") for h in req.hashes])
+
+    # -------------------------------------------------------------- blocks
+    def on_block_request(self, req: BlockRequest) -> BlockResponse:
+        out: List[bytes] = []
+        if self.chain is None:
+            return BlockResponse(out)
+        h = req.block_hash
+        for _ in range(req.parents):
+            block = self.chain.get_block(h)
+            if block is None:
+                break
+            out.append(block.encode())
+            if block.number == 0:
+                break
+            h = block.parent_hash
+        return BlockResponse(out)

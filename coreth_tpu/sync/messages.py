@@ -1,0 +1,159 @@
+"""Sync wire messages (plugin/evm/message twin).
+
+LeafsRequest/Response carry verified key ranges (message/
+leafs_request.go); CodeRequest fetches contract bytecode by hash;
+BlockRequest fetches ancestor block bodies.  Encoding rides the same
+linear-codec packer the atomic txs use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from coreth_tpu.atomic.wire import Packer, Unpacker
+
+
+@dataclass
+class LeafsRequest:
+    """Range request against one trie (leafs_request.go:30)."""
+    root: bytes = b"\x00" * 32
+    account: bytes = b""           # set for storage-trie requests
+    start: bytes = b""             # first key (inclusive), raw trie key
+    limit: int = 1024
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(0)
+        p.fixed(self.root, 32)
+        p.var_bytes(self.account)
+        p.var_bytes(self.start)
+        p.u32(self.limit)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LeafsRequest":
+        u = Unpacker(data)
+        assert u.u8() == 0
+        return cls(u.fixed(32), u.var_bytes(), u.var_bytes(), u.u32())
+
+
+@dataclass
+class LeafsResponse:
+    keys: List[bytes] = field(default_factory=list)
+    vals: List[bytes] = field(default_factory=list)
+    more: bool = False
+    proof: List[bytes] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(1)
+        p.u32(len(self.keys))
+        for k, v in zip(self.keys, self.vals):
+            p.var_bytes(k)
+            p.var_bytes(v)
+        p.u8(1 if self.more else 0)
+        p.u32(len(self.proof))
+        for n in self.proof:
+            p.var_bytes(n)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LeafsResponse":
+        u = Unpacker(data)
+        assert u.u8() == 1
+        n = u.u32()
+        keys, vals = [], []
+        for _ in range(n):
+            keys.append(u.var_bytes())
+            vals.append(u.var_bytes())
+        more = bool(u.u8())
+        proof = [u.var_bytes() for _ in range(u.u32())]
+        return cls(keys, vals, more, proof)
+
+
+@dataclass
+class CodeRequest:
+    hashes: List[bytes] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(2)
+        p.u32(len(self.hashes))
+        for h in self.hashes:
+            p.fixed(h, 32)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CodeRequest":
+        u = Unpacker(data)
+        assert u.u8() == 2
+        return cls([u.fixed(32) for _ in range(u.u32())])
+
+
+@dataclass
+class CodeResponse:
+    codes: List[bytes] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(3)
+        p.u32(len(self.codes))
+        for c in self.codes:
+            p.var_bytes(c)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CodeResponse":
+        u = Unpacker(data)
+        assert u.u8() == 3
+        return cls([u.var_bytes() for _ in range(u.u32())])
+
+
+@dataclass
+class BlockRequest:
+    """Fetch `parents` ancestors ending at `block_hash`
+    (message/block_request.go)."""
+    block_hash: bytes = b"\x00" * 32
+    height: int = 0
+    parents: int = 1
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(4)
+        p.fixed(self.block_hash, 32)
+        p.u64(self.height)
+        p.u16(self.parents)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockRequest":
+        u = Unpacker(data)
+        assert u.u8() == 4
+        return cls(u.fixed(32), u.u64(), u.u16())
+
+
+@dataclass
+class BlockResponse:
+    blocks: List[bytes] = field(default_factory=list)  # wire bodies
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(5)
+        p.u32(len(self.blocks))
+        for b in self.blocks:
+            p.var_bytes(b)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockResponse":
+        u = Unpacker(data)
+        assert u.u8() == 5
+        return cls([u.var_bytes() for _ in range(u.u32())])
+
+
+def decode_message(data: bytes):
+    kind = data[0]
+    return {0: LeafsRequest, 1: LeafsResponse, 2: CodeRequest,
+            3: CodeResponse, 4: BlockRequest,
+            5: BlockResponse}[kind].decode(data)

@@ -1,0 +1,157 @@
+"""State sync end-to-end: two nodes wired through an in-memory
+transport, verified leaf ranges, storage tries, code, resume, and
+adversarial servers.
+
+Mirrors the reference's two-VM sync tests (syncervm_test.go:621 — app
+senders wired together, no real network).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.chain import Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.mpt.proof import BadProofError
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.sync import SyncClient, SyncHandler, StateSyncer
+from coreth_tpu.sync.messages import LeafsRequest, LeafsResponse, decode_message
+from coreth_tpu.workloads.erc20 import balance_slot, token_genesis_account
+
+KEYS = [0x9100 + i for i in range(40)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+TOKEN = bytes([0x7A]) * 20
+
+
+def build_source_state():
+    """A state with 40 funded accounts + a token contract holding
+    storage for each + its code."""
+    alloc = {a: GenesisAccount(balance=10**20 + i)
+             for i, a in enumerate(ADDRS)}
+    alloc[TOKEN] = token_genesis_account({a: 10**18 + i
+                                          for i, a in enumerate(ADDRS)})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    return db, gblock.root
+
+
+def test_statesync_end_to_end():
+    src_db, root = build_source_state()
+    handler = SyncHandler(src_db)
+    client = SyncClient(handler.handle)
+    syncer = StateSyncer(client, page=16)  # force many pages
+    dst_db = syncer.sync(root)
+    # synced state opens and matches account-by-account
+    statedb = StateDB(root, dst_db)
+    for i, a in enumerate(ADDRS):
+        assert statedb.get_balance(a) == 10**20 + i
+    # storage + code came along
+    for i, a in enumerate(ADDRS):
+        v = statedb.get_state(TOKEN, balance_slot(a))
+        assert int.from_bytes(v, "big") == 10**18 + i
+    assert statedb.get_code(TOKEN) != b""
+    assert syncer.stats["pages"] > 3
+    assert syncer.stats["storage_tries"] == 1
+    assert syncer.stats["codes"] == 1
+
+
+def test_statesync_resumes_after_crash():
+    src_db, root = build_source_state()
+    handler = SyncHandler(src_db)
+
+    calls = {"n": 0}
+
+    def flaky_transport(payload):
+        calls["n"] += 1
+        if calls["n"] == 4:  # die mid-account-trie
+            raise ConnectionError("boom")
+        return handler.handle(payload)
+
+    progress = {}
+    client = SyncClient(flaky_transport, retries=1)
+    syncer = StateSyncer(client, page=8, progress=progress)
+    with pytest.raises(Exception):
+        syncer.sync(root)
+    assert progress["account_pos"] != b"done"
+
+    # resume with the SAME progress dict on a fresh syncer
+    client2 = SyncClient(handler.handle)
+    syncer2 = StateSyncer(client2, page=8, progress=progress)
+    dst_db = syncer2.sync(root)
+    statedb = StateDB(root, dst_db)
+    assert statedb.get_balance(ADDRS[3]) == 10**20 + 3
+    assert progress["account_pos"] == b"done"
+    assert all(v == b"done" for v in progress["storage"].values())
+
+
+def test_statesync_rejects_omitting_server():
+    """A server that drops a leaf from each full page cannot get its
+    responses accepted."""
+    src_db, root = build_source_state()
+    honest = SyncHandler(src_db)
+
+    def malicious(payload):
+        resp = decode_message(honest.handle(payload))
+        if isinstance(resp, LeafsResponse) and len(resp.keys) > 2:
+            del resp.keys[1], resp.vals[1]  # omit a middle leaf
+        return resp.encode()
+
+    client = SyncClient(malicious, retries=1)
+    syncer = StateSyncer(client, page=16)
+    with pytest.raises(BadProofError):
+        syncer.sync(root)
+
+
+def test_statesync_rejects_tampered_value():
+    src_db, root = build_source_state()
+    honest = SyncHandler(src_db)
+
+    def malicious(payload):
+        resp = decode_message(honest.handle(payload))
+        if isinstance(resp, LeafsResponse) and resp.vals:
+            resp.vals[0] = resp.vals[0] + b"\x01"
+        return resp.encode()
+
+    client = SyncClient(malicious, retries=1)
+    syncer = StateSyncer(client, page=16)
+    with pytest.raises(BadProofError):
+        syncer.sync(root)
+
+
+def test_block_request_hash_chain():
+    from coreth_tpu.chain import BlockChain, generate_chain
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDRS[0]: GenesisAccount(balance=10**24)})
+    db = Database()
+    gblock = genesis.to_block(db)
+
+    def gen(i, bg):
+        bg.add_tx(sign_tx(DynamicFeeTx(
+            chain_id_=CFG.chain_id, nonce=i, gas_tip_cap_=10**9,
+            gas_fee_cap_=300 * 10**9, gas=21_000, to=b"\x31" * 20,
+            value=1), KEYS[0], CFG.chain_id))
+
+    blocks, _ = generate_chain(CFG, gblock, db, 5, gen, gap=2)
+    chain = BlockChain(genesis)
+    chain.insert_chain(blocks)
+    handler = SyncHandler(chain.db, chain=chain)
+    client = SyncClient(handler.handle)
+    got = client.get_blocks(blocks[-1].hash(), blocks[-1].number, 4)
+    assert len(got) == 4
+    # tampering is caught by the hash-chain check
+    def tamper(payload):
+        resp = decode_message(handler.handle(payload))
+        if hasattr(resp, "blocks") and resp.blocks:
+            resp.blocks[0] = resp.blocks[0][:-1] + b"\x00"
+        return resp.encode()
+    from coreth_tpu.sync.client import SyncClientError
+    bad_client = SyncClient(tamper, retries=1)
+    with pytest.raises(SyncClientError):
+        bad_client.get_blocks(blocks[-1].hash(), blocks[-1].number, 2)
