@@ -47,13 +47,41 @@ def nibbles_to_key(nibbles: bytes) -> bytes:
 def leaves(trie: Trie, start: bytes = b"",
            limit: Optional[int] = None) -> Iterator[Tuple[bytes, bytes]]:
     """(key, value) pairs in key order, beginning at `start`
-    (inclusive) — the shape sync/handlers/leafs_request.go walks."""
+    (inclusive) — the shape sync/handlers/leafs_request.go walks.
+
+    Seeks directly to `start` (subtrees entirely below it are never
+    visited), so serving a page costs O(page + depth), not O(trie)."""
     start_nibs = key_to_nibbles(start) if start else b""
     count = 0
-    for nibs, value in trie.items():
-        if nibs < start_nibs:
-            continue
-        yield nibbles_to_key(nibs), value
-        count += 1
+
+    def walk(node, prefix: bytes):
+        nonlocal count
         if limit is not None and count >= limit:
             return
+        node = trie._resolve(node)
+        if node is None:
+            return
+        kind = node[0]
+        if kind == LEAF:
+            full = prefix + node[1]
+            if full >= start_nibs:
+                yield nibbles_to_key(full), node[2]
+                count += 1
+            return
+        if kind == EXT:
+            sub = prefix + node[1]
+            # skip subtrees whose maximal key is still below start
+            if sub >= start_nibs[:len(sub)]:
+                yield from walk(node[2], sub)
+            return
+        for i, c in enumerate(node[1]):
+            if c is None:
+                continue
+            sub = prefix + bytes([i])
+            if sub < start_nibs[:len(sub)]:
+                continue  # entirely left of the start bound
+            yield from walk(c, sub)
+            if limit is not None and count >= limit:
+                return
+
+    yield from walk(trie.root, b"")

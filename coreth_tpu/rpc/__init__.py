@@ -1,0 +1,29 @@
+"""JSON-RPC API surface.
+
+Twin of reference rpc/ (transport + dispatch), internal/ethapi
+(eth_* methods), eth/filters, eth/gasprice, and eth/tracers'
+debug_trace* entry points — assembled over the chain/txpool/miner
+stack the way eth/backend.go wires the Ethereum facade.
+"""
+
+from coreth_tpu.rpc.server import RPCError, RPCServer
+from coreth_tpu.rpc.backend import Backend
+from coreth_tpu.rpc.ethapi import register_eth_api
+from coreth_tpu.rpc.filters import FilterSystem, filter_logs
+from coreth_tpu.rpc.gasprice import Oracle
+from coreth_tpu.rpc.tracers import register_debug_api
+
+__all__ = [
+    "Backend", "FilterSystem", "Oracle", "RPCError", "RPCServer",
+    "filter_logs", "register_debug_api", "register_eth_api",
+]
+
+
+def new_rpc_stack(chain, txpool=None):
+    """Assemble a served API stack (eth/backend.go APIs() role):
+    returns (server, backend)."""
+    backend = Backend(chain, txpool)
+    server = RPCServer()
+    register_eth_api(server, backend)
+    register_debug_api(server, backend)
+    return server, backend

@@ -1,0 +1,172 @@
+"""RPC backend: bridges method handlers to the chain stack.
+
+Twin of reference eth/api_backend.go: block/state resolution by number
+or hash ("latest"/"pending" included), tx-hash lookup, EVM execution
+for eth_call/estimateGas (NoBaseFee + SkipAccountChecks message
+semantics, internal/ethapi), and re-execution with a tracer for the
+debug API (eth/state_accessor.go role — state at block N-1 replayed
+through the processor)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from coreth_tpu.evm import EVM, Config, TxContext
+from coreth_tpu.processor.message import Message
+from coreth_tpu.processor.state_processor import (
+    Processor, new_block_context,
+)
+from coreth_tpu.processor.state_transition import GasPool, apply_message
+from coreth_tpu.rpc.hexutil import to_bytes, to_int
+from coreth_tpu.rpc.server import RPCError
+from coreth_tpu.types import Block, LatestSigner, Receipt, Transaction
+
+
+class Backend:
+    def __init__(self, chain, txpool=None):
+        self.chain = chain
+        self.txpool = txpool
+        self.config = chain.config
+        self.signer = LatestSigner(chain.config.chain_id)
+        # tx hash -> (block hash, index); filled lazily per block
+        self._tx_lookup: dict = {}
+        self._indexed_height = -1
+
+    # ------------------------------------------------------------- blocks
+    def resolve_block(self, tag) -> Block:
+        if tag is None or tag in ("latest", "pending", "accepted"):
+            return self.chain.last_accepted if tag == "accepted" \
+                else self.chain.current_block()
+        if tag == "earliest":
+            return self.chain.genesis_block
+        if isinstance(tag, str):
+            number = int(tag, 16) if tag.startswith("0x") else int(tag)
+        else:
+            number = int(tag)
+        block = self.chain.get_block_by_number(number)
+        if block is None:
+            raise RPCError(f"block {number} not found")
+        return block
+
+    def state_at(self, block: Block):
+        if not self.chain.has_state(block.root):
+            raise RPCError(f"state at block {block.number} unavailable")
+        return self.chain.state_at(block.root)
+
+    # ----------------------------------------------------------- tx index
+    def _index_to(self, height: int) -> None:
+        while self._indexed_height < height:
+            self._indexed_height += 1
+            b = self.chain.get_block_by_number(self._indexed_height)
+            if b is None:
+                continue
+            h = b.hash()
+            for i, tx in enumerate(b.transactions):
+                self._tx_lookup[tx.hash()] = (h, i)
+
+    def tx_by_hash(self, tx_hash: bytes
+                   ) -> Optional[Tuple[Block, Transaction, int]]:
+        self._index_to(self.chain.last_accepted.number)
+        hit = self._tx_lookup.get(tx_hash)
+        if hit is None:
+            return None
+        block = self.chain.get_block(hit[0])
+        return block, block.transactions[hit[1]], hit[1]
+
+    def receipt_by_hash(self, tx_hash: bytes
+                        ) -> Optional[Tuple[Block, Receipt, int]]:
+        found = self.tx_by_hash(tx_hash)
+        if found is None:
+            return None
+        block, _tx, idx = found
+        receipts = self.chain.get_receipts(block.hash())
+        if receipts is None or idx >= len(receipts):
+            return None
+        return block, receipts[idx], idx
+
+    # ------------------------------------------------------------ execute
+    def call(self, args: dict, block: Block, gas_cap: int = 50_000_000):
+        """eth_call semantics (internal/ethapi api.go DoCall): run the
+        message on the block's state with account checks skipped and
+        base-fee enforcement off; returns the ExecutionResult."""
+        statedb = self.state_at(block)
+        msg = self._args_to_message(args, block, gas_cap)
+        ctx = new_block_context(block.header, self.ancestry_hash(block))
+        evm = EVM(ctx, TxContext(origin=msg.from_,
+                                 gas_price=msg.gas_price),
+                  statedb, self.config, Config(no_base_fee=True))
+        return apply_message(evm, msg, GasPool(msg.gas_limit))
+
+    def ancestry_hash(self, block: Block):
+        """BLOCKHASH resolver for execution in `block`'s context —
+        the same ancestry walk consensus execution uses."""
+        parent = self.chain.get_block(block.parent_hash)
+        if parent is None:
+            return None
+        return self.chain._ancestry_hash_fn(parent)
+
+    def _args_to_message(self, args: dict, block: Block,
+                         gas_cap: int) -> Message:
+        gas = to_int(args.get("gas"), gas_cap)
+        return Message(
+            from_=to_bytes(args.get("from")) or b"\x00" * 20,
+            to=to_bytes(args.get("to")) or None,
+            gas_limit=min(gas, gas_cap),
+            gas_price=to_int(args.get("gasPrice")),
+            gas_fee_cap=to_int(args.get("maxFeePerGas"),
+                               to_int(args.get("gasPrice"))),
+            gas_tip_cap=to_int(args.get("maxPriorityFeePerGas"),
+                               to_int(args.get("gasPrice"))),
+            value=to_int(args.get("value")),
+            data=to_bytes(args.get("data") or args.get("input")),
+            skip_account_checks=True,
+        )
+
+    def estimate_gas(self, args: dict, block: Block,
+                     gas_cap: int = 50_000_000) -> int:
+        """Binary search the minimum sufficient gas (api.go
+        DoEstimateGas shape)."""
+        lo = 21_000 - 1
+        hi = min(to_int(args.get("gas"), gas_cap), gas_cap)
+
+        def executable(gas: int) -> bool:
+            trial = dict(args)
+            trial["gas"] = hex(gas)
+            try:
+                res = self.call(trial, block, gas_cap)
+            except Exception:  # noqa: BLE001 — tx-invalid counts as fail
+                return False
+            return not res.failed
+
+        if not executable(hi):
+            raise RPCError("gas required exceeds allowance or always "
+                           "failing transaction")
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            if executable(mid):
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+    # -------------------------------------------------------- re-execute
+    def replay_block(self, block: Block, vm_config: Config,
+                     until_tx: Optional[int] = None):
+        """Re-execute `block` on its parent state with a vm.Config
+        (tracer) attached; returns the statedb after `until_tx`
+        (exclusive) or the whole block (eth/state_accessor.go)."""
+        parent = self.chain.get_block(block.parent_hash)
+        if parent is None:
+            raise RPCError("parent block unavailable")
+        statedb = self.state_at(parent)
+        sub_block = block
+        if until_tx is not None:
+            sub_block = Block(block.header,
+                              block.transactions[:until_tx],
+                              version=block.version,
+                              extdata=block.extdata)
+        proc = Processor(self.config)
+        proc.process(sub_block, parent.header, statedb,
+                     vm_config=vm_config,
+                     get_hash=self.ancestry_hash(block))
+        return statedb

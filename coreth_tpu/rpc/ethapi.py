@@ -1,0 +1,240 @@
+"""The eth_* / net_* / web3_* method implementations.
+
+Twin of reference internal/ethapi/api.go over the Backend seam.  All
+quantities hex-encoded per the JSON-RPC conventions; blocks accept
+"latest" / "pending" / "earliest" / "accepted" / hex-number tags.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from coreth_tpu.rpc.backend import Backend
+from coreth_tpu.rpc.hexutil import to_bytes
+from coreth_tpu.rpc.filters import FilterSystem, filter_logs
+from coreth_tpu.rpc.gasprice import Oracle
+from coreth_tpu.rpc.server import RPCError, RPCServer
+from coreth_tpu.types import Block, Receipt, Transaction
+
+
+def qty(v: Optional[int]) -> Optional[str]:
+    return None if v is None else hex(v)
+
+
+def data(b: Optional[bytes]) -> Optional[str]:
+    return None if b is None else "0x" + b.hex()
+
+
+def _addr(s: str) -> bytes:
+    return to_bytes(s, 20)
+
+
+def _h32(s: str) -> bytes:
+    return to_bytes(s, 32)
+
+
+def tx_json(tx: Transaction, block: Optional[Block], index: int,
+            signer) -> dict:
+    out = {
+        "hash": data(tx.hash()),
+        "nonce": qty(tx.nonce),
+        "from": data(signer.sender(tx)),
+        "to": data(tx.to),
+        "value": qty(tx.value),
+        "gas": qty(tx.gas),
+        "gasPrice": qty(tx.gas_price),
+        "input": data(tx.data),
+        "type": qty(tx.tx_type),
+        "blockHash": data(block.hash()) if block else None,
+        "blockNumber": qty(block.number) if block else None,
+        "transactionIndex": qty(index) if block else None,
+    }
+    if tx.tx_type == 2:
+        out["maxFeePerGas"] = qty(tx.gas_fee_cap)
+        out["maxPriorityFeePerGas"] = qty(tx.gas_tip_cap)
+    return out
+
+
+def block_json(block: Block, full_txs: bool, signer) -> dict:
+    h = block.header
+    return {
+        "number": qty(block.number),
+        "hash": data(block.hash()),
+        "parentHash": data(h.parent_hash),
+        "stateRoot": data(h.root),
+        "transactionsRoot": data(h.tx_hash),
+        "receiptsRoot": data(h.receipt_hash),
+        "miner": data(h.coinbase),
+        "logsBloom": data(h.bloom),
+        "gasLimit": qty(h.gas_limit),
+        "gasUsed": qty(h.gas_used),
+        "timestamp": qty(h.time),
+        "extraData": data(h.extra),
+        "baseFeePerGas": qty(h.base_fee),
+        "extDataHash": data(h.ext_data_hash),
+        "extDataGasUsed": qty(h.ext_data_gas_used),
+        "blockGasCost": qty(h.block_gas_cost),
+        "transactions": [
+            tx_json(tx, block, i, signer) if full_txs
+            else data(tx.hash())
+            for i, tx in enumerate(block.transactions)],
+    }
+
+
+def receipt_json(block: Block, receipt: Receipt, tx: Transaction,
+                 index: int, signer, log_offset: int = 0) -> dict:
+    """log_offset: count of logs in the block's earlier receipts —
+    logIndex is block-wide per the JSON-RPC spec."""
+    return {
+        "transactionHash": data(receipt.tx_hash),
+        "transactionIndex": qty(index),
+        "blockHash": data(block.hash()),
+        "blockNumber": qty(block.number),
+        "from": data(signer.sender(tx)),
+        "to": data(tx.to),
+        "cumulativeGasUsed": qty(receipt.cumulative_gas_used),
+        "gasUsed": qty(receipt.gas_used),
+        "effectiveGasPrice": qty(receipt.effective_gas_price),
+        "contractAddress": data(receipt.contract_address),
+        "status": qty(receipt.status),
+        "type": qty(receipt.tx_type),
+        "logsBloom": data(receipt.bloom),
+        "logs": [{
+            "address": data(l.address),
+            "topics": [data(t) for t in l.topics],
+            "data": data(l.data),
+            "blockNumber": qty(block.number),
+            "blockHash": data(block.hash()),
+            "transactionHash": data(receipt.tx_hash),
+            "transactionIndex": qty(index),
+            "logIndex": qty(log_offset + j),
+        } for j, l in enumerate(receipt.logs)],
+    }
+
+
+def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
+    b = backend
+    oracle = Oracle(b)
+    filters = FilterSystem(b)
+
+    def eth_chainId():
+        return qty(b.config.chain_id)
+
+    def eth_blockNumber():
+        return qty(b.chain.current_block().number)
+
+    def eth_getBalance(addr, tag="latest"):
+        state = b.state_at(b.resolve_block(tag))
+        return qty(state.get_balance(_addr(addr)))
+
+    def eth_getTransactionCount(addr, tag="latest"):
+        state = b.state_at(b.resolve_block(tag))
+        return qty(state.get_nonce(_addr(addr)))
+
+    def eth_getCode(addr, tag="latest"):
+        state = b.state_at(b.resolve_block(tag))
+        return data(state.get_code(_addr(addr)))
+
+    def eth_getStorageAt(addr, slot, tag="latest"):
+        state = b.state_at(b.resolve_block(tag))
+        key = int(slot, 16).to_bytes(32, "big")
+        return data(state.get_state(_addr(addr), key))
+
+    def eth_getBlockByNumber(tag, full=False):
+        try:
+            block = b.resolve_block(tag)
+        except RPCError:
+            return None
+        return block_json(block, bool(full), b.signer)
+
+    def eth_getBlockByHash(h, full=False):
+        block = b.chain.get_block(_h32(h))
+        return None if block is None \
+            else block_json(block, bool(full), b.signer)
+
+    def eth_getTransactionByHash(h):
+        found = b.tx_by_hash(_h32(h))
+        if found is None:
+            return None
+        block, tx, idx = found
+        return tx_json(tx, block, idx, b.signer)
+
+    def eth_getTransactionReceipt(h):
+        found = b.receipt_by_hash(_h32(h))
+        if found is None:
+            return None
+        block, receipt, idx = found
+        receipts = b.chain.get_receipts(block.hash()) or []
+        log_offset = sum(len(r.logs) for r in receipts[:idx])
+        return receipt_json(block, receipt, block.transactions[idx],
+                            idx, b.signer, log_offset)
+
+    def eth_sendRawTransaction(raw):
+        if b.txpool is None:
+            raise RPCError("tx pool unavailable")
+        tx = Transaction.decode(to_bytes(raw))
+        errs = b.txpool.add_remotes([tx])
+        if errs and errs[0] is not None:
+            raise RPCError(str(errs[0]) or type(errs[0]).__name__)
+        return data(tx.hash())
+
+    def eth_call(args, tag="latest"):
+        res = b.call(args, b.resolve_block(tag))
+        if res.failed:
+            raise RPCError("execution reverted",
+                           data=data(res.return_data))
+        return data(res.return_data)
+
+    def eth_estimateGas(args, tag="latest"):
+        return qty(b.estimate_gas(args, b.resolve_block(tag)))
+
+    def eth_gasPrice():
+        return qty(oracle.suggest_price())
+
+    def eth_maxPriorityFeePerGas():
+        return qty(oracle.suggest_tip_cap())
+
+    def eth_feeHistory(count, tag="latest", percentiles=None):
+        n = int(count, 16) if isinstance(count, str) else int(count)
+        return oracle.fee_history(n, b.resolve_block(tag),
+                                  percentiles or [])
+
+    def eth_getLogs(criteria):
+        return filters.get_logs(criteria)
+
+    def eth_newFilter(criteria):
+        return filters.new_log_filter(criteria)
+
+    def eth_newBlockFilter():
+        return filters.new_block_filter()
+
+    def eth_getFilterChanges(fid):
+        return filters.get_changes(fid)
+
+    def eth_getFilterLogs(fid):
+        return filters.get_filter_logs(fid)
+
+    def eth_uninstallFilter(fid):
+        return filters.uninstall(fid)
+
+    def net_version():
+        return str(b.config.chain_id)
+
+    def web3_clientVersion():
+        return "coreth-tpu/0.3.0"
+
+    def eth_syncing():
+        return False
+
+    for fn in (eth_chainId, eth_blockNumber, eth_getBalance,
+               eth_getTransactionCount, eth_getCode, eth_getStorageAt,
+               eth_getBlockByNumber, eth_getBlockByHash,
+               eth_getTransactionByHash, eth_getTransactionReceipt,
+               eth_sendRawTransaction, eth_call, eth_estimateGas,
+               eth_gasPrice, eth_maxPriorityFeePerGas, eth_feeHistory,
+               eth_getLogs, eth_newFilter, eth_newBlockFilter,
+               eth_getFilterChanges, eth_getFilterLogs,
+               eth_uninstallFilter, net_version, web3_clientVersion,
+               eth_syncing):
+        server.register(fn.__name__, fn)
+    return filters

@@ -1,0 +1,153 @@
+"""Log filters: eth_getLogs + the stateful filter API.
+
+Twin of reference eth/filters (filter.go log matching with address +
+positional topic criteria, bloom pre-screening per block;
+filter_system.go's installed-filter lifecycle for newFilter /
+getFilterChanges)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from coreth_tpu.rpc.hexutil import to_bytes as _hx
+from coreth_tpu.rpc.server import RPCError
+from coreth_tpu.types.receipt import bloom9
+
+
+def _bloom_might_contain(bloom: bytes, value: bytes) -> bool:
+    bits = bloom9(value)
+    have = int.from_bytes(bloom, "big")
+    return (have & bits) == bits
+
+
+def _match_log(log, addresses: List[bytes], topics: List[List[bytes]]
+               ) -> bool:
+    """filter.go filterLogs criteria: address OR-list + positional
+    topic OR-lists (empty position = wildcard)."""
+    if addresses and log.address not in addresses:
+        return False
+    if len(topics) > len(log.topics):
+        return False
+    for want, have in zip(topics, log.topics):
+        if want and have not in want:
+            return False
+    return True
+
+
+def filter_logs(backend, from_block: int, to_block: int,
+                addresses: List[bytes], topics: List[List[bytes]]
+                ) -> list:
+    """Collect matching logs over a canonical block range, skipping
+    blocks whose header bloom rules the criteria out."""
+    out = []
+    for number in range(from_block, to_block + 1):
+        block = backend.chain.get_block_by_number(number)
+        if block is None:
+            continue
+        bloom = block.header.bloom
+        if addresses and not any(
+                _bloom_might_contain(bloom, a) for a in addresses):
+            continue
+        receipts = backend.chain.get_receipts(block.hash()) or []
+        log_index = 0  # block-wide position, per the JSON-RPC spec
+        for idx, r in enumerate(receipts):
+            for log in r.logs:
+                if _match_log(log, addresses, topics):
+                    out.append({
+                        "address": "0x" + log.address.hex(),
+                        "topics": ["0x" + t.hex() for t in log.topics],
+                        "data": "0x" + log.data.hex(),
+                        "blockNumber": hex(number),
+                        "blockHash": "0x" + block.hash().hex(),
+                        "transactionHash": "0x" + r.tx_hash.hex(),
+                        "transactionIndex": hex(idx),
+                        "logIndex": hex(log_index),
+                    })
+                log_index += 1
+    return out
+
+
+def _parse_criteria(backend, criteria: dict):
+    addresses = criteria.get("address") or []
+    if isinstance(addresses, str):
+        addresses = [addresses]
+    addresses = [_hx(a) for a in addresses]
+    topics = []
+    for t in criteria.get("topics") or []:
+        if t is None:
+            topics.append([])
+        elif isinstance(t, str):
+            topics.append([_hx(t)])
+        else:
+            topics.append([_hx(x) for x in t])
+
+    def resolve(tag, default):
+        if tag is None:
+            return default
+        return backend.resolve_block(tag).number
+
+    head = backend.chain.current_block().number
+    from_block = resolve(criteria.get("fromBlock"), 0)
+    to_block = resolve(criteria.get("toBlock"), head)
+    return from_block, to_block, addresses, topics
+
+
+class FilterSystem:
+    def __init__(self, backend):
+        self.backend = backend
+        self._ids = itertools.count(1)
+        # fid -> {"type", "criteria", "last_block"}
+        self._filters: Dict[str, dict] = {}
+
+    def get_logs(self, criteria: dict) -> list:
+        return filter_logs(self.backend,
+                           *_parse_criteria(self.backend, criteria))
+
+    def new_log_filter(self, criteria: dict) -> str:
+        fid = hex(next(self._ids))
+        self._filters[fid] = {
+            "type": "logs", "criteria": criteria,
+            "last_block": self.backend.chain.current_block().number}
+        return fid
+
+    def new_block_filter(self) -> str:
+        fid = hex(next(self._ids))
+        self._filters[fid] = {
+            "type": "blocks",
+            "last_block": self.backend.chain.current_block().number}
+        return fid
+
+    def _require(self, fid: str) -> dict:
+        f = self._filters.get(fid)
+        if f is None:
+            raise RPCError(f"filter not found: {fid}")
+        return f
+
+    def get_changes(self, fid: str) -> list:
+        f = self._require(fid)
+        head = self.backend.chain.current_block().number
+        start = f["last_block"] + 1
+        f["last_block"] = head
+        if start > head:
+            return []
+        if f["type"] == "blocks":
+            out = []
+            for n in range(start, head + 1):
+                b = self.backend.chain.get_block_by_number(n)
+                if b is not None:
+                    out.append("0x" + b.hash().hex())
+            return out
+        frm, to, addrs, topics = _parse_criteria(
+            self.backend, f["criteria"])
+        return filter_logs(self.backend, max(frm, start),
+                           min(to, head), addrs, topics)
+
+    def get_filter_logs(self, fid: str) -> list:
+        f = self._require(fid)
+        if f["type"] != "logs":
+            raise RPCError("not a log filter")
+        return self.get_logs(f["criteria"])
+
+    def uninstall(self, fid: str) -> bool:
+        return self._filters.pop(fid, None) is not None

@@ -1,0 +1,94 @@
+"""Fee suggestion oracle.
+
+Twin of reference eth/gasprice (gasprice.go:402 Oracle — percentile of
+recent blocks' effective tips over a lookback window, floored at the
+fork minimum; feehistory.go — per-block base fee / tip percentiles /
+gas-used ratios)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+DEFAULT_BLOCKS = 20
+DEFAULT_PERCENTILE = 60
+MAX_HISTORY = 1024
+MIN_PRICE = 25 * 10**9  # AP4 min base fee floor (params avalanche)
+
+
+class Oracle:
+    def __init__(self, backend, blocks: int = DEFAULT_BLOCKS,
+                 percentile: int = DEFAULT_PERCENTILE):
+        self.backend = backend
+        self.blocks = blocks
+        self.percentile = percentile
+
+    # ------------------------------------------------------------ helpers
+    def _block_tips(self, block) -> List[int]:
+        base = block.base_fee or 0
+        tips = []
+        for tx in block.transactions:
+            if tx.tx_type == 2:
+                tips.append(min(tx.gas_tip_cap,
+                                max(tx.gas_fee_cap - base, 0)))
+            else:
+                tips.append(max(tx.gas_price - base, 0))
+        return tips
+
+    def suggest_tip_cap(self) -> int:
+        """Percentile of per-block median tips over the lookback
+        (gasprice.go SuggestTipCap shape)."""
+        chain = self.backend.chain
+        head = chain.current_block()
+        samples: List[int] = []
+        number = head.number
+        for _ in range(self.blocks):
+            if number < 1:
+                break
+            block = chain.get_block_by_number(number)
+            number -= 1
+            if block is None or not block.transactions:
+                continue
+            tips = sorted(self._block_tips(block))
+            samples.append(tips[len(tips) // 2])
+        if not samples:
+            return 10**9
+        samples.sort()
+        idx = min(len(samples) - 1,
+                  len(samples) * self.percentile // 100)
+        return samples[idx]
+
+    def suggest_price(self) -> int:
+        """Legacy eth_gasPrice: base fee + suggested tip, floored."""
+        head = self.backend.chain.current_block()
+        base = head.base_fee or 0
+        return max(base + self.suggest_tip_cap(), MIN_PRICE)
+
+    def fee_history(self, count: int, last_block,
+                    percentiles: List[float]) -> dict:
+        count = max(1, min(count, MAX_HISTORY))
+        chain = self.backend.chain
+        oldest = max(0, last_block.number - count + 1)
+        base_fees: List[str] = []
+        ratios: List[float] = []
+        rewards: List[List[str]] = []
+        for n in range(oldest, last_block.number + 1):
+            block = chain.get_block_by_number(n)
+            if block is None:
+                continue
+            base_fees.append(hex(block.base_fee or 0))
+            ratios.append(block.header.gas_used
+                          / max(block.header.gas_limit, 1))
+            if percentiles:
+                tips = sorted(self._block_tips(block)) or [0]
+                rewards.append([
+                    hex(tips[min(len(tips) - 1,
+                                 int(len(tips) * p / 100))])
+                    for p in percentiles])
+        # next block's base fee estimate rides the engine's calculator
+        # when available; repeat the head fee otherwise
+        base_fees.append(hex(last_block.base_fee or 0))
+        out = {"oldestBlock": hex(oldest), "baseFeePerGas": base_fees,
+               "gasUsedRatio": ratios}
+        if percentiles:
+            out["reward"] = rewards
+        return out

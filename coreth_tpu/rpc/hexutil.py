@@ -1,0 +1,38 @@
+"""Shared JSON-RPC hex codecs (geth common/hexutil role) — one
+decoder for every method, so malformed input fails uniformly."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from coreth_tpu.rpc.server import INVALID_PARAMS, RPCError
+
+
+def to_bytes(v: Optional[str], length: Optional[int] = None) -> bytes:
+    if not v:
+        return b""
+    if not isinstance(v, str):
+        raise RPCError(f"expected hex string, got {type(v).__name__}",
+                       INVALID_PARAMS)
+    s = v[2:] if v.startswith("0x") else v
+    try:
+        raw = bytes.fromhex(s)
+    except ValueError:
+        raise RPCError(f"invalid hex string {v!r}",
+                       INVALID_PARAMS) from None
+    if length is not None and len(raw) != length:
+        raise RPCError(f"expected {length} bytes, got {len(raw)}",
+                       INVALID_PARAMS)
+    return raw
+
+
+def to_int(v, default: int = 0) -> int:
+    if v is None:
+        return default
+    if isinstance(v, str):
+        try:
+            return int(v, 16) if v.startswith("0x") else int(v)
+        except ValueError:
+            raise RPCError(f"invalid quantity {v!r}",
+                           INVALID_PARAMS) from None
+    return int(v)

@@ -1,0 +1,117 @@
+"""Transport-agnostic JSON-RPC 2.0 server.
+
+Twin of reference rpc/server.go + handler.go: a method registry
+dispatching single and batched requests, exposed over HTTP
+(http.server) the way rpc/http.go mounts it; in-process dispatch is a
+plain call for tests and embedding.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class RPCError(Exception):
+    def __init__(self, message: str, code: int = INTERNAL_ERROR,
+                 data: Any = None):
+        super().__init__(message)
+        self.code = code
+        self.data = data
+
+
+class RPCServer:
+    def __init__(self):
+        self._methods: Dict[str, Callable] = {}
+        self._http: Optional[ThreadingHTTPServer] = None
+        self._lock = threading.Lock()
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._methods[name] = fn
+
+    # ------------------------------------------------------------ dispatch
+    def handle_call(self, method: str, params: list) -> Any:
+        fn = self._methods.get(method)
+        if fn is None:
+            raise RPCError(f"the method {method} does not exist",
+                           METHOD_NOT_FOUND)
+        return fn(*params)
+
+    def handle_request(self, req: Any) -> Any:
+        if isinstance(req, list):
+            if not req:
+                return _err(None, INVALID_REQUEST, "empty batch")
+            return [self._handle_one(r) for r in req]
+        return self._handle_one(req)
+
+    def _handle_one(self, req: Any) -> dict:
+        if not isinstance(req, dict) or "method" not in req:
+            return _err(None, INVALID_REQUEST, "invalid request")
+        rid = req.get("id")
+        params = req.get("params", [])
+        if not isinstance(params, list):
+            return _err(rid, INVALID_PARAMS, "params must be an array")
+        try:
+            with self._lock:
+                result = self.handle_call(req["method"], params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except RPCError as e:
+            return _err(rid, e.code, str(e), e.data)
+        except TypeError as e:
+            return _err(rid, INVALID_PARAMS, str(e))
+        except Exception as e:  # noqa: BLE001 — method fault
+            return _err(rid, INTERNAL_ERROR, f"{type(e).__name__}: {e}")
+
+    def handle_raw(self, body: bytes) -> bytes:
+        try:
+            req = json.loads(body)
+        except Exception:  # noqa: BLE001
+            return json.dumps(_err(None, PARSE_ERROR, "parse error")
+                              ).encode()
+        return json.dumps(self.handle_request(req)).encode()
+
+    # ----------------------------------------------------------- transport
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Serve over HTTP in a daemon thread; returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — stdlib naming
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                out = server.handle_raw(body)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *a):  # silence stdlib request logs
+                pass
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        self._http.daemon_threads = True
+        threading.Thread(target=self._http.serve_forever,
+                         daemon=True).start()
+        return self._http.server_address[1]
+
+    def close(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+
+
+def _err(rid, code: int, message: str, data: Any = None) -> dict:
+    e: dict = {"code": code, "message": message}
+    if data is not None:
+        e["data"] = data
+    return {"jsonrpc": "2.0", "id": rid, "error": e}

@@ -1,0 +1,154 @@
+"""debug_trace* API: historical re-execution with tracers.
+
+Twin of reference eth/tracers/api.go (debug_traceTransaction :>,
+debug_traceCall, debug_traceBlockByNumber) over the struct logger
+(evm/tracing.StructLogger) and a call tracer producing the nested
+call-frame JSON the native callTracer emits."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from coreth_tpu.evm import Config
+from coreth_tpu.evm.tracing import StructLogger, Tracer
+from coreth_tpu.rpc.backend import Backend
+from coreth_tpu.rpc.server import RPCError, RPCServer
+
+
+class CallTracer(Tracer):
+    """Nested call-frame tracer (eth/tracers/native/call.go)."""
+
+    _OPS = {0xF1: "CALL", 0xF2: "CALLCODE", 0xF4: "DELEGATECALL",
+            0xFA: "STATICCALL", 0xF0: "CREATE", 0xF5: "CREATE2"}
+
+    def __init__(self):
+        self.root: Optional[dict] = None
+        self._stack: List[dict] = []
+
+    def capture_start(self, evm, origin, to, create, input_, gas, value):
+        self.root = {
+            "type": "CREATE" if create else "CALL",
+            "from": "0x" + origin.hex(), "to": "0x" + to.hex(),
+            "value": hex(value), "gas": hex(gas),
+            "input": "0x" + input_.hex(), "calls": [],
+        }
+        self._stack = [self.root]
+
+    def capture_enter(self, op, caller, to, input_, gas, value):
+        frame = {
+            "type": self._OPS.get(op, hex(op)),
+            "from": "0x" + caller.hex(), "to": "0x" + to.hex(),
+            "value": hex(value), "gas": hex(gas),
+            "input": "0x" + input_.hex(), "calls": [],
+        }
+        if self._stack:
+            self._stack[-1]["calls"].append(frame)
+        self._stack.append(frame)
+
+    def capture_exit(self, output, gas_used, err):
+        if len(self._stack) > 1:
+            frame = self._stack.pop()
+            frame["gasUsed"] = hex(gas_used)
+            frame["output"] = "0x" + output.hex()
+            if err is not None:
+                frame["error"] = type(err).__name__
+
+    def capture_end(self, output, gas_used, err):
+        if self.root is not None:
+            self.root["gasUsed"] = hex(gas_used)
+            self.root["output"] = "0x" + output.hex()
+            if err is not None:
+                self.root["error"] = type(err).__name__
+
+    def result(self) -> dict:
+        return self.root or {}
+
+
+def _make_tracer(options: Optional[dict]):
+    options = options or {}
+    name = options.get("tracer")
+    if name in (None, "", "structLogger"):
+        return StructLogger(limit=int(options.get("limit", 0)))
+    if name == "callTracer":
+        return CallTracer()
+    raise RPCError(f"unknown tracer {name!r}")
+
+
+def register_debug_api(server: RPCServer, backend: Backend) -> None:
+    b = backend
+
+    def debug_traceTransaction(tx_hash, options=None):
+        found = b.tx_by_hash(bytes.fromhex(
+            tx_hash[2:] if tx_hash.startswith("0x") else tx_hash))
+        if found is None:
+            raise RPCError("transaction not found")
+        block, tx, idx = found
+        tracer = _make_tracer(options)
+        # replay the prefix untraced, then the target tx traced
+        statedb = b.replay_block(block, Config(), until_tx=idx)
+        from coreth_tpu.processor.state_processor import (
+            apply_transaction, new_block_context,
+        )
+        from coreth_tpu.processor.message import tx_to_message
+        from coreth_tpu.processor.state_transition import GasPool
+        from coreth_tpu.evm import EVM, TxContext
+        msg = tx_to_message(tx, b.signer, block.base_fee)
+        ctx = new_block_context(block.header)
+        evm = EVM(ctx, TxContext(), statedb, b.config,
+                  Config(tracer=tracer))
+        statedb.set_tx_context(tx.hash(), idx)
+        apply_transaction(msg, GasPool(block.header.gas_limit), statedb,
+                          block.number, block.hash(), tx, [0], evm)
+        return tracer.result()
+
+    def debug_traceCall(args, tag="latest", options=None):
+        tracer = _make_tracer(options)
+        block = b.resolve_block(tag)
+        statedb = b.state_at(block)
+        from coreth_tpu.processor.state_transition import (
+            GasPool, apply_message,
+        )
+        from coreth_tpu.processor.state_processor import new_block_context
+        from coreth_tpu.evm import EVM, TxContext
+        msg = b._args_to_message(args, block, 50_000_000)
+        evm = EVM(new_block_context(block.header),
+                  TxContext(origin=msg.from_, gas_price=msg.gas_price),
+                  statedb, b.config,
+                  Config(tracer=tracer, no_base_fee=True))
+        apply_message(evm, msg, GasPool(msg.gas_limit))
+        return tracer.result()
+
+    def debug_traceBlockByNumber(tag, options=None):
+        """One replay of the block, a fresh tracer per tx — O(n) tx
+        executions, not O(n^2) prefix replays (tracers/api.go
+        traceBlock)."""
+        block = b.resolve_block(tag)
+        parent = b.chain.get_block(block.parent_hash)
+        if parent is None:
+            raise RPCError("parent block unavailable")
+        statedb = b.state_at(parent)
+        from coreth_tpu.processor.state_processor import (
+            apply_transaction, new_block_context,
+        )
+        from coreth_tpu.processor.message import tx_to_message
+        from coreth_tpu.processor.state_transition import GasPool
+        from coreth_tpu.evm import EVM, TxContext
+        ctx = new_block_context(block.header, b.ancestry_hash(block))
+        gp = GasPool(block.header.gas_limit)
+        used = [0]
+        out = []
+        for i, tx in enumerate(block.transactions):
+            tracer = _make_tracer(options)
+            evm = EVM(ctx, TxContext(), statedb, b.config,
+                      Config(tracer=tracer))
+            msg = tx_to_message(tx, b.signer, block.base_fee)
+            statedb.set_tx_context(tx.hash(), i)
+            apply_transaction(msg, gp, statedb, block.number,
+                              block.hash(), tx, used, evm)
+            out.append({"txHash": "0x" + tx.hash().hex(),
+                        "result": tracer.result()})
+        return out
+
+    for fn in (debug_traceTransaction, debug_traceCall,
+               debug_traceBlockByNumber):
+        server.register(fn.__name__, fn)

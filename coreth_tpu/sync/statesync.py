@@ -49,26 +49,30 @@ class StateSyncer:
                       "storage_tries": 0, "codes": 0, "pages": 0}
 
     # ------------------------------------------------------------ sub-syncs
-    def _sync_trie(self, root: bytes, pos_get, pos_set) -> Trie:
+    def _sync_trie(self, root: bytes, pos_get, pos_set):
         """Pull one trie by verified ranges into a local Trie backed by
-        the shared node store; returns it (committed)."""
+        the shared node store; returns (trie, leaf_count), committed."""
+        # the done-marker is only trusted when the root is actually
+        # resident in THIS db — a progress dict paired with a fresh
+        # Database (or a crash before commit) re-syncs instead of
+        # wedging on a stale marker
+        if pos_get() == b"done" and (root == EMPTY_ROOT
+                                     or root in self.db.node_db):
+            t = Trie(root_hash=root, db=self.db.node_db)
+            return t, sum(1 for _ in t.items())
         t = Trie(db=self.db.node_db)
-        # re-fill from already-synced pages on resume: the local nodes
-        # are only committed when the trie completes, so a resumed trie
-        # restarts clean but skips completed tries entirely
-        pos = pos_get()
-        if pos == b"done":
-            return Trie(root_hash=root, db=self.db.node_db)
-        if pos != ZERO_KEY:
-            pos = ZERO_KEY  # partial trie restarts (segment-level
-            # resume needs persisted partials; trie-level is what the
-            # progress markers guarantee)
+        # partial tries restart from the beginning: page-level resume
+        # would need persisted partial nodes; trie-level completion is
+        # what the progress markers guarantee
+        pos = ZERO_KEY
+        count = 0
         while True:
             keys, vals, more = self.client.get_leafs(
                 root, start=pos, limit=self.page)
             self.stats["pages"] += 1
             for k, v in zip(keys, vals):
                 t.update(k, v)
+            count += len(keys)
             if not more:
                 break
             pos = _next_key(keys[-1])
@@ -77,7 +81,7 @@ class StateSyncer:
             raise SyncError("synced trie root mismatch")
         t.commit()
         pos_set(b"done")
-        return t
+        return t, count
 
     # --------------------------------------------------------------- start
     def sync(self, state_root: bytes) -> Database:
@@ -92,7 +96,7 @@ class StateSyncer:
         def account_pos_set(v):
             self.progress["account_pos"] = v
 
-        account_trie = self._sync_trie(
+        account_trie, _ = self._sync_trie(
             state_root, account_pos_get, account_pos_set)
 
         # walk synced accounts for storage roots + code hashes
@@ -119,9 +123,9 @@ class StateSyncer:
             def pos_set(v, key=key):
                 storage_progress[key] = v
 
-            st = self._sync_trie(root, pos_get, pos_set)
+            _st, n = self._sync_trie(root, pos_get, pos_set)
             self.stats["storage_tries"] += 1
-            self.stats["storage_leafs"] += sum(1 for _ in st.items())
+            self.stats["storage_leafs"] += n
 
         todo = [h for h in code_hashes
                 if h.hex() not in self.progress["codes"]]

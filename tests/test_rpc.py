@@ -1,0 +1,220 @@
+"""JSON-RPC surface: eth_* methods, filters, gasprice, debug tracers —
+driven both in-process and over a real HTTP round trip.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount, generate_chain
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.rpc import new_rpc_stack
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+from coreth_tpu.txpool import TxPool
+from coreth_tpu.workloads.erc20 import (
+    TRANSFER_TOPIC, token_genesis_account, transfer_calldata,
+)
+
+GWEI = 10**9
+KEY = 0xCAB1E
+ADDR = priv_to_address(KEY)
+KEY2 = 0xD06
+ADDR2 = priv_to_address(KEY2)
+TOKEN = bytes([0x7B]) * 20
+
+
+@pytest.fixture(scope="module")
+def stack():
+    alloc = {ADDR: GenesisAccount(balance=10**24),
+             ADDR2: GenesisAccount(balance=10**24)}
+    alloc[TOKEN] = token_genesis_account({ADDR: 10**20})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonce = [0]
+
+    def gen(i, bg):
+        if i == 0:
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonce[0],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=21_000,
+                to=ADDR2, value=12345), KEY, CFG.chain_id))
+            nonce[0] += 1
+        else:
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonce[0],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=100_000,
+                to=TOKEN, value=0,
+                data=transfer_calldata(ADDR2, 777)), KEY, CFG.chain_id))
+            nonce[0] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, db, 2, gen, gap=2)
+    chain = BlockChain(genesis)
+    chain.insert_chain(blocks)
+    txpool = TxPool(CFG, chain)
+    server, backend = new_rpc_stack(chain, txpool)
+    return server, backend, chain, blocks
+
+
+def call(server, method, *params):
+    resp = server.handle_request(
+        {"jsonrpc": "2.0", "id": 1, "method": method,
+         "params": list(params)})
+    if "error" in resp:
+        raise AssertionError(resp["error"])
+    return resp["result"]
+
+
+def test_basic_queries(stack):
+    server, backend, chain, blocks = stack
+    assert call(server, "eth_chainId") == hex(CFG.chain_id)
+    assert call(server, "eth_blockNumber") == hex(2)
+    bal = call(server, "eth_getBalance", "0x" + ADDR2.hex(), "latest")
+    assert int(bal, 16) == 10**24 + 12345
+    assert int(call(server, "eth_getTransactionCount",
+                    "0x" + ADDR.hex(), "latest"), 16) == 2
+    code = call(server, "eth_getCode", "0x" + TOKEN.hex(), "latest")
+    assert len(code) > 4
+    # storage slot for ADDR's token balance
+    from coreth_tpu.workloads.erc20 import balance_slot
+    # getStorageAt takes the EVM-level slot; normalization is internal
+    blk = call(server, "eth_getBlockByNumber", "0x1", True)
+    assert blk["number"] == "0x1"
+    assert len(blk["transactions"]) == 1
+    assert blk["transactions"][0]["from"] == "0x" + ADDR.hex()
+    assert call(server, "eth_getBlockByNumber", "0x99") is None
+
+
+def test_tx_and_receipt_lookup(stack):
+    server, backend, chain, blocks = stack
+    tx = blocks[1].transactions[0]
+    h = "0x" + tx.hash().hex()
+    got = call(server, "eth_getTransactionByHash", h)
+    assert got["blockNumber"] == "0x2"
+    rec = call(server, "eth_getTransactionReceipt", h)
+    assert rec["status"] == "0x1"
+    assert len(rec["logs"]) == 1
+    assert rec["logs"][0]["topics"][0] == "0x" + TRANSFER_TOPIC.hex()
+
+
+def test_eth_call_and_estimate(stack):
+    server, backend, chain, blocks = stack
+    # balanceOf(ADDR2) on the token
+    from coreth_tpu.workloads.erc20 import BALANCEOF_SELECTOR
+    data = "0x" + (BALANCEOF_SELECTOR + b"\x00" * 12 + ADDR2).hex()
+    out = call(server, "eth_call",
+               {"from": "0x" + ADDR.hex(), "to": "0x" + TOKEN.hex(),
+                "data": data}, "latest")
+    assert int(out, 16) == 777
+    gas = call(server, "eth_estimateGas",
+               {"from": "0x" + ADDR.hex(), "to": "0x" + ADDR2.hex(),
+                "value": "0x1"}, "latest")
+    assert int(gas, 16) == 21_000
+
+
+def test_logs_and_filters(stack):
+    server, backend, chain, blocks = stack
+    logs = call(server, "eth_getLogs",
+                {"fromBlock": "0x0", "toBlock": "latest",
+                 "address": "0x" + TOKEN.hex()})
+    assert len(logs) == 1
+    assert logs[0]["topics"][0] == "0x" + TRANSFER_TOPIC.hex()
+    # topic criteria: non-matching first topic -> no results
+    none = call(server, "eth_getLogs",
+                {"fromBlock": "0x0", "toBlock": "latest",
+                 "topics": ["0x" + (b"\x01" * 32).hex()]})
+    assert none == []
+    # positional wildcard matches
+    wild = call(server, "eth_getLogs",
+                {"fromBlock": "0x0", "toBlock": "latest",
+                 "topics": [None, "0x" + (b"\x00" * 12 + ADDR).hex()]})
+    assert len(wild) == 1
+    fid = call(server, "eth_newFilter",
+               {"fromBlock": "0x0", "address": "0x" + TOKEN.hex()})
+    assert call(server, "eth_getFilterLogs", fid) == logs
+    assert call(server, "eth_getFilterChanges", fid) == []
+    assert call(server, "eth_uninstallFilter", fid) is True
+
+
+def test_gasprice_and_feehistory(stack):
+    server, backend, chain, blocks = stack
+    price = int(call(server, "eth_gasPrice"), 16)
+    assert price >= 25 * GWEI
+    hist = call(server, "eth_feeHistory", "0x2", "latest", [50])
+    assert len(hist["baseFeePerGas"]) == 3  # 2 blocks + next estimate
+    assert len(hist["reward"]) == 2
+
+
+def test_debug_tracers(stack):
+    server, backend, chain, blocks = stack
+    tx = blocks[1].transactions[0]
+    h = "0x" + tx.hash().hex()
+    trace = call(server, "debug_traceTransaction", h)
+    assert not trace["failed"]
+    ops = [l["op"] for l in trace["structLogs"]]
+    assert "SLOAD" in ops and "SSTORE" in ops and "LOG3" in ops
+    calls = call(server, "debug_traceTransaction", h,
+                 {"tracer": "callTracer"})
+    assert calls["to"] == "0x" + TOKEN.hex()
+    assert int(calls["gasUsed"], 16) > 0
+    # traceCall against latest state
+    from coreth_tpu.workloads.erc20 import BALANCEOF_SELECTOR
+    res = call(server, "debug_traceCall",
+               {"from": "0x" + ADDR.hex(), "to": "0x" + TOKEN.hex(),
+                "data": "0x" + (BALANCEOF_SELECTOR + b"\x00" * 12
+                                + ADDR2).hex()},
+               "latest", {"tracer": "callTracer"})
+    assert res["type"] == "CALL"
+
+
+def test_http_round_trip_and_batch(stack):
+    server, backend, chain, blocks = stack
+    port = server.serve_http()
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        single = post({"jsonrpc": "2.0", "id": 7,
+                       "method": "eth_blockNumber", "params": []})
+        assert single["result"] == hex(2) and single["id"] == 7
+        batch = post([
+            {"jsonrpc": "2.0", "id": 1, "method": "eth_chainId",
+             "params": []},
+            {"jsonrpc": "2.0", "id": 2, "method": "bogus_method",
+             "params": []},
+        ])
+        assert batch[0]["result"] == hex(CFG.chain_id)
+        assert batch[1]["error"]["code"] == -32601
+    finally:
+        server.close()
+
+
+def test_send_raw_transaction(stack):
+    server, backend, chain, blocks = stack
+    tx = sign_tx(DynamicFeeTx(
+        chain_id_=CFG.chain_id, nonce=0, gas_tip_cap_=GWEI,
+        gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDR, value=5,
+    ), KEY2, CFG.chain_id)
+    h = call(server, "eth_sendRawTransaction", "0x" + tx.encode().hex())
+    assert h == "0x" + tx.hash().hex()
+    pending, _ = backend.txpool.stats()
+    assert pending == 1
+
+
+def test_trace_block_and_log_index(stack):
+    server, backend, chain, blocks = stack
+    traced = call(server, "debug_traceBlockByNumber", "0x2")
+    assert len(traced) == len(blocks[1].transactions)
+    assert not traced[0]["result"]["failed"]
