@@ -1,0 +1,541 @@
+"""BLS12-381 signatures: sign / verify / aggregate.
+
+Role parity with the reference's supranational/blst dependency
+(SURVEY.md section 2.7), which warp uses for validator signatures
+(warp/backend.go:136 signing, aggregator quorum verification).  This is
+the min-pk scheme blst implements: secret keys are scalars, public
+keys live in G1 (48-byte compressed), signatures in G2 (96-byte
+compressed); aggregation is point addition on either side.
+
+The pairing is the optimal-ate over the Fq12 tower computed with
+affine Miller-loop arithmetic (the py_ecc-style formulation: clarity
+over speed — this is host-side control-plane crypto, not the TPU hot
+path).  Hash-to-curve uses deterministic try-and-increment with
+cofactor clearing rather than RFC 9380 SSWU; semantics and security
+(ROM) match, but signatures are NOT wire-compatible with blst's —
+documented divergence, acceptable while no cross-implementation peer
+exists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------- params
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+X_PARAM = -0xD201000000010000  # the BLS parameter (negative)
+
+G1X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+G2X = (0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+       0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E)
+G2Y = (0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+       0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE)
+
+H_EFF_G2 = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551  # noqa: E501 — G2 cofactor effective multiple
+
+
+# ------------------------------------------------------------- Fq tower
+
+def _inv(a: int, m: int = P) -> int:
+    return pow(a, m - 2, m)
+
+
+class Fq2(tuple):
+    """Fq[u] / (u^2 + 1)."""
+
+    def __new__(cls, c0: int, c1: int):
+        return super().__new__(cls, (c0 % P, c1 % P))
+
+    def __add__(self, o):
+        return Fq2(self[0] + o[0], self[1] + o[1])
+
+    def __sub__(self, o):
+        return Fq2(self[0] - o[0], self[1] - o[1])
+
+    def __neg__(self):
+        return Fq2(-self[0], -self[1])
+
+    def __mul__(self, o):
+        if isinstance(o, int):
+            return Fq2(self[0] * o, self[1] * o)
+        a0, a1 = self
+        b0, b1 = o
+        t0 = a0 * b0
+        t1 = a1 * b1
+        return Fq2(t0 - t1, (a0 + a1) * (b0 + b1) - t0 - t1)
+
+    __rmul__ = __mul__
+
+    def sq(self):
+        a0, a1 = self
+        return Fq2((a0 + a1) * (a0 - a1), 2 * a0 * a1)
+
+    def inv(self):
+        a0, a1 = self
+        d = _inv(a0 * a0 + a1 * a1)
+        return Fq2(a0 * d, -a1 * d)
+
+    def conj(self):
+        return Fq2(self[0], -self[1])
+
+    def is_zero(self):
+        return self[0] == 0 and self[1] == 0
+
+    def sqrt(self) -> Optional["Fq2"]:
+        """Square root in Fq2 (complex method), or None."""
+        a0, a1 = self
+        if a1 == 0:
+            s = pow(a0, (P + 1) // 4, P)
+            if s * s % P == a0:
+                return Fq2(s, 0)
+            # a0 is a non-residue: sqrt = u * sqrt(-a0)
+            s = pow((-a0) % P, (P + 1) // 4, P)
+            if s * s % P == (-a0) % P:
+                return Fq2(0, s)
+            return None
+        # norm = a0^2 + a1^2 must be a residue
+        n = (a0 * a0 + a1 * a1) % P
+        d = pow(n, (P + 1) // 4, P)
+        if d * d % P != n:
+            return None
+        two_inv = _inv(2)
+        x0 = (a0 + d) * two_inv % P
+        s0 = pow(x0, (P + 1) // 4, P)
+        if s0 * s0 % P != x0:
+            x0 = (a0 - d) * two_inv % P
+            s0 = pow(x0, (P + 1) // 4, P)
+            if s0 * s0 % P != x0:
+                return None
+        s1 = a1 * _inv(2 * s0) % P
+        cand = Fq2(s0, s1)
+        return cand if cand.sq() == self else None
+
+
+FQ2_ONE = Fq2(1, 0)
+FQ2_ZERO = Fq2(0, 0)
+
+# Fq12 as polynomials over Fq modulo w^12 - 2w^6 + 2 — the py_ecc
+# formulation (w^6 = w^6; the modulus encodes w^6 = u + 1 with u^2=-1
+# flattened to a single extension, avoiding the explicit tower).
+FQ12_MODULUS = [2, 0, 0, 0, 0, 0, -2, 0, 0, 0, 0, 0]  # + w^12
+
+
+class Fq12:
+    __slots__ = ("c",)
+
+    def __init__(self, coeffs: Sequence[int]):
+        self.c = [x % P for x in coeffs]
+
+    @classmethod
+    def one(cls):
+        return cls([1] + [0] * 11)
+
+    @classmethod
+    def zero(cls):
+        return cls([0] * 12)
+
+    def __eq__(self, o):
+        return self.c == o.c
+
+    def __add__(self, o):
+        return Fq12([a + b for a, b in zip(self.c, o.c)])
+
+    def __sub__(self, o):
+        return Fq12([a - b for a, b in zip(self.c, o.c)])
+
+    def __neg__(self):
+        return Fq12([-a for a in self.c])
+
+    def __mul__(self, o):
+        if isinstance(o, int):
+            return Fq12([a * o for a in self.c])
+        b = [0] * 23
+        for i, ai in enumerate(self.c):
+            if ai:
+                for j, bj in enumerate(o.c):
+                    b[i + j] += ai * bj
+        # reduce by w^12 = 2w^6 - 2
+        for i in range(22, 11, -1):
+            t = b[i]
+            if t:
+                b[i] = 0
+                b[i - 6] += 2 * t
+                b[i - 12] -= 2 * t
+        return Fq12(b[:12])
+
+    __rmul__ = __mul__
+
+    def inv(self):
+        """Extended euclid over Fq[w] mod the fixed modulus (py_ecc)."""
+        lm, hm = [1] + [0] * 12, [0] * 13
+        low = self.c + [0]
+        high = FQ12_MODULUS + [1]
+
+        def deg(p):
+            d = len(p) - 1
+            while d and p[d] % P == 0:
+                d -= 1
+            return d
+
+        def poly_rounded_div(a, b):
+            dega, degb = deg(a), deg(b)
+            temp = [x for x in a]
+            o = [0] * len(a)
+            for i in range(dega - degb, -1, -1):
+                q = temp[degb + i] * _inv(b[degb]) % P
+                o[i] += q
+                for c in range(degb + 1):
+                    temp[c + i] -= o[i] * b[c]
+            return [x % P for x in o[:deg(o) + 1]]
+
+        while deg(low):
+            r = poly_rounded_div(high, low)
+            r += [0] * (13 - len(r))
+            nm = [x for x in hm]
+            new = [x for x in high]
+            for i in range(13):
+                for j in range(13 - i):
+                    nm[i + j] -= lm[i] * r[j]
+                    new[i + j] -= low[i] * r[j]
+            nm = [x % P for x in nm]
+            new = [x % P for x in new]
+            lm, low, hm, high = nm, new, lm, low
+        d = _inv(low[0])
+        return Fq12([x * d % P for x in lm[:12]])
+
+    def pow(self, e: int):
+        result = Fq12.one()
+        base = self
+        while e:
+            if e & 1:
+                result = result * base
+            base = base * base
+            e >>= 1
+        return result
+
+
+# Fq2 -> Fq12 embedding: u maps to w^6 - 1 (since w^6 = u + 1)
+def fq2_to_fq12(a: Fq2) -> Fq12:
+    c = [0] * 12
+    c[0] = (a[0] - a[1]) % P
+    c[6] = a[1]
+    return Fq12(c)
+
+
+# ----------------------------------------------------------- the curves
+
+# E1: y^2 = x^3 + 4 over Fq; E2: y^2 = x^3 + 4(u+1) over Fq2
+B1 = 4
+B2 = Fq2(4, 4)
+
+G1 = (G1X, G1Y)
+G2 = (Fq2(*G2X), Fq2(*G2Y))
+
+
+def g1_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - x * x * x - B1) % P == 0
+
+
+def g2_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return y.sq() - x.sq() * x == B2
+
+
+def _ec_add(p1, p2, fadd, fsub, fmul, fsq, finv, is_eq, neg_y):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if is_eq(x1, x2):
+        if is_eq(y1, y2):
+            # double
+            lam = fmul(fmul(fsq(x1), 3), finv(fmul(y1, 2)))
+        else:
+            return None
+    else:
+        lam = fmul(fsub(y2, y1), finv(fsub(x2, x1)))
+    x3 = fsub(fsub(fsq(lam), x1), x2)
+    y3 = fsub(fmul(lam, fsub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g1_add(p1, p2):
+    return _ec_add(
+        p1, p2,
+        lambda a, b: (a + b) % P, lambda a, b: (a - b) % P,
+        lambda a, b: a * b % P, lambda a: a * a % P, _inv,
+        lambda a, b: a == b, lambda y: (-y) % P)
+
+
+def g1_neg(p1):
+    return None if p1 is None else (p1[0], (-p1[1]) % P)
+
+
+def g1_mul(pt, k: int):
+    k %= R
+    acc = None
+    while k:
+        if k & 1:
+            acc = g1_add(acc, pt)
+        pt = g1_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def g2_add(p1, p2):
+    return _ec_add(
+        p1, p2,
+        lambda a, b: a + b, lambda a, b: a - b,
+        lambda a, b: (a * b) if isinstance(b, Fq2) else a * b,
+        lambda a: a.sq(), lambda a: a.inv(),
+        lambda a, b: a == b, lambda y: -y)
+
+
+def g2_neg(pt):
+    return None if pt is None else (pt[0], -pt[1])
+
+
+def g2_mul(pt, k: int):
+    acc = None
+    while k:
+        if k & 1:
+            acc = g2_add(acc, pt)
+        pt = g2_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+# ----------------------------------------------------------- the pairing
+
+def _fq12_point_add(p1, p2):
+    return _ec_add(
+        p1, p2,
+        lambda a, b: a + b, lambda a, b: a - b,
+        lambda a, b: a * b, lambda a: a * a, lambda a: a.inv(),
+        lambda a, b: a == b, lambda y: -y)
+
+
+def _fq12_point_mul(pt, k):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _fq12_point_add(acc, pt)
+        pt = _fq12_point_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def _twist(pt):
+    """E2 -> E(Fq12) untwist (py_ecc twist): (x, y) ->
+    (x' / w^2, y' / w^3) with the u -> w^6-1 embedding."""
+    if pt is None:
+        return None
+    x, y = pt
+    xc = [(x[0] - x[1]) % P, x[1]]
+    yc = [(y[0] - y[1]) % P, y[1]]
+    nx = Fq12([xc[0]] + [0] * 5 + [xc[1]] + [0] * 5)
+    ny = Fq12([yc[0]] + [0] * 5 + [yc[1]] + [0] * 5)
+    w = Fq12([0, 1] + [0] * 10)
+    w2, w3 = w * w, w * w * w
+    return (nx * w2.inv(), ny * w3.inv())
+
+
+def _g1_to_fq12(pt):
+    if pt is None:
+        return None
+    return (Fq12([pt[0]] + [0] * 11), Fq12([pt[1]] + [0] * 11))
+
+
+def _linefunc(p1, p2, t):
+    """Evaluate the line through p1, p2 at t (all over Fq12)."""
+    x1, y1 = p1
+    x2, y2 = p2
+    xt, yt = t
+    if not x1 == x2:
+        m = (y2 - y1) * (x2 - x1).inv()
+        return m * (xt - x1) - (yt - y1)
+    if y1 == y2:
+        m = (x1 * x1) * 3 * (y1 * 2).inv()
+        return m * (xt - x1) - (yt - y1)
+    return xt - x1
+
+
+ATE_LOOP_COUNT = 15132376222941642752  # |x|, the BLS parameter
+LOG_ATE = 62
+
+
+def miller_loop(q, p) -> Fq12:
+    """f_{T,Q}(P) for the ate pairing (py_ecc formulation)."""
+    if q is None or p is None:
+        return Fq12.one()
+    r_pt = q
+    f = Fq12.one()
+    for i in range(LOG_ATE, -1, -1):
+        f = f * f * _linefunc(r_pt, r_pt, p)
+        r_pt = _fq12_point_add(r_pt, r_pt)
+        if ATE_LOOP_COUNT & (2 ** i):
+            f = f * _linefunc(r_pt, q, p)
+            r_pt = _fq12_point_add(r_pt, q)
+    return f.pow((P ** 12 - 1) // R)
+
+
+def pairing(q_g2, p_g1) -> Fq12:
+    """e(P, Q) with P in G1, Q in G2."""
+    if p_g1 is None or q_g2 is None:
+        return Fq12.one()
+    return miller_loop(_twist(q_g2), _g1_to_fq12(p_g1))
+
+
+# ------------------------------------------------------------- encoding
+
+def g1_compress(pt) -> bytes:
+    if pt is None:
+        return bytes([0xC0]) + b"\x00" * 47
+    x, y = pt
+    flag = 0x80 | (0x20 if y > (P - 1) // 2 else 0)
+    raw = bytearray(x.to_bytes(48, "big"))
+    raw[0] |= flag
+    return bytes(raw)
+
+
+def g1_decompress(data: bytes):
+    if len(data) != 48:
+        raise ValueError("bad G1 encoding length")
+    if data[0] & 0x40:
+        return None  # infinity
+    y_flag = bool(data[0] & 0x20)
+    x = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    y2 = (pow(x, 3, P) + B1) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise ValueError("x not on curve")
+    if (y > (P - 1) // 2) != y_flag:
+        y = P - y
+    return (x, y)
+
+
+def g2_compress(pt) -> bytes:
+    if pt is None:
+        return bytes([0xC0]) + b"\x00" * 95
+    x, y = pt
+    # sign from the lexicographically-largest test on (c1, c0)
+    neg = -y
+    bigger = (y[1], y[0]) > (neg[1], neg[0])
+    flag = 0x80 | (0x20 if bigger else 0)
+    raw = bytearray(x[1].to_bytes(48, "big") + x[0].to_bytes(48, "big"))
+    raw[0] |= flag
+    return bytes(raw)
+
+
+def g2_decompress(data: bytes):
+    if len(data) != 96:
+        raise ValueError("bad G2 encoding length")
+    if data[0] & 0x40:
+        return None
+    y_flag = bool(data[0] & 0x20)
+    c1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    c0 = int.from_bytes(data[48:], "big")
+    x = Fq2(c0, c1)
+    y = (x.sq() * x + B2).sqrt()
+    if y is None:
+        raise ValueError("x not on curve")
+    neg = -y
+    if ((y[1], y[0]) > (neg[1], neg[0])) != y_flag:
+        y = neg
+    return (x, y)
+
+
+# -------------------------------------------------------- hash to curve
+
+DST = b"CORETH-TPU-BLS-SIG-V01-TAI-G2"
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST):
+    """Deterministic try-and-increment onto E2, then clear the cofactor.
+    Secure in the ROM; NOT the RFC 9380 SSWU map blst uses (see module
+    docstring)."""
+    ctr = 0
+    while True:
+        seed = hashlib.sha256(dst + len(dst).to_bytes(1, "big")
+                              + msg + ctr.to_bytes(4, "big")).digest()
+        c0 = int.from_bytes(hashlib.sha512(seed + b"\x00").digest(),
+                            "big") % P
+        c1 = int.from_bytes(hashlib.sha512(seed + b"\x01").digest(),
+                            "big") % P
+        x = Fq2(c0, c1)
+        y = (x.sq() * x + B2).sqrt()
+        if y is not None:
+            # deterministic sign choice
+            neg = -y
+            if (y[1], y[0]) > (neg[1], neg[0]):
+                y = neg
+            return g2_mul((x, y), H_EFF_G2)
+        ctr += 1
+
+
+# ------------------------------------------------------------- the API
+
+class BLSError(Exception):
+    pass
+
+
+def secret_from_bytes(ikm: bytes) -> int:
+    """Deterministic keygen from seed material."""
+    h = hashlib.sha512(b"coreth-tpu-bls-keygen" + ikm).digest()
+    sk = int.from_bytes(h, "big") % R
+    return sk or 1
+
+
+def public_key(sk: int) -> bytes:
+    return g1_compress(g1_mul(G1, sk))
+
+
+def sign(sk: int, msg: bytes) -> bytes:
+    return g2_compress(g2_mul(hash_to_g2(msg), sk))
+
+
+def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    try:
+        pk_pt = g1_decompress(pk)
+        sig_pt = g2_decompress(sig)
+    except ValueError:
+        return False
+    if pk_pt is None or sig_pt is None:
+        return False
+    h = hash_to_g2(msg)
+    # e(pk, H(m)) == e(g1, sig)  <=>  e(-pk, H(m)) * e(g1, sig) == 1
+    lhs = pairing(h, g1_neg(pk_pt))
+    rhs = pairing(sig_pt, G1)
+    return lhs * rhs == Fq12.one()
+
+
+def aggregate_signatures(sigs: List[bytes]) -> bytes:
+    acc = None
+    for s in sigs:
+        acc = g2_add(acc, g2_decompress(s))
+    return g2_compress(acc)
+
+
+def aggregate_public_keys(pks: List[bytes]) -> bytes:
+    acc = None
+    for p in pks:
+        acc = g1_add(acc, g1_decompress(p))
+    return g1_compress(acc)
+
+
+def verify_aggregate(pks: List[bytes], msg: bytes, sig: bytes) -> bool:
+    """Same-message aggregate verify (the warp quorum check)."""
+    if not pks:
+        return False
+    return verify(aggregate_public_keys(pks), msg, sig)

@@ -25,6 +25,9 @@ class ChainConfig:
     petersburg_block: Optional[int] = 0
     istanbul_block: Optional[int] = 0
     muir_glacier_block: Optional[int] = 0
+    # per-config stateful-precompile activation overrides, keyed by
+    # Module.config_key (None entry = disabled for this config)
+    precompile_upgrades: Optional[dict] = None
     # Avalanche timestamp upgrades (None = never active)
     apricot_phase1_time: Optional[int] = None
     apricot_phase2_time: Optional[int] = None
@@ -101,9 +104,36 @@ class ChainConfig:
     def is_cancun(self, num: int, time: int) -> bool:
         return _active_time(self.cancun_time, time)
 
+    def precompile_activation_time(self, module):
+        """Per-config activation override by config_key (the reference
+        resolves activation from the chain config's upgrade schedule,
+        config.go getActivePrecompileConfig) — falls back to the
+        module's registry default."""
+        overrides = self.precompile_upgrades or {}
+        return overrides.get(module.config_key, module.timestamp)
+
+    def precompile_active(self, module, timestamp: int) -> bool:
+        at = self.precompile_activation_time(module)
+        return at is not None and timestamp >= at
+
     def rules(self, num: int, timestamp: int) -> "Rules":
-        """Flattened rule set for a block (reference config.go:1027-1100)."""
+        """Flattened rule set for a block (reference config.go:1027-1100).
+
+        Registered stateful-precompile modules active at `timestamp`
+        populate active_precompiles/predicaters (config.go Rules
+        ActivePrecompiles — here fed by the module registry)."""
+        from coreth_tpu.precompile.modules import registered_modules
+        active = {}
+        predicaters = {}
+        for m in registered_modules():
+            if not self.precompile_active(m, timestamp):
+                continue
+            active[m.address] = m.contract
+            if m.predicater is not None:
+                predicaters[m.address] = m.predicater
         return Rules(
+            active_precompiles=active,
+            predicaters=predicaters,
             chain_id=self.chain_id,
             is_homestead=self.is_homestead(num),
             is_eip150=self.is_eip150(num),

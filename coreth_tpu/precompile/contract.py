@@ -1,0 +1,77 @@
+"""Stateful precompile contract framework.
+
+Twin of reference precompile/contract/ (contract.go
+statefulPrecompileFunction + newStatefulPrecompileWithFunctionSelectors,
+interfaces.go AccessibleState): a stateful precompile is a map from
+4-byte ABI selectors to gas-charged functions that see the EVM
+(statedb, block context, caller) — the mechanism every precompile
+module (warp included) plugs into the interpreter through
+(evm.precompile(), evm.go:78).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.evm import vmerrs
+
+
+def selector(signature: str) -> bytes:
+    """4-byte ABI selector from a function signature string."""
+    return keccak256(signature.encode())[:4]
+
+
+@dataclass
+class PrecompileFunction:
+    """One selector-dispatched entry point (contract.go
+    statefulPrecompileFunction)."""
+    sel: bytes
+    execute: Callable  # (accessible_state, caller, addr, input, gas,
+    #                     read_only) -> (ret, remaining_gas)
+
+
+class StatefulPrecompiledContract:
+    """Selector-dispatching stateful precompile (contract.go:57)."""
+
+    stateful = True
+
+    def __init__(self, functions: Dict[bytes, Callable],
+                 fallback: Optional[Callable] = None):
+        self.functions = functions
+        self.fallback = fallback
+
+    def run_stateful(self, evm, caller: bytes, addr: bytes,
+                     input_: bytes, gas: int, read_only: bool
+                     ) -> Tuple[bytes, int]:
+        if len(input_) < 4:
+            if self.fallback is not None:
+                return self.fallback(evm, caller, addr, input_, gas,
+                                     read_only)
+            raise vmerrs.ErrExecutionReverted()
+        fn = self.functions.get(input_[:4])
+        if fn is None:
+            raise vmerrs.ErrExecutionReverted()
+        return fn(evm, caller, addr, input_[4:], gas, read_only)
+
+
+def deduct_gas(gas: int, cost: int) -> int:
+    """contract.go DeductGas."""
+    if gas < cost:
+        raise vmerrs.ErrOutOfGas()
+    return gas - cost
+
+
+# ------------------------------------------------------- ABI mini-codec
+
+def abi_word(v) -> bytes:
+    if isinstance(v, bytes):
+        return v.rjust(32, b"\x00")
+    return int(v).to_bytes(32, "big")
+
+
+def abi_pack_bytes(payload: bytes) -> bytes:
+    """Dynamic `bytes` tail encoding: length word + padded data."""
+    padded = payload + b"\x00" * ((32 - len(payload) % 32) % 32)
+    return abi_word(len(payload)) + padded

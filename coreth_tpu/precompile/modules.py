@@ -27,6 +27,17 @@ class Module:
     contract: object  # Precompile with run_stateful
     # called by ApplyUpgrades; default = no state changes
     apply_upgrade: Callable = lambda *a, **k: None
+    # activation timestamp (None = registered but inactive); modules
+    # become visible through ChainConfig.rules() once active
+    timestamp: Optional[int] = 0
+    # optional precompileconfig.Predicater (predicate_gas/verify_predicate)
+    predicater: object = None
+
+
+def unregister_module(address: bytes) -> None:
+    """Test hook: drop a registration (module registries in the
+    reference are import-time-global too; tests need cleanup)."""
+    _registry.pop(address, None)
 
 
 _registry: Dict[bytes, Module] = {}

@@ -1,0 +1,189 @@
+"""The Warp stateful precompile (0x0200000000000000000000000000000000000005).
+
+Twin of reference precompile/contracts/warp/contract.go:
+- sendWarpMessage(bytes payload) (:231): wraps the caller + payload as
+  an AddressedCall inside an UnsignedMessage and emits the
+  SendWarpMessage log — the accepted-block hook hands the message to
+  the warp backend for signing
+- getVerifiedWarpMessage(uint32 index) (:190): reads the index-th warp
+  predicate this tx presented in its access list; returns the message
+  iff block-level predicate verification marked it valid
+- predicate verification (module VerifyPredicate): quorum-checks the
+  aggregate BLS signature against the P-Chain validator set
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.evm import vmerrs
+from coreth_tpu.precompile.contract import (
+    StatefulPrecompiledContract, abi_pack_bytes, abi_word, deduct_gas,
+    selector,
+)
+from coreth_tpu.precompile.modules import Module
+from coreth_tpu.warp.messages import (
+    AddressedCall, SignedMessage, UnsignedMessage,
+)
+from coreth_tpu.warp.predicate import (
+    PredicateError, pack_predicate, unpack_predicate,
+)
+
+WARP_ADDRESS = b"\x02" + b"\x00" * 18 + b"\x05"
+
+SEND_WARP_MESSAGE = selector("sendWarpMessage(bytes)")
+GET_VERIFIED_WARP_MESSAGE = selector("getVerifiedWarpMessage(uint32)")
+GET_BLOCKCHAIN_ID = selector("getBlockchainID()")
+
+# keccak256("SendWarpMessage(address,bytes32,bytes)")
+SEND_WARP_MESSAGE_TOPIC = keccak256(
+    b"SendWarpMessage(address,bytes32,bytes)")
+
+# gas costs (contract.go:40-63)
+SEND_WARP_MESSAGE_GAS = 30_000
+GET_VERIFIED_WARP_MESSAGE_BASE_GAS = 2
+GAS_PER_WARP_MESSAGE_CHUNK = 3_200
+GAS_PER_WARP_SIGNER = 500
+
+
+class WarpConfig:
+    """Module config + predicate verifier (config.go + VerifyPredicate).
+
+    network_id/source_chain_id identify this chain; validator_set_fn
+    returns the ValidatorSet to verify aggregate signatures against
+    (the P-Chain view at the proposer height)."""
+
+    def __init__(self, network_id: int, source_chain_id: bytes,
+                 validator_set_fn=None, quorum_num: int = 67,
+                 quorum_den: int = 100):
+        self.network_id = network_id
+        self.source_chain_id = source_chain_id
+        self.validator_set_fn = validator_set_fn
+        self.quorum_num = quorum_num
+        self.quorum_den = quorum_den
+
+    # predicate gas: charged through the access-list hook
+    # (state_transition.go:159); per 32-byte chunk + per signer
+    def predicate_gas(self, predicate_bytes: bytes) -> int:
+        chunks = (len(predicate_bytes) + 31) // 32
+        gas = chunks * GAS_PER_WARP_MESSAGE_CHUNK
+        try:
+            signed = SignedMessage.decode(
+                unpack_predicate(predicate_bytes))
+            gas += len(signed.signature.signer_indices()) \
+                * GAS_PER_WARP_SIGNER
+        except (PredicateError, ValueError):
+            pass  # verification will fail the predicate anyway
+        return gas
+
+    def verify_predicate(self, predicate_bytes: bytes) -> bool:
+        """One tx predicate -> valid? (contract VerifyPredicate)."""
+        if self.validator_set_fn is None:
+            return False
+        try:
+            signed = SignedMessage.decode(
+                unpack_predicate(predicate_bytes))
+        except (PredicateError, ValueError):
+            return False
+        if signed.message.network_id != self.network_id:
+            return False
+        return signed.verify(self.validator_set_fn(),
+                             self.quorum_num, self.quorum_den)
+
+
+def make_warp_module(config: WarpConfig) -> Module:
+    """Build the registered module; the contract closes over config."""
+
+    def send_warp_message(evm, caller, addr, input_, gas, read_only):
+        remaining = deduct_gas(gas, SEND_WARP_MESSAGE_GAS)
+        if read_only:
+            raise vmerrs.ErrWriteProtection()
+        if len(input_) < 64:
+            raise vmerrs.ErrExecutionReverted()
+        offset = int.from_bytes(input_[0:32], "big")
+        length = int.from_bytes(input_[offset:offset + 32], "big")
+        payload = input_[offset + 32:offset + 32 + length]
+        if len(payload) != length:
+            raise vmerrs.ErrExecutionReverted()
+        unsigned = UnsignedMessage(
+            config.network_id, config.source_chain_id,
+            AddressedCall(caller, payload).encode())
+        from coreth_tpu.types import Log
+        evm.statedb.add_log(Log(
+            address=WARP_ADDRESS,
+            topics=[SEND_WARP_MESSAGE_TOPIC,
+                    b"\x00" * 12 + caller,
+                    unsigned.id()],
+            data=unsigned.encode()))
+        return abi_word(unsigned.id()), remaining
+
+    def get_verified_warp_message(evm, caller, addr, input_, gas,
+                                  read_only):
+        remaining = deduct_gas(gas, GET_VERIFIED_WARP_MESSAGE_BASE_GAS)
+        if len(input_) < 32:
+            raise vmerrs.ErrExecutionReverted()
+        index = int.from_bytes(input_[0:32], "big")
+        slots = evm.statedb.get_predicate_storage_slots(WARP_ADDRESS)
+        results = evm.block_ctx.predicate_results
+        predicates = slots or []
+        if index >= len(predicates) or results is None:
+            return _no_message(), remaining
+        tx_index = getattr(evm.statedb, "_tx_index", 0)
+        bitset = results.get_result(tx_index, WARP_ADDRESS)
+        failed = index < len(bitset) * 8 \
+            and bitset[index // 8] & (1 << (index % 8))
+        if failed:
+            return _no_message(), remaining
+        try:
+            signed = SignedMessage.decode(
+                unpack_predicate(predicates[index]))
+        except (PredicateError, ValueError):
+            return _no_message(), remaining
+        call = AddressedCall.decode(signed.message.payload)
+        # WarpMessage{sourceChainID, originSenderAddress, payload}, valid
+        head = abi_word(64)  # offset of the message struct
+        msg = (abi_word(signed.message.source_chain_id)
+               + abi_word(call.source_address)
+               + abi_word(96)
+               + abi_pack_bytes(call.payload))
+        return head + abi_word(1) + msg, remaining
+
+    def get_blockchain_id(evm, caller, addr, input_, gas, read_only):
+        remaining = deduct_gas(gas, GET_VERIFIED_WARP_MESSAGE_BASE_GAS)
+        return abi_word(config.source_chain_id), remaining
+
+    contract = StatefulPrecompiledContract({
+        SEND_WARP_MESSAGE: send_warp_message,
+        GET_VERIFIED_WARP_MESSAGE: get_verified_warp_message,
+        GET_BLOCKCHAIN_ID: get_blockchain_id,
+    })
+    return Module(address=WARP_ADDRESS, config_key="warpConfig",
+                  contract=contract, predicater=config)
+
+
+def _no_message() -> bytes:
+    return abi_word(64) + abi_word(0) + abi_word(0) * 3 + abi_word(0)
+
+
+def verify_block_predicates(config: WarpConfig, block, rules,
+                            signer) -> "object":
+    """Block-level predicate verification (plugin/evm/block.go:413
+    verifyPredicates): for every tx access-list tuple addressed to the
+    warp precompile, run VerifyPredicate and record failures in the
+    per-tx results bitset."""
+    from coreth_tpu.warp.predicate import PredicateResults, slots_to_bytes
+    results = PredicateResults()
+    for tx_index, tx in enumerate(block.transactions):
+        per_addr: dict = {}
+        for addr, keys in (tx.access_list or []):
+            if addr == WARP_ADDRESS:
+                per_addr.setdefault(addr, []).append(keys)
+        for addr, tuple_list in per_addr.items():
+            bits = bytearray((len(tuple_list) + 7) // 8)
+            for i, keys in enumerate(tuple_list):
+                ok = config.verify_predicate(slots_to_bytes(keys))
+                if not ok:
+                    bits[i // 8] |= 1 << (i % 8)
+            results.set_result(tx_index, addr, bytes(bits))
+    return results

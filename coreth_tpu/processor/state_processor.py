@@ -118,4 +118,13 @@ def apply_upgrades(config: ChainConfig, parent_timestamp, block,
     """
     from coreth_tpu.precompile.modules import registered_modules
     for module in registered_modules():
-        module.apply_upgrade(config, parent_timestamp, block, statedb)
+        # only modules whose activation boundary falls in
+        # (parent, block] get their upgrade state written — inactive
+        # registrations must not mutate state (state_processor.go:222)
+        at = config.precompile_activation_time(module)
+        if at is None:
+            continue
+        newly = block.time >= at and (parent_timestamp is None
+                                      or parent_timestamp < at)
+        if newly:
+            module.apply_upgrade(config, parent_timestamp, block, statedb)

@@ -1,0 +1,25 @@
+"""Warp cross-subnet messaging.
+
+Twin of reference warp/ (backend.go, aggregator/, validators/) +
+predicate/ + precompile/contracts/warp: validators BLS-sign warp
+messages; an aggregator collects signatures to quorum weight into a
+bitset-addressed aggregate; the stateful warp precompile sends
+messages from EVM contracts and reads quorum-verified ones back
+through block predicates.
+"""
+
+from coreth_tpu.warp.messages import (
+    AddressedCall, BitSetSignature, SignedMessage, UnsignedMessage,
+)
+from coreth_tpu.warp.validators import Validator, ValidatorSet
+from coreth_tpu.warp.backend import WarpBackend
+from coreth_tpu.warp.aggregator import Aggregator, AggregateError
+from coreth_tpu.warp.predicate import (
+    PredicateResults, pack_predicate, unpack_predicate,
+)
+
+__all__ = [
+    "AddressedCall", "AggregateError", "Aggregator", "BitSetSignature",
+    "PredicateResults", "SignedMessage", "UnsignedMessage", "Validator",
+    "ValidatorSet", "WarpBackend", "pack_predicate", "unpack_predicate",
+]

@@ -1,0 +1,61 @@
+"""Signature aggregation to quorum weight.
+
+Twin of reference warp/aggregator/aggregator.go (:52
+AggregateSignatures): fan signature requests out to validators, verify
+each response against that validator's registered BLS key, and stop as
+soon as accumulated weight crosses the quorum threshold, producing the
+bitset-addressed aggregate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from coreth_tpu.crypto import bls
+from coreth_tpu.warp.messages import (
+    BitSetSignature, SignedMessage, UnsignedMessage,
+)
+from coreth_tpu.warp.validators import ValidatorSet
+
+
+class AggregateError(Exception):
+    pass
+
+
+class Aggregator:
+    def __init__(self, validator_set: ValidatorSet,
+                 fetch_signature: Callable[[bytes, UnsignedMessage],
+                                           Optional[bytes]]):
+        """fetch_signature(node_id, msg) -> 96-byte signature or None
+        (the peer.NetworkClient seam)."""
+        self.validators = validator_set
+        self.fetch = fetch_signature
+
+    def aggregate(self, msg: UnsignedMessage, quorum_num: int = 67,
+                  quorum_den: int = 100) -> SignedMessage:
+        payload = msg.encode()
+        total = self.validators.total_weight()
+        needed = (total * quorum_num + quorum_den - 1) // quorum_den
+        weight = 0
+        indices: List[int] = []
+        sigs: List[bytes] = []
+        for i, v in enumerate(self.validators.canonical()):
+            try:
+                sig = self.fetch(v.node_id, msg)
+            except Exception:  # noqa: BLE001 — peer fault, skip
+                continue
+            if sig is None:
+                continue
+            if not bls.verify(v.public_key, payload, sig):
+                continue  # invalid responses never poison the aggregate
+            indices.append(i)
+            sigs.append(sig)
+            weight += v.weight
+            if weight >= needed:
+                break
+        if weight < needed:
+            raise AggregateError(
+                f"insufficient weight {weight}/{needed}")
+        agg = bls.aggregate_signatures(sigs)
+        return SignedMessage(msg, BitSetSignature.from_indices(
+            indices, agg))

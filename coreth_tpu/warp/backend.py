@@ -1,0 +1,68 @@
+"""Warp backend: message store + node signing.
+
+Twin of reference warp/backend.go (:36 Backend, :114 AddMessage, :136
+GetMessageSignature, :158 GetBlockSignature): outgoing unsigned
+messages persist in a warp store keyed by message id; this node signs
+message ids and accepted block hashes with its BLS key on request
+(the signature handler seam other validators query), with an LRU of
+produced signatures.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from coreth_tpu.crypto import bls
+from coreth_tpu.warp.messages import UnsignedMessage
+
+SIGNATURE_CACHE = 256
+
+
+class WarpBackend:
+    def __init__(self, network_id: int, source_chain_id: bytes,
+                 secret_key: int, store: Optional[dict] = None):
+        self.network_id = network_id
+        self.source_chain_id = source_chain_id
+        self.sk = secret_key
+        self.public_key = bls.public_key(secret_key)
+        self.store: Dict[bytes, bytes] = store if store is not None else {}
+        self._sig_cache: "OrderedDict[bytes, bytes]" = OrderedDict()
+
+    # ------------------------------------------------------------ messages
+    def add_message(self, msg: UnsignedMessage) -> bytes:
+        """Persist an accepted outgoing message (AddMessage :114)."""
+        mid = msg.id()
+        self.store[mid] = msg.encode()
+        return mid
+
+    def get_message(self, message_id: bytes) -> Optional[UnsignedMessage]:
+        raw = self.store.get(message_id)
+        return UnsignedMessage.decode(raw) if raw is not None else None
+
+    # ----------------------------------------------------------- signatures
+    def _sign_cached(self, key: bytes, payload: bytes) -> bytes:
+        hit = self._sig_cache.get(key)
+        if hit is not None:
+            self._sig_cache.move_to_end(key)
+            return hit
+        sig = bls.sign(self.sk, payload)
+        self._sig_cache[key] = sig
+        if len(self._sig_cache) > SIGNATURE_CACHE:
+            self._sig_cache.popitem(last=False)
+        return sig
+
+    def get_message_signature(self, message_id: bytes) -> bytes:
+        """Sign a stored message (GetMessageSignature :136); unknown
+        ids are refused — a node only signs what it emitted."""
+        raw = self.store.get(message_id)
+        if raw is None:
+            raise KeyError(f"unknown warp message {message_id.hex()}")
+        return self._sign_cached(message_id, raw)
+
+    def get_block_signature(self, block_hash: bytes) -> bytes:
+        """Sign an accepted block hash (GetBlockSignature :158) wrapped
+        as a block-hash payload message."""
+        msg = UnsignedMessage(self.network_id, self.source_chain_id,
+                              block_hash)
+        return self._sign_cached(b"blk" + block_hash, msg.encode())

@@ -1,0 +1,135 @@
+"""Warp message formats.
+
+Twin of avalanchego's vms/platformvm/warp payload/message types as the
+reference consumes them: UnsignedMessage(networkID, sourceChainID,
+payload); AddressedCall payload (sourceAddress, payload); the signed
+container carries a signer bitset over the canonical validator set
+plus one aggregate BLS signature (BitSetSignature).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from coreth_tpu.atomic.wire import Packer, Unpacker
+from coreth_tpu.crypto import bls
+
+
+@dataclass
+class UnsignedMessage:
+    network_id: int = 0
+    source_chain_id: bytes = b"\x00" * 32
+    payload: bytes = b""
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u16(0)  # codec version
+        p.u32(self.network_id)
+        p.fixed(self.source_chain_id, 32)
+        p.var_bytes(self.payload)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "UnsignedMessage":
+        u = Unpacker(data)
+        if u.u16() != 0:
+            raise ValueError("bad warp codec version")
+        return cls(u.u32(), u.fixed(32), u.var_bytes())
+
+    def id(self) -> bytes:
+        return hashlib.sha256(self.encode()).digest()
+
+
+@dataclass
+class AddressedCall:
+    """The payload carrying an EVM source address (payload/addressed_call)."""
+    source_address: bytes = b""
+    payload: bytes = b""
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u16(1)  # payload type id
+        p.var_bytes(self.source_address)
+        p.var_bytes(self.payload)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "AddressedCall":
+        u = Unpacker(data)
+        if u.u16() != 1:
+            raise ValueError("not an addressed call")
+        return cls(u.var_bytes(), u.var_bytes())
+
+
+@dataclass
+class BitSetSignature:
+    """Aggregate signature addressed by a signer bitset over the
+    canonical validator ordering."""
+    signers: bytes = b""          # bitset, LSB of byte 0 = validator 0
+    signature: bytes = b"\x00" * 96
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.var_bytes(self.signers)
+        p.fixed(self.signature, 96)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BitSetSignature":
+        u = Unpacker(data)
+        return cls(u.var_bytes(), u.fixed(96))
+
+    def signer_indices(self) -> List[int]:
+        out = []
+        for byte_i, b in enumerate(self.signers):
+            for bit in range(8):
+                if b & (1 << bit):
+                    out.append(byte_i * 8 + bit)
+        return out
+
+    @classmethod
+    def from_indices(cls, indices: List[int], signature: bytes
+                     ) -> "BitSetSignature":
+        if indices:
+            size = max(indices) // 8 + 1
+            bits = bytearray(size)
+            for i in indices:
+                bits[i // 8] |= 1 << (i % 8)
+        else:
+            bits = bytearray()
+        return cls(bytes(bits), signature)
+
+
+@dataclass
+class SignedMessage:
+    message: UnsignedMessage = field(default_factory=UnsignedMessage)
+    signature: BitSetSignature = field(default_factory=BitSetSignature)
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.var_bytes(self.message.encode())
+        p.var_bytes(self.signature.encode())
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedMessage":
+        u = Unpacker(data)
+        return cls(UnsignedMessage.decode(u.var_bytes()),
+                   BitSetSignature.decode(u.var_bytes()))
+
+    def verify(self, validator_set, quorum_num: int = 67,
+               quorum_den: int = 100) -> bool:
+        """Quorum check against the canonical validator ordering
+        (precompile/contracts/warp verifyPredicate semantics)."""
+        indices = self.signature.signer_indices()
+        vals = validator_set.canonical()
+        if not indices or (indices and indices[-1] >= len(vals)):
+            return False
+        pks = [vals[i].public_key for i in indices]
+        weight = sum(vals[i].weight for i in indices)
+        if weight * quorum_den < validator_set.total_weight() * quorum_num:
+            return False
+        return bls.verify_aggregate(pks, self.message.encode(),
+                                    self.signature.signature)

@@ -1,0 +1,37 @@
+"""Validator-set view for warp verification.
+
+Twin of reference warp/validators/state.go: the canonical ordering
+(deterministic across every verifier — here sorted by public key
+bytes) that signer bitsets index into, plus total weight.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass(frozen=True)
+class Validator:
+    node_id: bytes
+    public_key: bytes  # 48-byte compressed G1
+    weight: int
+
+
+class ValidatorSet:
+    def __init__(self, validators: List[Validator]):
+        self._canonical = sorted(validators,
+                                 key=lambda v: v.public_key)
+        self._total = sum(v.weight for v in validators)
+
+    def canonical(self) -> List[Validator]:
+        return self._canonical
+
+    def total_weight(self) -> int:
+        return self._total
+
+    def index_of(self, public_key: bytes) -> int:
+        for i, v in enumerate(self._canonical):
+            if v.public_key == public_key:
+                return i
+        raise KeyError("unknown validator public key")

@@ -1,0 +1,220 @@
+"""Warp messaging: BLS signatures, aggregation to quorum, predicates,
+and the stateful warp precompile end-to-end (send on one chain,
+aggregate validator signatures, verify + read on another).
+
+Mirrors the reference's vm_warp_test.go:679 end-to-end shape without a
+network: validator backends are queried directly.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.crypto import bls
+from coreth_tpu.evm import EVM, BlockContext, TxContext
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.precompile.contract import abi_pack_bytes, abi_word
+from coreth_tpu.precompile.modules import register_module, unregister_module
+from coreth_tpu.precompile.warp_contract import (
+    GET_BLOCKCHAIN_ID, GET_VERIFIED_WARP_MESSAGE, SEND_WARP_MESSAGE,
+    SEND_WARP_MESSAGE_TOPIC, WARP_ADDRESS, WarpConfig, make_warp_module,
+    verify_block_predicates,
+)
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.warp import (
+    AddressedCall, Aggregator, AggregateError, BitSetSignature,
+    SignedMessage, UnsignedMessage, Validator, ValidatorSet, WarpBackend,
+    pack_predicate, unpack_predicate,
+)
+
+NETWORK_ID = 5
+SOURCE_CHAIN = b"\xAA" * 32
+CALLER = b"\x0C" * 20
+
+N_VALIDATORS = 4
+SKS = [bls.secret_from_bytes(f"validator-{i}".encode())
+       for i in range(N_VALIDATORS)]
+PKS = [bls.public_key(sk) for sk in SKS]
+VSET = ValidatorSet([
+    Validator(node_id=bytes([i]) * 20, public_key=PKS[i], weight=100)
+    for i in range(N_VALIDATORS)])
+
+
+def test_predicate_pack_roundtrip():
+    for n in (0, 1, 31, 32, 33, 100):
+        data = bytes(range(256))[:n]
+        packed = pack_predicate(data)
+        assert len(packed) % 32 == 0
+        assert unpack_predicate(packed) == data
+    with pytest.raises(Exception):
+        unpack_predicate(b"\x00" * 32)  # no delimiter
+    with pytest.raises(Exception):
+        unpack_predicate(b"\x01")      # misaligned
+
+
+def test_bitset_signature_indices():
+    bs = BitSetSignature.from_indices([0, 3, 9], b"\x00" * 96)
+    assert bs.signer_indices() == [0, 3, 9]
+    assert BitSetSignature(b"", b"\x00" * 96).signer_indices() == []
+
+
+def _aggregate(msg, available):
+    backends = {bytes([i]) * 20: WarpBackend(NETWORK_ID, SOURCE_CHAIN,
+                                             SKS[i])
+                for i in range(N_VALIDATORS)}
+    for b in backends.values():
+        b.add_message(msg)
+
+    def fetch(node_id, m):
+        if node_id not in available:
+            return None
+        return backends[node_id].get_message_signature(m.id())
+
+    return Aggregator(VSET, fetch).aggregate(msg)
+
+
+def test_aggregate_to_quorum_and_verify():
+    msg = UnsignedMessage(NETWORK_ID, SOURCE_CHAIN,
+                          AddressedCall(CALLER, b"hello subnet").encode())
+    # 3 of 4 validators respond: 300/400 >= 67%
+    signed = _aggregate(msg, {bytes([i]) * 20 for i in range(3)})
+    assert signed.verify(VSET)
+    # serialization roundtrip preserves verification
+    re = SignedMessage.decode(signed.encode())
+    assert re.verify(VSET)
+    # sub-quorum aggregation refuses
+    with pytest.raises(AggregateError):
+        _aggregate(msg, {bytes([0]) * 20, bytes([1]) * 20})
+    # a tampered message fails verification
+    bad = SignedMessage(
+        UnsignedMessage(NETWORK_ID, SOURCE_CHAIN, b"forged"),
+        signed.signature)
+    assert not bad.verify(VSET)
+
+
+@pytest.fixture
+def warp_module():
+    config = WarpConfig(NETWORK_ID, SOURCE_CHAIN,
+                        validator_set_fn=lambda: VSET)
+    module = make_warp_module(config)
+    register_module(module)
+    yield config, module
+    unregister_module(WARP_ADDRESS)
+
+
+def _evm(statedb, predicate_results=None, time=1000):
+    ctx = BlockContext(number=1, time=time, gas_limit=10_000_000,
+                       base_fee=25 * 10**9,
+                       predicate_results=predicate_results)
+    return EVM(ctx, TxContext(origin=CALLER, gas_price=0), statedb, CFG)
+
+
+def test_warp_precompile_send_and_receive(warp_module):
+    config, module = warp_module
+    # --- sending chain: sendWarpMessage via the EVM --------------------
+    db = StateDB(EMPTY_ROOT, Database())
+    db.add_balance(CALLER, 10**18)
+    evm = _evm(db)
+    payload = b"cross-subnet payload"
+    calldata = (SEND_WARP_MESSAGE + abi_word(32)
+                + abi_pack_bytes(payload))
+    ret, gas_left, err = evm.call(CALLER, WARP_ADDRESS, calldata,
+                                  200_000, 0)
+    assert err is None
+    logs = db.tx_logs()
+    assert len(logs) == 1
+    assert logs[0].topics[0] == SEND_WARP_MESSAGE_TOPIC
+    unsigned = UnsignedMessage.decode(logs[0].data)
+    assert unsigned.id() == ret[-32:]
+    call = AddressedCall.decode(unsigned.payload)
+    assert call.source_address == CALLER
+    assert call.payload == payload
+
+    # --- validators sign; aggregator reaches quorum --------------------
+    signed = _aggregate(unsigned, {bytes([i]) * 20 for i in range(3)})
+
+    # --- receiving chain: tx presents the predicate in its access list
+    packed = pack_predicate(signed.encode())
+    slots = [packed[i:i + 32] for i in range(0, len(packed), 32)]
+    access_list = [(WARP_ADDRESS, slots)]
+    rules = CFG.rules(1, 1000)
+    assert WARP_ADDRESS in rules.predicaters
+
+    db2 = StateDB(EMPTY_ROOT, Database())
+    db2.add_balance(CALLER, 10**18)
+    db2.prepare(rules, CALLER, b"\x00" * 20, WARP_ADDRESS,
+                list(rules.active_precompiles), access_list)
+
+    # block-level predicate verification -> results bitset (all pass)
+    class _Tx:
+        def __init__(self, al):
+            self.access_list = al
+
+    class _Blk:
+        transactions = [_Tx(access_list)]
+
+    results = verify_block_predicates(config, _Blk, rules, None)
+    assert results.get_result(0, WARP_ADDRESS) == b"\x00"
+
+    evm2 = _evm(db2, predicate_results=results)
+    ret2, _, err2 = evm2.call(
+        CALLER, WARP_ADDRESS,
+        GET_VERIFIED_WARP_MESSAGE + abi_word(0), 500_000, 0)
+    assert err2 is None
+    assert int.from_bytes(ret2[32:64], "big") == 1  # valid flag
+    assert ret2[64:96] == SOURCE_CHAIN
+    assert ret2[96:128] == b"\x00" * 12 + CALLER
+    # the payload rides at the tail
+    assert payload in ret2
+
+    # --- an invalid predicate (sub-quorum) is marked failed ------------
+    under = SignedMessage(unsigned, BitSetSignature.from_indices(
+        [0], bls.sign(SKS[0], unsigned.encode())))
+    packed_bad = pack_predicate(under.encode())
+    bad_slots = [packed_bad[i:i + 32]
+                 for i in range(0, len(packed_bad), 32)]
+    bad_al = [(WARP_ADDRESS, bad_slots)]
+
+    class _Blk2:
+        transactions = [_Tx(bad_al)]
+
+    results2 = verify_block_predicates(config, _Blk2, rules, None)
+    assert results2.get_result(0, WARP_ADDRESS) == b"\x01"
+
+    db3 = StateDB(EMPTY_ROOT, Database())
+    db3.add_balance(CALLER, 10**18)
+    db3.prepare(rules, CALLER, b"\x00" * 20, WARP_ADDRESS,
+                list(rules.active_precompiles), bad_al)
+    evm3 = _evm(db3, predicate_results=results2)
+    ret3, _, err3 = evm3.call(
+        CALLER, WARP_ADDRESS,
+        GET_VERIFIED_WARP_MESSAGE + abi_word(0), 500_000, 0)
+    assert err3 is None
+    assert int.from_bytes(ret3[32:64], "big") == 0  # invalid
+
+
+def test_get_blockchain_id(warp_module):
+    db = StateDB(EMPTY_ROOT, Database())
+    db.add_balance(CALLER, 10**18)
+    evm = _evm(db)
+    ret, _, err = evm.call(CALLER, WARP_ADDRESS, GET_BLOCKCHAIN_ID,
+                           100_000, 0)
+    assert err is None and ret == SOURCE_CHAIN
+
+
+def test_warp_backend_signing():
+    backend = WarpBackend(NETWORK_ID, SOURCE_CHAIN, SKS[0])
+    msg = UnsignedMessage(NETWORK_ID, SOURCE_CHAIN, b"x")
+    with pytest.raises(KeyError):
+        backend.get_message_signature(msg.id())  # only signs known msgs
+    backend.add_message(msg)
+    sig = backend.get_message_signature(msg.id())
+    assert bls.verify(PKS[0], msg.encode(), sig)
+    assert backend.get_message_signature(msg.id()) == sig  # cached
+    bsig = backend.get_block_signature(b"\x42" * 32)
+    blk_msg = UnsignedMessage(NETWORK_ID, SOURCE_CHAIN, b"\x42" * 32)
+    assert bls.verify(PKS[0], blk_msg.encode(), bsig)
