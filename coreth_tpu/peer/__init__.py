@@ -1,0 +1,12 @@
+"""App-level p2p seam: request/response routing + gossip.
+
+Twin of reference peer/ (network.go:41 Network, :142 SendAppRequestAny,
+:325 AppRequest, :452 AppGossip) with avalanchego's transport replaced
+by an in-memory hub — the same substitution the reference's own tests
+make by wiring two VMs' AppSenders together.  Sync handlers, warp
+signature handlers, and the tx gossiper all ride this seam.
+"""
+
+from coreth_tpu.peer.network import AppNetwork, Peer
+
+__all__ = ["AppNetwork", "Peer"]

@@ -1,0 +1,101 @@
+"""In-memory app network hub.
+
+Each Peer registers a request handler (bytes -> bytes) and a gossip
+handler (bytes -> None).  send_request routes to a named peer (or any
+peer but the sender — SendAppRequestAny), gossip fans out to everyone
+else.  Peer tracking records response counts/failures per peer so
+callers can prefer responsive peers (peer_tracker.go role, simplified
+to the scoring seam).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class PeerStats:
+    requests: int = 0
+    failures: int = 0
+
+
+class Peer:
+    def __init__(self, network: "AppNetwork", node_id: bytes,
+                 request_handler: Optional[Callable[[bytes], bytes]] = None,
+                 gossip_handler: Optional[Callable[[bytes], None]] = None):
+        self.network = network
+        self.node_id = node_id
+        self.request_handler = request_handler
+        self.gossip_handler = gossip_handler
+
+    # ------------------------------------------------------------ sending
+    def send_request(self, target: bytes, payload: bytes) -> bytes:
+        return self.network.route_request(self.node_id, target, payload)
+
+    def send_request_any(self, payload: bytes) -> bytes:
+        """SendAppRequestAny (network.go:142): pick a responsive peer."""
+        return self.network.route_request_any(self.node_id, payload)
+
+    def gossip(self, payload: bytes) -> int:
+        return self.network.route_gossip(self.node_id, payload)
+
+
+class AppNetwork:
+    def __init__(self, seed: int = 0):
+        self.peers: Dict[bytes, Peer] = {}
+        self.stats: Dict[bytes, PeerStats] = {}
+        self._rng = random.Random(seed)
+
+    def join(self, node_id: bytes,
+             request_handler: Optional[Callable] = None,
+             gossip_handler: Optional[Callable] = None) -> Peer:
+        peer = Peer(self, node_id, request_handler, gossip_handler)
+        self.peers[node_id] = peer
+        self.stats[node_id] = PeerStats()
+        return peer
+
+    # ------------------------------------------------------------- routing
+    def route_request(self, from_id: bytes, to_id: bytes,
+                      payload: bytes) -> bytes:
+        peer = self.peers.get(to_id)
+        stats = self.stats.setdefault(to_id, PeerStats())
+        stats.requests += 1
+        if peer is None or peer.request_handler is None:
+            stats.failures += 1
+            raise ConnectionError(f"no handler at {to_id.hex()}")
+        try:
+            return peer.request_handler(payload)
+        except Exception:
+            stats.failures += 1
+            raise
+
+    def route_request_any(self, from_id: bytes, payload: bytes) -> bytes:
+        """Prefer peers with the best response record (tracker role)."""
+        candidates = [p for nid, p in self.peers.items()
+                      if nid != from_id and p.request_handler is not None]
+        if not candidates:
+            raise ConnectionError("no peers")
+        candidates.sort(key=lambda p: (
+            self.stats[p.node_id].failures,
+            -self.stats[p.node_id].requests))
+        errs: List[Exception] = []
+        for peer in candidates:
+            try:
+                return self.route_request(from_id, peer.node_id, payload)
+            except Exception as e:  # noqa: BLE001 — try the next peer
+                errs.append(e)
+        raise ConnectionError(f"all peers failed: {errs[-1]}")
+
+    def route_gossip(self, from_id: bytes, payload: bytes) -> int:
+        n = 0
+        for nid, peer in self.peers.items():
+            if nid == from_id or peer.gossip_handler is None:
+                continue
+            try:
+                peer.gossip_handler(payload)
+                n += 1
+            except Exception:  # noqa: BLE001 — gossip is best-effort
+                pass
+        return n

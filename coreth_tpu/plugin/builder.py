@@ -1,0 +1,57 @@
+"""Block-build pacing.
+
+Twin of reference plugin/evm/block_builder.go (:26 blockBuilder, :91
+handleGenerateBlock, :104 needToBuild, :129 signalTxsReady): decides
+when to tell the consensus engine a block is worth building —
+immediately on the first pending tx after a quiet period, then rate-
+limited to `min_block_build_interval` between builds.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from collections import deque
+from typing import Deque, Optional
+
+MIN_BLOCK_BUILD_INTERVAL = 0.5  # seconds (config.go minBlockBuildInterval)
+
+PENDING_TXS = "PendingTxs"
+
+
+class BlockBuilder:
+    def __init__(self, vm, clock=_time.time,
+                 min_interval: float = MIN_BLOCK_BUILD_INTERVAL):
+        self.vm = vm
+        self.clock = clock
+        self.min_interval = min_interval
+        self.last_build: float = 0.0
+        self.to_engine: Deque[str] = vm.to_engine \
+            if vm is not None else deque()
+
+    def need_to_build(self) -> bool:
+        """needToBuild (:104): pending work exists."""
+        pending, _ = self.vm.txpool.stats()
+        if pending > 0:
+            return True
+        mempool = getattr(self.vm, "atomic_mempool", None)
+        return mempool is not None and mempool.pending_len() > 0
+
+    def signal_txs_ready(self) -> bool:
+        """signalTxsReady (:129): notify the engine unless it is too
+        soon after the last build or a signal is already queued.
+        Returns True when a PendingTxs message was enqueued."""
+        if not self.need_to_build():
+            return False
+        now = self.clock()
+        if now - self.last_build < self.min_interval:
+            return False
+        if self.to_engine and self.to_engine[-1] == PENDING_TXS:
+            return False
+        self.to_engine.append(PENDING_TXS)
+        return True
+
+    def handle_generate_block(self) -> None:
+        """Called after the engine built a block (:91): stamp the build
+        time and re-signal if work remains."""
+        self.last_build = self.clock()
+        self.signal_txs_ready()

@@ -1,0 +1,123 @@
+"""Push gossip of eth + atomic transactions.
+
+Twin of reference plugin/evm/gossiper.go (:57 pushGossiper, :121
+queueExecutableTxs — regossip selects executable txs nonce-ordered by
+effective price; dedup caches stop re-gossip storms) over the peer
+AppNetwork seam.  Incoming gossip feeds the tx pool / atomic mempool
+(GossipHandler :449).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional
+
+from coreth_tpu.atomic.tx import Tx as AtomicTx
+from coreth_tpu.types import Transaction
+
+RECENT_CACHE = 512
+
+# gossip payload kinds
+KIND_ETH_TXS = 0
+KIND_ATOMIC_TX = 1
+
+
+def _encode_gossip(kind: int, items: List[bytes]) -> bytes:
+    from coreth_tpu.atomic.wire import Packer
+    p = Packer()
+    p.u8(kind)
+    p.u32(len(items))
+    for raw in items:
+        p.var_bytes(raw)
+    return p.bytes()
+
+
+def _decode_gossip(data: bytes):
+    from coreth_tpu.atomic.wire import Unpacker
+    u = Unpacker(data)
+    kind = u.u8()
+    return kind, [u.var_bytes() for _ in range(u.u32())]
+
+
+class Gossiper:
+    def __init__(self, peer, txpool, atomic_mempool=None,
+                 regossip_max: int = 16):
+        self.peer = peer
+        self.txpool = txpool
+        self.atomic_mempool = atomic_mempool
+        self.regossip_max = regossip_max
+        self._recent: "OrderedDict[bytes, None]" = OrderedDict()
+
+    # --------------------------------------------------------------- dedup
+    def _seen(self, h: bytes) -> bool:
+        if h in self._recent:
+            return True
+        self._recent[h] = None
+        if len(self._recent) > RECENT_CACHE:
+            self._recent.popitem(last=False)
+        return False
+
+    # ---------------------------------------------------------------- push
+    def gossip_txs(self, txs: List[Transaction]) -> int:
+        fresh = [tx for tx in txs if not self._seen(tx.hash())]
+        if not fresh:
+            return 0
+        return self.peer.gossip(_encode_gossip(
+            KIND_ETH_TXS, [tx.encode() for tx in fresh]))
+
+    def gossip_atomic_tx(self, tx: AtomicTx) -> int:
+        if self._seen(tx.id()):
+            return 0
+        return self.peer.gossip(_encode_gossip(KIND_ATOMIC_TX,
+                                               [tx.encode()]))
+
+    def regossip(self) -> int:
+        """Periodic re-announce of our best executable txs
+        (queueExecutableTxs :121): nonce-contiguous pending txs ordered
+        by effective tip, capped."""
+        base_fee = self.txpool.chain.current_block().base_fee
+        pending = self.txpool.pending_txs(base_fee)
+        flat: List[Transaction] = []
+        for _addr, txs in pending.items():
+            flat.extend(txs[:2])  # at most 2 per account per round
+        flat.sort(key=lambda tx: -self._tip(tx, base_fee))
+        chosen = flat[:self.regossip_max]
+        if not chosen:
+            return 0
+        # regossip intentionally bypasses the dedup cache: it exists to
+        # re-announce txs the network may have dropped
+        return self.peer.gossip(_encode_gossip(
+            KIND_ETH_TXS, [tx.encode() for tx in chosen]))
+
+    @staticmethod
+    def _tip(tx: Transaction, base_fee: Optional[int]) -> int:
+        if base_fee is None:
+            return tx.gas_price
+        return min(tx.gas_tip_cap, max(tx.gas_fee_cap - base_fee, 0))
+
+    # -------------------------------------------------------------- handle
+    def handle_gossip(self, payload: bytes) -> None:
+        """Incoming AppGossip (GossipHandler :449)."""
+        kind, items = _decode_gossip(payload)
+        if kind == KIND_ETH_TXS:
+            txs = []
+            for raw in items:
+                try:
+                    tx = Transaction.decode(raw)
+                except Exception:  # noqa: BLE001 — bad peer data
+                    continue
+                if not self._seen(tx.hash()):
+                    txs.append(tx)
+            if txs:
+                self.txpool.add_remotes(txs)
+        elif kind == KIND_ATOMIC_TX and self.atomic_mempool is not None:
+            for raw in items:
+                try:
+                    tx = AtomicTx.decode(raw)
+                except Exception:  # noqa: BLE001
+                    continue
+                if not self._seen(tx.id()):
+                    try:
+                        self.atomic_mempool.add_tx(tx)
+                    except Exception:  # noqa: BLE001 — invalid tx
+                        pass

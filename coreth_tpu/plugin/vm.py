@@ -63,6 +63,8 @@ class VM:
         gb.status = Status.ACCEPTED
         self._blocks[gb.id] = gb
         self.preferred_id = gb.id
+        from coreth_tpu.plugin.builder import BlockBuilder
+        self.builder = BlockBuilder(self, clock=self.clock)
         self.initialized = True
 
     def shutdown(self) -> None:
@@ -93,6 +95,7 @@ class VM:
         block = self.miner.generate_block()
         blk = PluginBlock(self, block)
         blk.verify()
+        self.builder.handle_generate_block()
         return blk
 
     def parse_block(self, data: bytes) -> PluginBlock:
@@ -138,8 +141,7 @@ class VM:
         errs = self.txpool.add_remotes([tx])
         if errs and errs[0] is not None:
             raise errs[0]
-        if not self.to_engine or self.to_engine[-1] != PENDING_TXS:
-            self.to_engine.append(PENDING_TXS)
+        self.builder.signal_txs_ready()
 
     def mempool_stats(self):
         self._require_init()

@@ -1,0 +1,81 @@
+"""Local-transaction journal.
+
+Twin of reference core/txpool/journal.go: locally submitted txs append
+to an on-disk journal so they survive restarts; load() replays them
+into the pool, rotate() rewrites the file keeping only the still-
+pending set.  Wire format: length-prefixed tx encodings; torn tails
+from a crash are skipped.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Callable, List
+
+from coreth_tpu.types import Transaction
+
+_LEN = struct.Struct("<I")
+
+
+class TxJournal:
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    # -------------------------------------------------------------- load
+    def load(self, add: Callable[[Transaction], object]) -> int:
+        """Replay journaled txs through `add`; returns accepted count
+        (journal.go load)."""
+        if not os.path.exists(self.path):
+            return 0
+        data = open(self.path, "rb").read()
+        off = 0
+        loaded = 0
+        while off + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, off)
+            if off + _LEN.size + n > len(data):
+                break  # torn tail
+            raw = data[off + _LEN.size:off + _LEN.size + n]
+            off += _LEN.size + n
+            try:
+                tx = Transaction.decode(raw)
+            except Exception:  # noqa: BLE001 — skip corrupt entries
+                continue
+            err = add(tx)
+            if err is None:
+                loaded += 1
+        return loaded
+
+    # ------------------------------------------------------------- insert
+    def _file(self):
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "ab")
+        return self._f
+
+    def insert(self, tx: Transaction) -> None:
+        raw = tx.encode()
+        f = self._file()
+        f.write(_LEN.pack(len(raw)))
+        f.write(raw)
+        f.flush()
+
+    # ------------------------------------------------------------- rotate
+    def rotate(self, all_pending: List[Transaction]) -> None:
+        """Rewrite the journal with only the live set (journal.go
+        rotate)."""
+        self.close()
+        tmp = self.path + ".new"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            for tx in all_pending:
+                raw = tx.encode()
+                f.write(_LEN.pack(len(raw)))
+                f.write(raw)
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

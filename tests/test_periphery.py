@@ -1,0 +1,195 @@
+"""Txpool periphery: journal, block-build pacing, gossip over the app
+network, atomic mempool conflict/price semantics.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.atomic import (
+    ChainContext, EVMInput, EVMOutput, TransferableInput,
+    TransferableOutput, Tx, UnsignedExportTx, UnsignedImportTx,
+)
+from coreth_tpu.atomic.mempool import AtomicMempool, MempoolError
+from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.peer import AppNetwork
+from coreth_tpu.plugin.builder import BlockBuilder
+from coreth_tpu.plugin.gossiper import Gossiper
+from coreth_tpu.txpool import TxPool
+from coreth_tpu.txpool.journal import TxJournal
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+
+GWEI = 10**9
+KEY = 0x1D01
+ADDR = priv_to_address(KEY)
+KEY2 = 0x1D02
+ADDR2 = priv_to_address(KEY2)
+CTX = ChainContext()
+
+
+def make_chain():
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDR: GenesisAccount(balance=10**24),
+                             ADDR2: GenesisAccount(balance=10**24)})
+    return BlockChain(genesis)
+
+
+def make_tx(nonce, key=KEY, tip=GWEI):
+    return sign_tx(DynamicFeeTx(
+        chain_id_=CFG.chain_id, nonce=nonce, gas_tip_cap_=tip,
+        gas_fee_cap_=300 * GWEI, gas=21_000, to=b"\x51" * 20,
+        value=1), key, CFG.chain_id)
+
+
+# ------------------------------------------------------------- journal
+
+def test_tx_journal_roundtrip_and_rotate(tmp_path):
+    path = str(tmp_path / "journal.rlp")
+    j = TxJournal(path)
+    txs = [make_tx(i) for i in range(3)]
+    for tx in txs:
+        j.insert(tx)
+    j.close()
+    # torn tail from a crash is skipped
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00half")
+    loaded = []
+    j2 = TxJournal(path)
+    assert j2.load(lambda tx: loaded.append(tx) and None) == 3
+    assert [t.hash() for t in loaded] == [t.hash() for t in txs]
+    # rotate keeps only the live set
+    j2.rotate(txs[1:])
+    loaded2 = []
+    TxJournal(path).load(lambda tx: loaded2.append(tx) and None)
+    assert [t.hash() for t in loaded2] == [t.hash() for t in txs[1:]]
+
+
+def test_txpool_journal_integration(tmp_path):
+    """Local txs journaled by the caller replay into a fresh pool."""
+    chain = make_chain()
+    pool = TxPool(CFG, chain)
+    j = TxJournal(str(tmp_path / "j.rlp"))
+    for i in range(2):
+        tx = make_tx(i)
+        pool.add_local(tx)
+        j.insert(tx)
+    j.close()
+    pool2 = TxPool(CFG, make_chain())
+    accepted = j.load(lambda tx: pool2.add_remotes([tx])[0])
+    assert accepted == 2
+    assert pool2.stats()[0] == 2
+
+
+# ------------------------------------------------------------- builder
+
+def test_block_builder_pacing():
+    t = [1000.0]
+
+    class FakeVM:
+        pass
+
+    chain = make_chain()
+    vm = FakeVM()
+    vm.txpool = TxPool(CFG, chain)
+    from collections import deque
+    vm.to_engine = deque()
+    builder = BlockBuilder(vm, clock=lambda: t[0], min_interval=0.5)
+    assert not builder.signal_txs_ready()  # nothing pending
+    vm.txpool.add_remotes([make_tx(0)])
+    assert builder.signal_txs_ready()
+    assert list(vm.to_engine) == ["PendingTxs"]
+    assert not builder.signal_txs_ready()  # already signaled
+    vm.to_engine.clear()
+    builder.handle_generate_block()       # build happened at t=1000
+    vm.to_engine.clear()
+    assert not builder.signal_txs_ready()  # rate limited
+    t[0] += 1.0
+    assert builder.signal_txs_ready()      # window passed
+
+
+# -------------------------------------------------------------- gossip
+
+def test_gossip_propagates_txs_between_nodes():
+    net = AppNetwork()
+    chain_a, chain_b = make_chain(), make_chain()
+    pool_a, pool_b = TxPool(CFG, chain_a), TxPool(CFG, chain_b)
+    g = {}
+    for name, pool in ((b"A" * 20, pool_a), (b"B" * 20, pool_b)):
+        peer = net.join(name)
+        g[name] = Gossiper(peer, pool)
+        peer.gossip_handler = g[name].handle_gossip
+    tx = make_tx(0)
+    pool_a.add_local(tx)
+    sent = g[b"A" * 20].gossip_txs([tx])
+    assert sent == 1
+    assert pool_b.has(tx.hash())
+    # dedup: same tx does not gossip twice
+    assert g[b"A" * 20].gossip_txs([tx]) == 0
+    # regossip bypasses dedup and re-announces best pending
+    assert g[b"A" * 20].regossip() == 1
+
+
+# ------------------------------------------------------- atomic mempool
+
+def _import_tx(utxo_tx_id: bytes, amount: int, burn: int) -> Tx:
+    unsigned = UnsignedImportTx(
+        network_id=CTX.network_id, blockchain_id=CTX.chain_id,
+        source_chain=CTX.x_chain_id,
+        imported_inputs=[TransferableInput(
+            tx_id=utxo_tx_id, output_index=0,
+            asset_id=CTX.avax_asset_id, amount=amount,
+            sig_indices=[0])],
+        outs=[EVMOutput(address=ADDR, amount=amount - burn,
+                        asset_id=CTX.avax_asset_id)])
+    tx = Tx(unsigned)
+    tx.sign([[KEY]])
+    return tx
+
+
+def test_atomic_mempool_price_and_conflicts():
+    pool = AtomicMempool(CTX)
+    cheap = _import_tx(b"\x01" * 32, 10_000_000, burn=1_000)
+    rich = _import_tx(b"\x01" * 32, 10_000_000, burn=900_000)  # same UTXO
+    other = _import_tx(b"\x02" * 32, 10_000_000, burn=50_000)
+    pool.add_tx(cheap)
+    with pytest.raises(MempoolError):
+        pool.add_tx(cheap)  # duplicate
+    # higher-paying conflict evicts the cheaper spender
+    pool.add_tx(rich)
+    assert not pool.has(cheap.id())
+    # a cheaper conflict is refused
+    with pytest.raises(MempoolError):
+        pool.add_tx(cheap)
+    pool.add_tx(other)
+    assert pool.pending_len() == 2
+    # building pulls highest price first and marks issued
+    first = pool.next_tx()
+    assert first.id() == rich.id()
+    assert pool.pending_len() == 1
+    # conflicts with issued txs are refused outright
+    with pytest.raises(MempoolError):
+        pool.add_tx(cheap)
+    # cancel returns it to pending; accepted removal clears everything
+    pool.cancel_current_tx(rich.id())
+    assert pool.pending_len() == 2
+    pool.remove_accepted([rich.id(), other.id()])
+    assert len(pool) == 0
+
+
+def test_atomic_mempool_eviction_cap():
+    pool = AtomicMempool(CTX, max_size=2)
+    a = _import_tx(b"\x0A" * 32, 10_000_000, burn=10_000)
+    b = _import_tx(b"\x0B" * 32, 10_000_000, burn=20_000)
+    c = _import_tx(b"\x0C" * 32, 10_000_000, burn=30_000)
+    pool.add_tx(a)
+    pool.add_tx(b)
+    pool.add_tx(c)          # evicts the cheapest (a)
+    assert not pool.has(a.id()) and pool.has(c.id())
+    weak = _import_tx(b"\x0D" * 32, 10_000_000, burn=1_000)
+    with pytest.raises(MempoolError):
+        pool.add_tx(weak)   # cheaper than everything resident
