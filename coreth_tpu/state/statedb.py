@@ -17,9 +17,15 @@ journal.go:
 - access list (EIP-2929), transient storage (EIP-1153), refunds, logs and
   predicate storage slots all journal-revert correctly.
 
-Not modeled (documented divergence, revisit with the snapshot layer):
-same-tx destruct+resurrect of one address keeps the old storage trie —
-geth semantics wipe it.  Cross-tx destruct+resurrect IS handled.
+Same-tx destruct+resurrect: unreachable through the EVM — a CREATE2
+onto an address self-destructed earlier in the same tx fails the
+address-collision check (the account keeps its code until the tx-end
+Finalise), which matches geth; the destructed account's state stays
+readable until tx end and is deleted at Finalise (both geth-matching,
+pinned by tests/test_statetests.py).  Cross-tx destruct+resurrect
+creates a fresh object with wiped storage.  Callers driving the
+StateDB API directly (not through the EVM) should use create_account
+for resurrection, which also wipes storage.
 """
 
 from __future__ import annotations

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""Generate the self-pinned GeneralStateTests-format corpus.
+
+Each family below builds fixtures in the upstream JSON layout with the
+expected post-state root + logs hash computed by the current
+implementation, then written to <family>.json — regression vectors
+that pin semantics (incl. exact gas, folded into the coinbase balance
+and therefore the root) against future change.  Re-run after an
+INTENTIONAL semantics change: `python tests/statetests/generate.py`.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from coreth_tpu.tests_harness import (  # noqa: E402
+    _fixture_pre, run_state_test,
+)
+
+DIR = os.path.dirname(os.path.abspath(__file__))
+
+SENDER_KEY = "0x" + (45).to_bytes(32, "big").hex()
+from coreth_tpu.crypto.secp256k1 import priv_to_address  # noqa: E402
+SENDER = "0x" + priv_to_address(45).hex()
+COINBASE = "0x" + (b"\xba" * 20).hex()
+TARGET = "0x" + (b"\xcc" * 20).hex()
+OTHER = "0x" + (b"\xdd" * 20).hex()
+
+ENV = {
+    "currentCoinbase": COINBASE,
+    "currentGasLimit": hex(10_000_000),
+    "currentNumber": "0x1",
+    "currentTimestamp": "0x3e8",
+    "currentBaseFee": hex(25 * 10**9),
+}
+
+
+def push(v: int) -> str:
+    raw = v.to_bytes((max(v.bit_length(), 1) + 7) // 8, "big")
+    return f"{0x5F + len(raw):02x}" + raw.hex()
+
+
+def sstore(slot: int) -> str:
+    return push(slot) + "55"
+
+
+def code_store_results(exprs) -> str:
+    """[(code_producing_one_stack_value, slot)] -> runtime hex."""
+    out = ""
+    for code, slot in exprs:
+        out += code + sstore(slot)
+    return out + "00"  # STOP
+
+
+def base_tx(data="0x", gas=500_000, value=0):
+    return {
+        "data": [data], "gasLimit": [hex(gas)], "value": [hex(value)],
+        "gasPrice": hex(30 * 10**9),
+        "nonce": "0x0", "to": TARGET, "secretKey": SENDER_KEY,
+    }
+
+
+def fixture(code_hex: str, tx=None, pre_extra=None, storage=None):
+    pre = {
+        SENDER: {"balance": hex(10**20), "nonce": "0x0"},
+        TARGET: {"balance": "0x0", "nonce": "0x1",
+                 "code": "0x" + code_hex,
+                 **({"storage": storage} if storage else {})},
+    }
+    if pre_extra:
+        pre.update(pre_extra)
+    return {"env": dict(ENV), "pre": pre,
+            "transaction": tx or base_tx(), "post": {}}
+
+
+FAMILIES = {}
+
+# ---------------------------------------------------------- arithmetic
+FAMILIES["arith"] = {
+    "addSubMulDiv": fixture(code_store_results([
+        (push(3) + push(4) + "01", 1),          # 4+3
+        (push(3) + push(10) + "03", 2),         # 10-3
+        (push(7) + push(6) + "02", 3),          # 6*7
+        (push(3) + push(17) + "04", 4),         # 17/3
+        (push(0) + push(17) + "04", 5),         # div by zero -> 0
+        (push(5) + push(17) + "06", 6),         # 17 mod 5
+    ])),
+    "signedOps": fixture(code_store_results([
+        # -6 / 3 via SDIV
+        (push(3) + push(2**256 - 6) + "05", 1),
+        (push(5) + push(2**256 - 17) + "07", 2),   # -17 smod 5
+        (push(2**255) + push(2**256 - 1) + "05", 3),
+        (push(0) + push(2**256 - 6) + "0b", 4),    # signextend byte 0
+    ])),
+    "modExpChains": fixture(code_store_results([
+        (push(7) + push(5) + push(100) + "08", 1),   # addmod
+        (push(7) + push(5) + push(100) + "09", 2),   # mulmod
+        (push(5) + push(3) + "0a", 3),               # 3**5
+        (push(0) + push(3) + "0a", 4),               # 3**0
+    ])),
+}
+
+# ------------------------------------------------------------ bitwise
+FAMILIES["bitwise"] = {
+    "compareAndBits": fixture(code_store_results([
+        (push(2) + push(1) + "10", 1),    # 1 < 2
+        (push(1) + push(2) + "11", 2),    # 2 > 1
+        (push(1) + push(2**256 - 1) + "12", 3),  # -1 slt 1
+        (push(5) + push(5) + "14", 4),    # eq
+        (push(0) + "15", 5),              # iszero
+        (push(0b1100) + push(0b1010) + "16", 6),
+        (push(0b1100) + push(0b1010) + "17", 7),
+        (push(0b1100) + push(0b1010) + "18", 8),
+        (push(0xFF00) + push(8) + "1c", 9),        # shr
+        (push(1) + push(4) + "1b", 10),            # shl
+        (push(2**256 - 16) + push(2) + "1d", 11),  # sar
+        (push(0xABCD) + push(30) + "1a", 12),      # byte 30
+    ])),
+}
+
+# --------------------------------------------------------------- flow
+FAMILIES["flow"] = {
+    "loopSum": fixture(
+        # sum 1..5 with a JUMPI loop: i slot scratch on stack
+        # pc0: PUSH1 0 (acc) PUSH1 5 (i)
+        # loop: JUMPDEST dup i -> iszero -> exit
+        "60006005"
+        "5b" "80" "15" + push(0x15) + "57"
+        "81" "01" "90" "6001" "90" "03"
+        + push(0x04) + "56"
+        "5b" "50" + sstore(1) + "00"),
+    "badJumpReverts": fixture(push(9) + "56",
+                              tx=base_tx(gas=100_000)),
+}
+
+# ------------------------------------------------------------- storage
+FAMILIES["storage"] = {
+    "sstoreWarmColdZero": fixture(code_store_results([
+        (push(111), 1),               # cold set
+        (push(222), 1),               # warm reset (dirty)
+        (push(0), 2),                 # zero an existing slot (delete)
+        (push(7) + push(3) + "55" + push(3) + "54", 4),  # store+load
+    ]), storage={"0x2": "0x5"}),
+    "transientStorage": fixture(
+        push(9) + push(1) + "5d"      # tstore
+        + push(1) + "5c" + sstore(1)  # tload -> persistent slot
+        + push(2) + "5c" + sstore(2)  # untouched tslot reads 0
+        + "00"),
+}
+
+# -------------------------------------------------------------- memory
+FAMILIES["memory"] = {
+    "memOpsAndKeccak": fixture(code_store_results([
+        (push(0xDEADBEEF) + push(0) + "52"
+         + push(0) + "51", 1),                     # mstore+mload
+        (push(0xAB) + push(64) + "53" + push(64) + "51", 2),  # mstore8
+        ("59", 3),                                 # msize
+        (push(32) + push(0) + "20", 4),            # keccak256(mem[0:32])
+    ])),
+}
+
+# ------------------------------------------------------------- context
+FAMILIES["context"] = {
+    "envOpcodes": fixture(code_store_results([
+        ("30", 1), ("33", 2), ("34", 3), ("36", 4),
+        ("3a", 5), ("43", 6), ("42", 7), ("46", 8),
+        ("47", 9), ("48", 10), ("45", 11),
+    ]), tx=base_tx(data="0x" + "11" * 7, value=12345)),
+}
+
+# --------------------------------------------------------------- calls
+CALLEE = "0x" + (b"\xee" * 20).hex()
+FAMILIES["calls"] = {
+    "callValueTransfer": fixture(
+        # CALL OTHER with 7 wei then store returned status
+        push(0) * 4 + push(7) + "73" + OTHER[2:] + push(50_000)[0:]
+        + "f1" + sstore(1) + "00",
+        tx=base_tx(value=100)),
+    "delegatecallStorageCtx": fixture(
+        # delegatecall CALLEE whose code writes slot 9 := 42; the write
+        # must land in TARGET's storage
+        push(0) * 4 + "73" + CALLEE[2:] + push(100_000)
+        + "f4" + sstore(1) + "00",
+        pre_extra={CALLEE: {"balance": "0x0", "nonce": "0x1",
+                            "code": "0x" + push(42) + sstore(9) + "00"}}),
+    "staticcallWriteProtected": fixture(
+        # staticcall into CALLEE (which SSTOREs) must fail -> status 0
+        push(0) * 4 + "73" + CALLEE[2:] + push(100_000)
+        + "fa" + sstore(1) + "00",
+        pre_extra={CALLEE: {"balance": "0x0", "nonce": "0x1",
+                            "code": "0x" + push(1) + sstore(1) + "00"}}),
+}
+
+# -------------------------------------------------------------- create
+INIT = push(77) + sstore(5) + push(0) + push(0) + "f3"
+INIT_BYTES = bytes.fromhex(INIT)
+FAMILIES["create"] = {
+    "createStoresAndNonce": fixture(
+        # mstore init right-aligned; CREATE(0, 32-len, len); store addr
+        "7f" + INIT_BYTES.rjust(32, b"\x00").hex() + push(0) + "52"
+        + push(len(INIT_BYTES)) + push(32 - len(INIT_BYTES)) + push(0)
+        + "f0" + sstore(1) + "00"),
+    "create2Deterministic": fixture(
+        "7f" + INIT_BYTES.rjust(32, b"\x00").hex() + push(0) + "52"
+        + push(9) + push(len(INIT_BYTES)) + push(32 - len(INIT_BYTES))
+        + push(0) + "f5" + sstore(1) + "00"),
+}
+
+# ---------------------------------------------------------------- logs
+FAMILIES["logs"] = {
+    "logTopics": fixture(
+        push(0xFEED) + push(0) + "52"
+        + push(0xA1) + push(0xB2)
+        + push(32) + push(0) + "a2"            # LOG2
+        + push(32) + push(0) + "a0"            # LOG0
+        + "00"),
+}
+
+# -------------------------------------------------------- access lists
+AL_TX = base_tx()
+AL_TX["accessLists"] = [[
+    {"address": TARGET, "storageKeys": ["0x" + "00" * 31 + "01",
+                                        "0x" + "00" * 31 + "05"]},
+]]
+FAMILIES["accesslist"] = {
+    "warmSlotsViaAccessList": {
+        "env": dict(ENV),
+        "pre": {
+            SENDER: {"balance": hex(10**20), "nonce": "0x0"},
+            TARGET: {"balance": "0x0", "nonce": "0x1",
+                     "code": "0x" + push(1) + "54" + sstore(2)
+                     + push(5) + "54" + sstore(3) + "00",
+                     "storage": {"0x1": "0x9", "0x5": "0x8"}},
+        },
+        "transaction": AL_TX, "post": {},
+    },
+}
+
+# ----------------------------------------------------------- exceptions
+FAMILIES["exceptions"] = {
+    "outOfGasReverts": fixture(push(1) + sstore(1) + "00",
+                               tx=base_tx(gas=21_020)),
+    "insufficientBalance": {
+        "env": dict(ENV),
+        "pre": {SENDER: {"balance": hex(10**15), "nonce": "0x0"}},
+        "transaction": {**base_tx(value=10**19), "to": OTHER},
+        "post": {},
+        "_expect_exception": True,
+    },
+}
+
+# ---------------------------------------------------------- selfdestruct
+FAMILIES["selfdestruct"] = {
+    "selfdestructSendsBalance": fixture(
+        "73" + OTHER[2:] + "ff",
+        tx=base_tx(value=5000),
+        pre_extra={OTHER: {"balance": "0x1", "nonce": "0x0"}}),
+}
+
+
+def main():
+    total = 0
+    for family, tests in FAMILIES.items():
+        out = {}
+        for name, fx in tests.items():
+            expect_exc = fx.pop("_expect_exception", False) \
+                if isinstance(fx, dict) else False
+            post_entry = {"indexes": {"data": 0, "gas": 0, "value": 0}}
+            if expect_exc:
+                post_entry["expectException"] = "tx invalid"
+                post_entry["hash"] = "0x" + "00" * 32
+                post_entry["logs"] = "0x" + "00" * 32
+                fx["post"] = {"Coreth": [post_entry]}
+                out[name] = fx
+                total += 1
+                continue
+            # compute the pinned post root/logs by executing once
+            from coreth_tpu.tests_harness import (
+                _run_one, FORKS, logs_hash,
+            )
+            _fixture_pre[name] = fx["pre"]
+            probe = dict(post_entry)
+            probe["hash"] = "0x" + "00" * 32
+            probe["logs"] = "0x" + "00" * 32
+            res = _run_one(name, FORKS["Coreth"], fx["env"],
+                           fx["transaction"], probe,
+                           probe["indexes"])
+            if "tx failed" in res.detail:
+                raise SystemExit(f"{family}/{name}: {res.detail}")
+            got_root, got_logs = res.detail.split(" | ")
+            post_entry["hash"] = "0x" + got_root.split()[1]
+            post_entry["logs"] = "0x" + got_logs.split()[1]
+            fx["post"] = {"Coreth": [post_entry]}
+            out[name] = fx
+            total += 1
+        path = os.path.join(DIR, f"{family}.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"wrote {path}")
+    print(f"{total} fixtures")
+
+
+if __name__ == "__main__":
+    main()
