@@ -1,0 +1,215 @@
+"""Snapshot flat-state layer.
+
+Twin of reference core/state/snapshot/ (snapshot.go:186 Tree, :211 New,
+:326 Update, :400 Flatten; difflayer.go; generate.go): a flat
+hash-keyed view of the world state — O(1) account and storage reads
+that bypass trie traversal — maintained as a disk layer plus one
+in-memory diff layer per processed block.  Layers are keyed by BLOCK
+hash (the coreth-specific departure from geth's root keying, needed
+because competing siblings can share state roots), and a block's diff
+is flattened toward the disk layer when consensus accepts it.
+
+Keys are keccak(address) / keccak(slot) exactly as the secure tries
+store them, so the generator can seed a snapshot straight from a trie
+and the StateDB read path can consult the snapshot before the trie.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from coreth_tpu.crypto import keccak256
+
+# a deleted account/slot in a diff layer
+DELETED = b""
+
+
+class SnapshotError(Exception):
+    pass
+
+
+class DiskLayer:
+    """The base flat state (disklayer.go role)."""
+
+    def __init__(self, root: bytes):
+        self.root = root
+        self.accounts: Dict[bytes, bytes] = {}   # keccak(addr) -> RLP
+        self.storage: Dict[Tuple[bytes, bytes], bytes] = {}
+
+    def account(self, addr_hash: bytes) -> Optional[bytes]:
+        return self.accounts.get(addr_hash)
+
+    def storage_slot(self, addr_hash: bytes,
+                     slot_hash: bytes) -> Optional[bytes]:
+        return self.storage.get((addr_hash, slot_hash))
+
+
+class DiffLayer:
+    """One block's state delta over its parent (difflayer.go)."""
+
+    def __init__(self, parent, block_hash: bytes, root: bytes,
+                 accounts: Dict[bytes, bytes],
+                 storage: Dict[Tuple[bytes, bytes], bytes]):
+        self.parent = parent
+        self.block_hash = block_hash
+        self.root = root
+        self.accounts = accounts
+        self.storage = storage
+
+    # reads walk the diff chain down to the disk layer
+    def account(self, addr_hash: bytes) -> Optional[bytes]:
+        layer = self
+        while isinstance(layer, DiffLayer):
+            if addr_hash in layer.accounts:
+                v = layer.accounts[addr_hash]
+                return None if v == DELETED else v
+            layer = layer.parent
+        return layer.account(addr_hash)
+
+    def storage_slot(self, addr_hash: bytes,
+                     slot_hash: bytes) -> Optional[bytes]:
+        layer = self
+        key = (addr_hash, slot_hash)
+        while isinstance(layer, DiffLayer):
+            if key in layer.storage:
+                v = layer.storage[key]
+                return None if v == DELETED else v
+            if addr_hash in layer.accounts \
+                    and layer.accounts[addr_hash] == DELETED:
+                return None  # destructed: nothing below survives
+            layer = layer.parent
+        return layer.storage_slot(addr_hash, slot_hash)
+
+
+class Tree:
+    """Layer manager keyed by block hash (snapshot.go Tree)."""
+
+    def __init__(self, base_root: bytes,
+                 genesis_hash: bytes = b"\x00" * 32):
+        self.disk = DiskLayer(base_root)
+        self.disk_block = genesis_hash
+        self.layers: Dict[bytes, DiffLayer] = {}
+
+    # ------------------------------------------------------------- lookup
+    def snapshot(self, block_hash: bytes):
+        """The readable layer for a processed block (or the disk layer
+        for the block it represents)."""
+        if block_hash == self.disk_block:
+            return self.disk
+        return self.layers.get(block_hash)
+
+    # ------------------------------------------------------------- update
+    def update(self, block_hash: bytes, parent_hash: bytes, root: bytes,
+               accounts: Dict[bytes, bytes],
+               storage: Dict[Tuple[bytes, bytes], bytes]) -> None:
+        """New diff layer for a processed block (snapshot.go:326);
+        values of DELETED mark removals."""
+        parent = self.snapshot(parent_hash)
+        if parent is None:
+            raise SnapshotError(
+                f"parent snapshot {parent_hash.hex()} missing")
+        if block_hash in self.layers:
+            raise SnapshotError("duplicate snapshot layer")
+        self.layers[block_hash] = DiffLayer(
+            parent, block_hash, root, dict(accounts), dict(storage))
+
+    # ------------------------------------------------------------ flatten
+    def flatten(self, block_hash: bytes) -> None:
+        """Consensus accepted `block_hash`: merge its (now unique) diff
+        chain into the disk layer and drop rejected siblings
+        (snapshot.go:400 Flatten — blockHash-keyed)."""
+        layer = self.layers.get(block_hash)
+        if layer is None:
+            raise SnapshotError(f"no layer for {block_hash.hex()}")
+        # collect the chain disk..block
+        chain: List[DiffLayer] = []
+        node = layer
+        while isinstance(node, DiffLayer):
+            chain.append(node)
+            node = node.parent
+        for diff in reversed(chain):
+            for ah, v in diff.accounts.items():
+                if v == DELETED:
+                    self.disk.accounts.pop(ah, None)
+                    for key in [k for k in self.disk.storage
+                                if k[0] == ah]:
+                        del self.disk.storage[key]
+                else:
+                    self.disk.accounts[ah] = v
+            for key, v in diff.storage.items():
+                if v == DELETED:
+                    self.disk.storage.pop(key, None)
+                else:
+                    self.disk.storage[key] = v
+        self.disk.root = layer.root
+        old_disk_block = self.disk_block
+        self.disk_block = block_hash
+        # drop every layer at or below the accepted height band whose
+        # ancestry does not include the accepted block (rejected
+        # siblings), and re-parent direct children onto the disk layer
+        dead = set(d.block_hash for d in chain)
+        survivors: Dict[bytes, DiffLayer] = {}
+        for bh, l in self.layers.items():
+            if bh in dead:
+                continue
+            # walk ancestry: keep only layers descending from the
+            # accepted block
+            node = l
+            descends = False
+            while isinstance(node, DiffLayer):
+                if node.block_hash == block_hash:
+                    descends = True
+                    break
+                node = node.parent
+            if descends:
+                if isinstance(l.parent, DiffLayer) \
+                        and l.parent.block_hash == block_hash:
+                    l.parent = self.disk
+                survivors[bh] = l
+        self.layers = survivors
+
+
+# ----------------------------------------------------------- generation
+
+def generate_from_trie(db, state_root: bytes,
+                       genesis_hash: bytes = b"\x00" * 32) -> Tree:
+    """Build a snapshot tree from a committed state trie (generate.go
+    role, synchronous)."""
+    from coreth_tpu.mpt.iterator import leaves, nibbles_to_key
+    from coreth_tpu.mpt.trie import Trie
+    from coreth_tpu.types import StateAccount
+    from coreth_tpu.types.account import EMPTY_ROOT_HASH
+
+    tree = Tree(state_root, genesis_hash)
+    account_trie = Trie(root_hash=state_root, db=db.node_db)
+    for addr_hash, raw in leaves(account_trie):
+        tree.disk.accounts[addr_hash] = raw
+        acct = StateAccount.from_rlp(raw)
+        if acct.root != EMPTY_ROOT_HASH:
+            st = Trie(root_hash=acct.root, db=db.node_db)
+            for slot_hash, v in leaves(st):
+                tree.disk.storage[(addr_hash, slot_hash)] = v
+    return tree
+
+
+def diff_from_statedb(statedb) -> Tuple[Dict[bytes, bytes],
+                                        Dict[Tuple[bytes, bytes], bytes]]:
+    """Extract a processed block's account/storage delta in snapshot
+    key space from a finalised+hashed StateDB (the Update feed at
+    blockchain.go writeBlockWithState)."""
+    accounts: Dict[bytes, bytes] = {}
+    storage: Dict[Tuple[bytes, bytes], bytes] = {}
+    for addr, obj in statedb._objects.items():
+        ah = keccak256(addr)
+        if obj.deleted or obj.suicided:
+            accounts[ah] = DELETED
+            continue
+        accounts[ah] = obj.account.rlp()
+        for key, value in obj.origin_storage.items():
+            sh = keccak256(key)
+            if value == b"\x00" * 32:
+                storage[(ah, sh)] = DELETED
+            else:
+                from coreth_tpu import rlp
+                storage[(ah, sh)] = rlp.encode(value.lstrip(b"\x00"))
+    return accounts, storage

@@ -81,9 +81,15 @@ class StateObject:
 
 
 class StateDB:
-    def __init__(self, root: bytes, db: Optional[Database] = None):
+    def __init__(self, root: bytes, db: Optional[Database] = None,
+                 snap=None):
+        """snap: optional snapshot layer (state.snapshot DiskLayer/
+        DiffLayer) — O(1) account/storage reads that bypass the trie
+        (the snapshot read-path acceleration, statedb.go:147 New with
+        snaps).  The trie stays authoritative for hashing."""
         self.db = db if db is not None else Database()
         self.original_root = root
+        self.snap = snap
         self._trie = self.db.open_trie(root)
         self._objects: Dict[bytes, StateObject] = {}
         self._destructed: Set[bytes] = set()
@@ -124,7 +130,10 @@ class StateDB:
 
     # ------------------------------------------------------------- objects
     def _load_account(self, addr: bytes) -> Optional[StateAccount]:
-        data = self._trie.get(addr)
+        if self.snap is not None:
+            data = self.snap.account(keccak256(addr))
+        else:
+            data = self._trie.get(addr)
         if data is None:
             return None
         return StateAccount.from_rlp(data)
@@ -300,6 +309,11 @@ class StateDB:
             return obj.origin_storage[key]
         if obj.fresh:
             value = HASH_ZERO
+        elif self.snap is not None:
+            raw = self.snap.storage_slot(keccak256(obj.address),
+                                         keccak256(key))
+            value = rlp.decode(raw).rjust(32, b"\x00") \
+                if raw is not None else HASH_ZERO
         else:
             trie = self._open_storage_trie(obj)
             raw = trie.get(key)

@@ -1,0 +1,151 @@
+"""Snapshot flat-state layer: generation from tries, O(1) reads feeding
+the StateDB, per-block diff layers keyed by block hash, flatten-on-
+accept with sibling discard.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.chain import Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.state.snapshot import (
+    DELETED, SnapshotError, Tree, diff_from_statedb, generate_from_trie,
+)
+from coreth_tpu.workloads.erc20 import balance_slot, token_genesis_account
+
+KEYS = [0xA500 + i for i in range(6)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+TOKEN = bytes([0x7C]) * 20
+GENESIS_HASH = b"\x00" * 32
+
+
+def build_state():
+    alloc = {a: GenesisAccount(balance=10**20 + i)
+             for i, a in enumerate(ADDRS)}
+    alloc[TOKEN] = token_genesis_account({a: 1000 + i
+                                          for i, a in enumerate(ADDRS)})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    return db, gblock.root
+
+
+def test_generate_and_read_parity():
+    db, root = build_state()
+    tree = generate_from_trie(db, root, GENESIS_HASH)
+    snap = tree.snapshot(GENESIS_HASH)
+    # account reads match the trie-backed StateDB
+    plain = StateDB(root, db)
+    fast = StateDB(root, db, snap=snap)
+    for i, a in enumerate(ADDRS):
+        assert fast.get_balance(a) == plain.get_balance(a)
+        assert fast.get_nonce(a) == plain.get_nonce(a)
+    for i, a in enumerate(ADDRS):
+        assert fast.get_state(TOKEN, balance_slot(a)) == \
+            plain.get_state(TOKEN, balance_slot(a))
+    # absent account/slot
+    assert fast.get_balance(b"\x09" * 20) == 0
+    assert fast.get_state(TOKEN, b"\x09" * 32) == b"\x00" * 32
+
+
+def test_identical_roots_with_snapshot_reads():
+    """Mutating through a snapshot-backed StateDB produces the same
+    root as the trie-backed one (reads accelerated, hashing intact)."""
+    db, root = build_state()
+    tree = generate_from_trie(db, root, GENESIS_HASH)
+
+    def mutate(statedb):
+        statedb.add_balance(ADDRS[0], 777)
+        statedb.sub_balance(ADDRS[1], 5)
+        statedb.set_state(TOKEN, balance_slot(ADDRS[0]),
+                          (4242).to_bytes(32, "big"))
+        statedb.set_state(TOKEN, balance_slot(ADDRS[1]),
+                          b"\x00" * 32)  # delete a slot
+        statedb.finalise(True)
+        return statedb.intermediate_root(True)
+
+    r_plain = mutate(StateDB(root, db))
+    r_fast = mutate(StateDB(root, db,
+                            snap=tree.snapshot(GENESIS_HASH)))
+    assert r_plain == r_fast
+
+
+def test_diff_layers_and_flatten_on_accept():
+    db, root = build_state()
+    tree = generate_from_trie(db, root, GENESIS_HASH)
+
+    # block A: +100 to ADDRS[0]
+    sa = StateDB(root, db, snap=tree.snapshot(GENESIS_HASH))
+    sa.add_balance(ADDRS[0], 100)
+    sa.finalise(True)
+    root_a = sa.intermediate_root(True)
+    sa.commit(True)
+    acc_a, sto_a = diff_from_statedb(sa)
+    tree.update(b"\xAA" * 32, GENESIS_HASH, root_a, acc_a, sto_a)
+
+    # competing sibling B: +999 to ADDRS[1]
+    sb = StateDB(root, db, snap=tree.snapshot(GENESIS_HASH))
+    sb.add_balance(ADDRS[1], 999)
+    sb.finalise(True)
+    root_b = sb.intermediate_root(True)
+    sb.commit(True)
+    acc_b, sto_b = diff_from_statedb(sb)
+    tree.update(b"\xBB" * 32, GENESIS_HASH, root_b, acc_b, sto_b)
+
+    # child of A
+    sc = StateDB(root_a, db, snap=tree.snapshot(b"\xAA" * 32))
+    assert sc.get_balance(ADDRS[0]) == 10**20 + 100  # reads the diff
+    sc.add_balance(ADDRS[0], 1)
+    sc.finalise(True)
+    root_c = sc.intermediate_root(True)
+    sc.commit(True)
+    acc_c, sto_c = diff_from_statedb(sc)
+    tree.update(b"\xCC" * 32, b"\xAA" * 32, root_c, acc_c, sto_c)
+
+    # accept A: flattens into disk, discards sibling B, keeps child C
+    tree.flatten(b"\xAA" * 32)
+    assert tree.disk_block == b"\xAA" * 32
+    assert tree.disk.root == root_a
+    assert tree.snapshot(b"\xBB" * 32) is None
+    assert tree.snapshot(b"\xCC" * 32) is not None
+    # disk now answers with A's state
+    fast = StateDB(root_a, db, snap=tree.snapshot(b"\xAA" * 32))
+    assert fast.get_balance(ADDRS[0]) == 10**20 + 100
+    # C still layers on top
+    fc = StateDB(root_c, db, snap=tree.snapshot(b"\xCC" * 32))
+    assert fc.get_balance(ADDRS[0]) == 10**20 + 101
+    # accepting C flattens the re-parented child cleanly
+    tree.flatten(b"\xCC" * 32)
+    assert tree.disk.root == root_c
+
+
+def test_destructed_account_masks_storage():
+    db, root = build_state()
+    tree = generate_from_trie(db, root, GENESIS_HASH)
+    ah = keccak256(TOKEN)
+    tree.update(b"\xAA" * 32, GENESIS_HASH, b"\x01" * 32,
+                {ah: DELETED}, {})
+    layer = tree.snapshot(b"\xAA" * 32)
+    assert layer.account(ah) is None
+    # storage below the destruction never leaks through
+    from coreth_tpu.state.statedb import normalize_state_key
+    sh = keccak256(normalize_state_key(balance_slot(ADDRS[0])))
+    assert layer.storage_slot(ah, sh) is None
+    tree.flatten(b"\xAA" * 32)
+    assert tree.disk.account(ah) is None
+    assert tree.disk.storage_slot(ah, sh) is None
+
+
+def test_update_requires_parent():
+    db, root = build_state()
+    tree = generate_from_trie(db, root, GENESIS_HASH)
+    with pytest.raises(SnapshotError):
+        tree.update(b"\x01" * 32, b"\x99" * 32, b"\x00" * 32, {}, {})
