@@ -84,12 +84,25 @@ def _encode(node) -> bytes:
     return rlp.encode(items)
 
 
-# Default threshold: on a tunneled TPU every device call pays ~100ms of
-# sync latency, so the host native keccak wins until the dirty frontier
-# is tens of thousands of nodes; locally-attached chips can lower this
-# via CORETH_REHASH_MIN_BATCH.
+# Default threshold — measured, not guessed (tools/rehash_crossover.py
+# on the tunneled v5e chip, 2026-07-30):
+#
+#    dirty    host_s  device_s
+#      256    0.0031    0.5475
+#     1024    0.0163    0.5677
+#     4096    0.0672    0.7982
+#    16384    0.5273    1.5778
+#    65536    2.1271    4.6740
+#   262144    9.8625   17.2645
+#
+# The host C++ keccak path wins at EVERY measured size on this
+# transport (per-level serialization + tunnel transfers dominate the
+# device path), so the default effectively disables device rehash;
+# locally-attached chips should re-measure and set
+# CORETH_REHASH_MIN_BATCH accordingly.
 import os as _os
-DEFAULT_MIN_BATCH = int(_os.environ.get("CORETH_REHASH_MIN_BATCH", "20000"))
+DEFAULT_MIN_BATCH = int(_os.environ.get("CORETH_REHASH_MIN_BATCH",
+                                        "1000000"))
 
 
 def device_rehash(trie: Trie, min_batch: int = DEFAULT_MIN_BATCH,

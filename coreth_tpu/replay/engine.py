@@ -16,10 +16,11 @@ tx loop) for TPU:
    nonce-sequence and solvency validation included.  The solvency
    checks ignore same-block credits, so success implies the sequential
    result (credits only help); any doubt falls back.
-3. **Hash** (device): account + touched storage tries updated
-   structurally on host, then level-synchronous batched keccak rehash
-   (mpt/rehash) reproduces the state root bit-identically; it is
-   checked against the header.
+3. **Hash** (host-native): account + touched storage tries fold and
+   rehash in C++ (mpt/native_trie over native/baseline.cc) when the
+   native runtime is built — bit-identical roots checked against the
+   header; pure-python tries (with the measured mpt/rehash device
+   policy) remain the fallback and interop format.
 
 State is shared with the host path through the same state Database, so
 both engines can interleave over one chain.
@@ -167,27 +168,41 @@ def _slot_step(slot_vals, from_slot, to_slot, amount16, mask,
     return new_vals, ok
 
 
-@partial(jax.jit, static_argnames=("num_accounts", "num_slots"))
-def _transfer_window(balances, nonces, slot_vals, txds, t_idxs, s_idxs,
-                     num_accounts: int, num_slots: int):
-    """A WINDOW of blocks in one device call: lax.scan over the packed
-    per-block batches, emitting one fetch tensor per block.
+@jax.jit
+def _transfer_window(balances, nonces, slot_vals, acct_gids, slot_gids,
+                     txds, t_idxs, s_idxs):
+    """A WINDOW of blocks in one device call, over a WINDOW-LOCAL
+    working set: gather the touched accounts/slots into small local
+    arrays, lax.scan the per-block batches against them (segment sums
+    over L locals instead of the whole table), then scatter the finals
+    back — so per-step device work scales with the window's touched
+    set, not with global state size.  This is the shape that amortizes
+    the host<->device round trip AND keeps the kernel
+    capacity-independent (the commit-interval batching analog,
+    core/state_manager.go:74: one upload, one scan, one download).
 
-    This is the shape that amortizes the host<->device round trip over
-    the whole window — the TPU-native analog of the reference's
-    commit-interval batching (core/state_manager.go:74): one upload, one
-    scan, one download.
+    acct_gids/slot_gids: [L]/[SL] global row ids of the local slots;
+    padding entries are out-of-bounds and gather zeros / scatter-drop.
+    txds carry LOCAL indices.
     """
+    lb = balances.at[acct_gids].get(mode="fill", fill_value=0)
+    ln = nonces.at[acct_gids].get(mode="fill", fill_value=0)
+    ls = slot_vals.at[slot_gids].get(mode="fill", fill_value=0)
+    L = acct_gids.shape[0]
+    SL = slot_gids.shape[0]
+
     def body(carry, inp):
         bal, non, sv = carry
         txd, t_idx, s_idx = inp
-        nb, nn, nsv, ok = _step_core(bal, non, sv, txd, num_accounts,
-                                     num_slots)
+        nb, nn, nsv, ok = _step_core(bal, non, sv, txd, L, SL)
         return (nb, nn, nsv), _gather_fetch(nb, nn, nsv, ok, t_idx, s_idx)
 
-    (bal, non, sv), fetches = jax.lax.scan(
-        body, (balances, nonces, slot_vals), (txds, t_idxs, s_idxs))
-    return bal, non, sv, fetches
+    (lb, ln, ls), fetches = jax.lax.scan(
+        body, (lb, ln, ls), (txds, t_idxs, s_idxs))
+    nb = balances.at[acct_gids].set(lb, mode="drop")
+    nn = nonces.at[acct_gids].set(ln, mode="drop")
+    nsv = slot_vals.at[slot_gids].set(ls, mode="drop")
+    return nb, nn, nsv, fetches
 
 
 @partial(jax.jit, static_argnames=("num_accounts",))
@@ -356,7 +371,14 @@ class ReplayEngine:
                  slot_capacity: Optional[int] = None):
         self.config = config
         self.db = db
+        from coreth_tpu.mpt import native_trie
+        self._native = native_trie.available()
         self.trie = db.open_trie(state_root)
+        if self._native:
+            # C++ trie for the hot fold (bit-identical roots pinned by
+            # tests); python tries remain the interop format in the db
+            self.trie = native_trie.NativeSecureTrie.from_python_trie(
+                self.trie)
         self.state = DeviceState(capacity, slot_capacity or capacity)
         self.signer = LatestSigner(config.chain_id)
         self.engine = DummyEngine()
@@ -390,8 +412,18 @@ class ReplayEngine:
         if st is None:
             idx = self.state.index[contract]
             st = self.db.open_trie(self.state.roots[idx])
+            if self._native:
+                from coreth_tpu.mpt.native_trie import NativeSecureTrie
+                st = NativeSecureTrie.from_python_trie(st)
             self.storage_tries[contract] = st
         return st
+
+    def _rehash(self, trie) -> bytes:
+        """Root of a fold target: native tries hash in C++; python
+        tries go through the measured rehash policy (mpt/rehash)."""
+        if self._native:
+            return trie.hash()
+        return device_rehash(trie)
 
     def _slot(self, contract: bytes, key: bytes) -> int:
         """Device slot index for (contract, EVM-level storage key),
@@ -666,10 +698,38 @@ class ReplayEngine:
         s_pad = 8
         touched_lists = []
         slot_lists = []
+        # window-local index spaces: the device works on gathered
+        # locals, so kernel cost scales with the window's touched set,
+        # not the global table capacity
+        acct_local: Dict[int, int] = {}
+        slot_local: Dict[int, int] = {0: 0}  # local slot 0 = the dummy
+
+        def a_loc(g: int) -> int:
+            l = acct_local.get(g)
+            if l is None:
+                l = len(acct_local)
+                acct_local[g] = l
+            return l
+
+        def s_loc(g: int) -> int:
+            l = slot_local.get(g)
+            if l is None:
+                l = len(slot_local)
+                slot_local[g] = l
+            return l
+
+        local_batches = []
         for block, batch in items:
             B = len(block.transactions)
             while pad < B:
                 pad *= 2
+            lb = dict(batch)
+            lb["senders"] = [a_loc(g) for g in batch["senders"]]
+            lb["recips"] = [a_loc(g) for g in batch["recips"]]
+            lb["coinbase"] = a_loc(batch["coinbase"])
+            lb["from_slots"] = [s_loc(g) for g in batch["from_slots"]]
+            lb["to_slots"] = [s_loc(g) for g in batch["to_slots"]]
+            local_batches.append(lb)
             touched = sorted(set(batch["senders"]) | set(batch["recips"])
                              | {batch["coinbase"]})
             touched_lists.append(touched)
@@ -680,30 +740,46 @@ class ReplayEngine:
             slot_lists.append(slots)
             while s_pad < len(slots):
                 s_pad *= 2
+        L = 256
+        while L < len(acct_local):
+            L *= 2
+        SL = 8
+        while SL < len(slot_local):
+            SL *= 2
+        cap = self.state.capacity
+        scap = self.state.slot_capacity
+        acct_gids = np.full(L, cap, dtype=np.int32)  # OOB pad: fill/drop
+        for g, l in acct_local.items():
+            acct_gids[l] = g
+        slot_gids = np.full(SL, scap, dtype=np.int32)
+        for g, l in slot_local.items():
+            slot_gids[l] = g
         txds = np.zeros((K, pad, TXD_COLS), dtype=np.int32)
         t_idxs = np.zeros((K, t_pad), dtype=np.int32)
         s_idxs = np.zeros((K, s_pad), dtype=np.int32)
         for k, (block, batch) in enumerate(items):
             B = len(block.transactions)
-            txds[k] = pack_txd(batch, B, pad)
-            t_idxs[k, :len(touched_lists[k])] = touched_lists[k]
-            s_idxs[k, :len(slot_lists[k])] = slot_lists[k]
-        return txds, t_idxs, s_idxs, touched_lists, slot_lists
+            txds[k] = pack_txd(local_batches[k], B, pad)
+            t_idxs[k, :len(touched_lists[k])] = \
+                [acct_local[g] for g in touched_lists[k]]
+            s_idxs[k, :len(slot_lists[k])] = \
+                [slot_local[g] for g in slot_lists[k]]
+        return (txds, t_idxs, s_idxs, acct_gids, slot_gids,
+                touched_lists, slot_lists)
 
     def _issue_window(self, items: List[Tuple[Block, dict]]) -> dict:
         """One device call for a whole run of transfer blocks: upload the
         stacked batches, lax.scan the steps, download one stacked fetch
         tensor.  Round-trip latency amortizes over the window."""
         t0 = time.monotonic()
-        txds, t_idxs, s_idxs, touched_lists, slot_lists = \
-            self._prepare_window(items)
+        (txds, t_idxs, s_idxs, acct_gids, slot_gids, touched_lists,
+         slot_lists) = self._prepare_window(items)
         prev = (self.state.balances, self.state.nonces,
                 self.state.slot_vals)
         new_bal, new_non, new_sv, fetches = _transfer_window(
-            prev[0], prev[1], prev[2], jnp.asarray(txds),
-            jnp.asarray(t_idxs), jnp.asarray(s_idxs),
-            num_accounts=self.state.capacity,
-            num_slots=self.state.slot_capacity)
+            prev[0], prev[1], prev[2], jnp.asarray(acct_gids),
+            jnp.asarray(slot_gids), jnp.asarray(txds),
+            jnp.asarray(t_idxs), jnp.asarray(s_idxs))
         self.state.balances = new_bal
         self.state.nonces = new_non
         self.state.slot_vals = new_sv
@@ -742,13 +818,13 @@ class ReplayEngine:
          self.state.slot_vals) = win["prev"]
         if k > 0:
             items = win["items"][:k]
-            txds, t_idxs, s_idxs, _, _ = self._prepare_window(items)
+            (txds, t_idxs, s_idxs, acct_gids, slot_gids, _,
+             _) = self._prepare_window(items)
             new_bal, new_non, new_sv, _ = _transfer_window(
                 self.state.balances, self.state.nonces,
-                self.state.slot_vals, jnp.asarray(txds),
-                jnp.asarray(t_idxs), jnp.asarray(s_idxs),
-                num_accounts=self.state.capacity,
-                num_slots=self.state.slot_capacity)
+                self.state.slot_vals, jnp.asarray(acct_gids),
+                jnp.asarray(slot_gids), jnp.asarray(txds),
+                jnp.asarray(t_idxs), jnp.asarray(s_idxs))
             self.state.balances = new_bal
             self.state.nonces = new_non
             self.state.slot_vals = new_sv
@@ -802,27 +878,57 @@ class ReplayEngine:
                 changed[contract] = st
             for contract, st in changed.items():
                 self.state.roots[self.state.index[contract]] = \
-                    device_rehash(st)
+                    self._rehash(st)
         n_touched = len(touched)
         balances = u256.to_ints(fetched[:n_touched, :16])
         nonces = fetched[:n_touched, 16]
-        for i, idx in enumerate(touched):
-            addr = self.state.addrs[idx]
-            balance, nonce = balances[i], int(nonces[i])
-            code_hash = self.state.code_hashes[idx]
-            storage_root = self.state.roots[idx]
-            if (balance == 0 and nonce == 0
-                    and code_hash == EMPTY_CODE_HASH
-                    and storage_root == EMPTY_ROOT_HASH
-                    and not self.state.multicoin[idx]):
-                # touched but empty: EIP-158 deletion semantics
-                self.trie.delete(addr)
-            else:
-                self.trie.update(addr, StateAccount(
-                    nonce=nonce, balance=balance, root=storage_root,
-                    code_hash=code_hash,
-                    is_multi_coin=self.state.multicoin[idx]).rlp())
-        root = device_rehash(self.trie)
+        if self._native:
+            # one ctypes call folds the whole block; RLP happens in C++
+            keys = bytearray()
+            bals = bytearray()
+            roots = bytearray()
+            hashes = bytearray()
+            mc = bytearray(n_touched)
+            dels = bytearray(n_touched)
+            nlist = []
+            from coreth_tpu.crypto import keccak256 as _k
+            for i, idx in enumerate(touched):
+                keys += _k(self.state.addrs[idx])
+                balance, nonce = balances[i], int(nonces[i])
+                code_hash = self.state.code_hashes[idx]
+                storage_root = self.state.roots[idx]
+                if (balance == 0 and nonce == 0
+                        and code_hash == EMPTY_CODE_HASH
+                        and storage_root == EMPTY_ROOT_HASH
+                        and not self.state.multicoin[idx]):
+                    dels[i] = 1  # EIP-158 touched-empty deletion
+                    balance = 0
+                bals += balance.to_bytes(32, "big")
+                roots += storage_root
+                hashes += code_hash
+                mc[i] = 1 if self.state.multicoin[idx] else 0
+                nlist.append(nonce)
+            self.trie.fold_accounts(bytes(keys), bytes(bals), nlist,
+                                    bytes(roots), bytes(hashes),
+                                    bytes(mc), bytes(dels))
+        else:
+            for i, idx in enumerate(touched):
+                addr = self.state.addrs[idx]
+                balance, nonce = balances[i], int(nonces[i])
+                code_hash = self.state.code_hashes[idx]
+                storage_root = self.state.roots[idx]
+                if (balance == 0 and nonce == 0
+                        and code_hash == EMPTY_CODE_HASH
+                        and storage_root == EMPTY_ROOT_HASH
+                        and not self.state.multicoin[idx]):
+                    # touched but empty: EIP-158 deletion semantics
+                    self.trie.delete(addr)
+                else:
+                    self.trie.update(addr, StateAccount(
+                        nonce=nonce, balance=balance, root=storage_root,
+                        code_hash=code_hash,
+                        is_multi_coin=self.state.multicoin[idx]).rlp())
+        root = self._rehash(self.trie)
         self.stats.t_trie += time.monotonic() - t0
         if root != block.header.root:
             raise ReplayError(
@@ -907,11 +1013,16 @@ class ReplayEngine:
         """Bit-exact host path for non-transfer blocks; device state for
         touched accounts is refreshed afterwards."""
         t0 = time.monotonic()
-        self.trie.commit()
-        self.db.cache_trie(self.root, self.trie)
-        # storage tries the device path touched must be readable too
-        for st in self.storage_tries.values():
-            self.db.cache_trie(st.commit(), st)
+        if self._native:
+            self.trie.commit_into(self.db.node_db)
+            for st in self.storage_tries.values():
+                st.commit_into(self.db.node_db)
+        else:
+            self.trie.commit()
+            self.db.cache_trie(self.root, self.trie)
+            # storage tries the device path touched must be readable too
+            for st in self.storage_tries.values():
+                self.db.cache_trie(st.commit(), st)
         statedb = StateDB(self.root, self.db)
         if (self.parent_header is None
                 and self.config.is_apricot_phase4(block.time)):
@@ -935,7 +1046,19 @@ class ReplayEngine:
         # batched scatter via the staging buffer)
         from coreth_tpu import rlp as _rlp
         self._slot_overlay.clear()
-        self.trie = self.db.open_trie(root)
+        if self._native:
+            # apply the fallback's account changes incrementally to the
+            # resident C++ trie and verify it lands on the same root
+            for addr, obj in statedb._objects.items():
+                if obj.deleted:
+                    self.trie.delete(addr)
+                else:
+                    self.trie.update(addr, obj.account.rlp())
+            if self.trie.hash() != root:
+                raise ReplayError(
+                    "native trie diverged after host fallback")
+        else:
+            self.trie = self.db.open_trie(root)
         self.state.flush_staged()
         for addr in list(statedb._objects):
             idx = self.state.index.get(addr)
@@ -974,6 +1097,10 @@ class ReplayEngine:
 
     def commit(self) -> bytes:
         """Persist the engine tries so host StateDBs can open the state."""
+        if self._native:
+            for st in self.storage_tries.values():
+                st.commit_into(self.db.node_db)
+            return self.trie.commit_into(self.db.node_db)
         root = self.trie.commit()
         self.db.cache_trie(root, self.trie)
         for st in self.storage_tries.values():

@@ -29,19 +29,22 @@ class SnapshotError(Exception):
 
 
 class DiskLayer:
-    """The base flat state (disklayer.go role)."""
+    """The base flat state (disklayer.go role).  Storage is two-level
+    (addr_hash -> slot_hash -> value) so destructing an account is one
+    pop, not a scan of every slot on disk."""
 
     def __init__(self, root: bytes):
         self.root = root
         self.accounts: Dict[bytes, bytes] = {}   # keccak(addr) -> RLP
-        self.storage: Dict[Tuple[bytes, bytes], bytes] = {}
+        self.storage: Dict[bytes, Dict[bytes, bytes]] = {}
 
     def account(self, addr_hash: bytes) -> Optional[bytes]:
         return self.accounts.get(addr_hash)
 
     def storage_slot(self, addr_hash: bytes,
                      slot_hash: bytes) -> Optional[bytes]:
-        return self.storage.get((addr_hash, slot_hash))
+        sub = self.storage.get(addr_hash)
+        return sub.get(slot_hash) if sub is not None else None
 
 
 class DiffLayer:
@@ -49,12 +52,17 @@ class DiffLayer:
 
     def __init__(self, parent, block_hash: bytes, root: bytes,
                  accounts: Dict[bytes, bytes],
-                 storage: Dict[Tuple[bytes, bytes], bytes]):
+                 storage: Dict[Tuple[bytes, bytes], bytes],
+                 destructs=None):
         self.parent = parent
         self.block_hash = block_hash
         self.root = root
         self.accounts = accounts
         self.storage = storage
+        # accounts destroyed during the block — including ones later
+        # re-created in the same block (geth's separate destructs set):
+        # nothing below this layer survives for them
+        self.destructs = set(destructs or ())
 
     # reads walk the diff chain down to the disk layer
     def account(self, addr_hash: bytes) -> Optional[bytes]:
@@ -63,6 +71,8 @@ class DiffLayer:
             if addr_hash in layer.accounts:
                 v = layer.accounts[addr_hash]
                 return None if v == DELETED else v
+            if addr_hash in layer.destructs:
+                return None
             layer = layer.parent
         return layer.account(addr_hash)
 
@@ -74,8 +84,9 @@ class DiffLayer:
             if key in layer.storage:
                 v = layer.storage[key]
                 return None if v == DELETED else v
-            if addr_hash in layer.accounts \
-                    and layer.accounts[addr_hash] == DELETED:
+            if addr_hash in layer.destructs \
+                    or (addr_hash in layer.accounts
+                        and layer.accounts[addr_hash] == DELETED):
                 return None  # destructed: nothing below survives
             layer = layer.parent
         return layer.storage_slot(addr_hash, slot_hash)
@@ -101,9 +112,11 @@ class Tree:
     # ------------------------------------------------------------- update
     def update(self, block_hash: bytes, parent_hash: bytes, root: bytes,
                accounts: Dict[bytes, bytes],
-               storage: Dict[Tuple[bytes, bytes], bytes]) -> None:
+               storage: Dict[Tuple[bytes, bytes], bytes],
+               destructs=None) -> None:
         """New diff layer for a processed block (snapshot.go:326);
-        values of DELETED mark removals."""
+        values of DELETED mark removals; `destructs` carries accounts
+        destroyed during the block even if re-created afterwards."""
         parent = self.snapshot(parent_hash)
         if parent is None:
             raise SnapshotError(
@@ -111,7 +124,8 @@ class Tree:
         if block_hash in self.layers:
             raise SnapshotError("duplicate snapshot layer")
         self.layers[block_hash] = DiffLayer(
-            parent, block_hash, root, dict(accounts), dict(storage))
+            parent, block_hash, root, dict(accounts), dict(storage),
+            destructs)
 
     # ------------------------------------------------------------ flatten
     def flatten(self, block_hash: bytes) -> None:
@@ -128,21 +142,22 @@ class Tree:
             chain.append(node)
             node = node.parent
         for diff in reversed(chain):
+            for ah in diff.destructs:
+                self.disk.storage.pop(ah, None)
             for ah, v in diff.accounts.items():
                 if v == DELETED:
                     self.disk.accounts.pop(ah, None)
-                    for key in [k for k in self.disk.storage
-                                if k[0] == ah]:
-                        del self.disk.storage[key]
+                    self.disk.storage.pop(ah, None)
                 else:
                     self.disk.accounts[ah] = v
-            for key, v in diff.storage.items():
+            for (ah, sh), v in diff.storage.items():
                 if v == DELETED:
-                    self.disk.storage.pop(key, None)
+                    sub = self.disk.storage.get(ah)
+                    if sub is not None:
+                        sub.pop(sh, None)
                 else:
-                    self.disk.storage[key] = v
+                    self.disk.storage.setdefault(ah, {})[sh] = v
         self.disk.root = layer.root
-        old_disk_block = self.disk_block
         self.disk_block = block_hash
         # drop every layer at or below the accepted height band whose
         # ancestry does not include the accepted block (rejected
@@ -188,17 +203,20 @@ def generate_from_trie(db, state_root: bytes,
         if acct.root != EMPTY_ROOT_HASH:
             st = Trie(root_hash=acct.root, db=db.node_db)
             for slot_hash, v in leaves(st):
-                tree.disk.storage[(addr_hash, slot_hash)] = v
+                tree.disk.storage.setdefault(addr_hash, {})[slot_hash] = v
     return tree
 
 
-def diff_from_statedb(statedb) -> Tuple[Dict[bytes, bytes],
-                                        Dict[Tuple[bytes, bytes], bytes]]:
-    """Extract a processed block's account/storage delta in snapshot
-    key space from a finalised+hashed StateDB (the Update feed at
-    blockchain.go writeBlockWithState)."""
+def diff_from_statedb(statedb):
+    """Extract a processed block's (accounts, storage, destructs) delta
+    in snapshot key space from a finalised+hashed StateDB (the Update
+    feed at blockchain.go writeBlockWithState).  destructs carries
+    every account destroyed during the block — including destruct+
+    re-create sequences, whose pre-destruct storage must be masked."""
     accounts: Dict[bytes, bytes] = {}
     storage: Dict[Tuple[bytes, bytes], bytes] = {}
+    destructs = {keccak256(a) for a in getattr(statedb, "_destructed",
+                                               ())}
     for addr, obj in statedb._objects.items():
         ah = keccak256(addr)
         if obj.deleted or obj.suicided:
@@ -212,4 +230,4 @@ def diff_from_statedb(statedb) -> Tuple[Dict[bytes, bytes],
             else:
                 from coreth_tpu import rlp
                 storage[(ah, sh)] = rlp.encode(value.lstrip(b"\x00"))
-    return accounts, storage
+    return accounts, storage, destructs

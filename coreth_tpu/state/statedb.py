@@ -591,7 +591,7 @@ class StateDB:
         same one-way contract: "Snapshots of the copied state cannot be
         applied to the copy."
         """
-        new = StateDB(self.original_root, self.db)
+        new = StateDB(self.original_root, self.db, snap=self.snap)
         new._trie = self._trie.copy()
         new._dirty_counts = dict(self._dirty_counts)
         for addr, obj in self._objects.items():

@@ -121,6 +121,7 @@ struct Node {
   Bytes enc;
   Bytes ref;  // 32-byte hash, or inline rlp (< 32 bytes)
   bool dirty = true;
+  bool exported = false;  // emitted by export_nodes since last change
 
   explicit Node(Kind k) : kind(k) {}
 };
@@ -158,6 +159,7 @@ struct Trie {
       return leaf;
     }
     n->dirty = true;
+    n->exported = false;
     if (n->kind == Node::BRANCH) {
       uint8_t idx = key[0];
       n->kids[idx] =
@@ -207,6 +209,115 @@ struct Trie {
       return ext;
     }
     return branch;
+  }
+
+  // ------------------------------------------------------------ get
+  const Bytes* get(const uint8_t* key, size_t len) const {
+    const Node* n = root.get();
+    while (n) {
+      if (n->kind == Node::BRANCH) {
+        if (len == 0) return nullptr;
+        n = n->kids[key[0]].get();
+        ++key;
+        --len;
+        continue;
+      }
+      size_t pl = n->path.size();
+      if (pl > len || !std::equal(n->path.begin(), n->path.end(), key))
+        return nullptr;
+      if (n->kind == Node::LEAF)
+        return pl == len ? &n->value : nullptr;
+      key += pl;
+      len -= pl;
+      n = n->child.get();
+    }
+    return nullptr;
+  }
+
+  // --------------------------------------------------------- delete
+  void erase(const uint8_t* nibbles, size_t len) {
+    root = erase_node(std::move(root), nibbles, len);
+  }
+
+  // collapse helper: absorb a lone child into its parent slot
+  static std::unique_ptr<Node> collapse(uint8_t idx,
+                                        std::unique_ptr<Node> child) {
+    if (child->kind == Node::BRANCH) {
+      auto ext = std::make_unique<Node>(Node::EXT);
+      ext->path.push_back(idx);
+      ext->child = std::move(child);
+      return ext;
+    }
+    // leaf/ext: prepend the branch nibble to its path
+    child->path.insert(child->path.begin(), idx);
+    child->dirty = true;
+    child->exported = false;
+    child->ref.clear();
+    return child;
+  }
+
+  std::unique_ptr<Node> erase_node(std::unique_ptr<Node> n,
+                                   const uint8_t* key, size_t len) {
+    if (!n) return nullptr;
+    n->dirty = true;
+    n->exported = false;
+    n->ref.clear();
+    if (n->kind == Node::BRANCH) {
+      if (len == 0) return n;  // no branch values in secure tries
+      uint8_t idx = key[0];
+      n->kids[idx] = erase_node(std::move(n->kids[idx]), key + 1,
+                                len - 1);
+      int live = -1, count = 0;
+      for (int i = 0; i < 16; ++i)
+        if (n->kids[i]) {
+          live = i;
+          ++count;
+        }
+      if (count == 0) return nullptr;
+      if (count == 1) {
+        auto merged = collapse((uint8_t)live, std::move(n->kids[live]));
+        return merged;
+      }
+      return n;
+    }
+    size_t pl = n->path.size();
+    if (pl > len || !std::equal(n->path.begin(), n->path.end(), key))
+      return n;  // key absent
+    if (n->kind == Node::LEAF) {
+      if (pl == len) return nullptr;
+      return n;
+    }
+    n->child = erase_node(std::move(n->child), key + pl, len - pl);
+    if (!n->child) return nullptr;
+    if (n->child->kind != Node::BRANCH) {
+      // merge ext with its short child
+      n->child->path.insert(n->child->path.begin(), n->path.begin(),
+                            n->path.end());
+      n->child->dirty = true;
+      n->child->exported = false;
+      n->child->ref.clear();
+      return std::move(n->child);
+    }
+    return n;
+  }
+
+  // ------------------------------------------------------- export
+  // Incremental: a clean, already-exported node encodes an unchanged
+  // subtree, so the walk prunes there — repeat exports cost O(changed)
+  // instead of O(trie).
+  void export_nodes(std::vector<std::pair<Bytes, Bytes>>& out, Node* n,
+                    bool mark) {
+    if (!n) return;
+    if (!n->dirty && n->exported) return;
+    encode(n);
+    if (n->enc.size() >= 32) out.emplace_back(n->ref, n->enc);
+    if (mark) n->exported = true;  // size probe must not mutate
+    if (n->kind == Node::EXT) {
+      export_nodes(out, n->child.get(), mark);
+    } else if (n->kind == Node::BRANCH) {
+      for (int i = 0; i < 16; ++i)
+        export_nodes(out, n->kids[i].get(), mark);
+    }
   }
 
   // memoized encode: fills enc/ref, clears dirty
@@ -291,6 +402,117 @@ u128 load_u128_be32(const uint8_t* p, bool* too_big) {
 }  // namespace
 
 extern "C" {
+
+// ------------------------------------------------------ trie handle API
+//
+// The engine's account/storage-trie fold in C++ (the hasher.go +
+// statedb updateTrie role): handle-based secure-trie operations over
+// pre-hashed 32-byte keys.  Batch update/delete amortizes the ctypes
+// boundary; export dumps (hash, rlp) node pairs for interop with the
+// Python node store.
+
+void* coreth_trie_new() { return new Trie(); }
+
+void coreth_trie_free(void* h) { delete (Trie*)h; }
+
+static void key_to_nibs(const uint8_t* key32, uint8_t nib[64]) {
+  for (int i = 0; i < 32; ++i) {
+    nib[2 * i] = key32[i] >> 4;
+    nib[2 * i + 1] = key32[i] & 0x0F;
+  }
+}
+
+// records: n entries of key_hash32; vals packed with u32 lengths
+// (length 0 = delete)
+void coreth_trie_update_batch(void* h, const uint8_t* keys32,
+                              const uint8_t* vals,
+                              const uint32_t* val_lens, uint64_t n) {
+  Trie* t = (Trie*)h;
+  uint8_t nib[64];
+  size_t off = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    key_to_nibs(keys32 + 32 * i, nib);
+    uint32_t vl = val_lens[i];
+    if (vl == 0) {
+      t->erase(nib, 64);
+    } else {
+      t->insert(nib, 64, Bytes(vals + off, vals + off + vl));
+      off += vl;
+    }
+  }
+}
+
+// returns 1 + copies value when present (cap bytes available), else 0
+int coreth_trie_get(void* h, const uint8_t* key32, uint8_t* out,
+                    uint32_t cap, uint32_t* out_len) {
+  Trie* t = (Trie*)h;
+  uint8_t nib[64];
+  key_to_nibs(key32, nib);
+  const Bytes* v = t->get(nib, 64);
+  if (!v) return 0;
+  *out_len = (uint32_t)v->size();
+  if (v->size() <= cap) std::memcpy(out, v->data(), v->size());
+  return 1;
+}
+
+void coreth_trie_hash(void* h, uint8_t out32[32]) {
+  ((Trie*)h)->hash_root(out32);
+}
+
+// Batched account fold (the statedb updateTrie + IntermediateRoot hot
+// loop in one call): n records of pre-hashed key, 32-byte BE balance,
+// nonce, storage root, code hash, multicoin flag; del[i] != 0 deletes.
+void coreth_trie_fold_accounts(void* h, const uint8_t* keys32,
+                               const uint8_t* balances32,
+                               const uint64_t* nonces,
+                               const uint8_t* roots32,
+                               const uint8_t* code_hashes32,
+                               const uint8_t* mc, const uint8_t* del,
+                               uint64_t n) {
+  Trie* t = (Trie*)h;
+  uint8_t nib[64];
+  for (uint64_t i = 0; i < n; ++i) {
+    key_to_nibs(keys32 + 32 * i, nib);
+    if (del[i]) {
+      t->erase(nib, 64);
+      continue;
+    }
+    Bytes payload;
+    rlp_uint(payload, nonces[i]);
+    {  // arbitrary-width balance from 32-byte BE
+      const uint8_t* b = balances32 + 32 * i;
+      int lead = 0;
+      while (lead < 32 && b[lead] == 0) ++lead;
+      rlp_string(payload, b + lead, 32 - lead);
+    }
+    rlp_string(payload, roots32 + 32 * i, 32);
+    rlp_string(payload, code_hashes32 + 32 * i, 32);
+    rlp_uint(payload, mc[i] ? 1 : 0);
+    t->insert(nib, 64, rlp_list(payload));
+  }
+}
+
+// export all hashed nodes: returns byte size written into `out`
+// ([hash32][u32 len][rlp])*, or the required size when out == NULL.
+uint64_t coreth_trie_export(void* h, uint8_t* out, uint64_t cap) {
+  Trie* t = (Trie*)h;
+  std::vector<std::pair<Bytes, Bytes>> nodes;
+  if (t->root) t->export_nodes(nodes, t->root.get(), out != nullptr);
+  uint64_t need = 0;
+  for (auto& kv : nodes) need += 32 + 4 + kv.second.size();
+  if (!out || cap < need) return need;
+  uint64_t off = 0;
+  for (auto& kv : nodes) {
+    std::memcpy(out + off, kv.first.data(), 32);
+    off += 32;
+    uint32_t l = (uint32_t)kv.second.size();
+    std::memcpy(out + off, &l, 4);
+    off += 4;
+    std::memcpy(out + off, kv.second.data(), l);
+    off += l;
+  }
+  return need;
+}
 
 // Packed tx record layout (byte offsets):
 //   sighash 0:32 | r 32:64 | s 64:96 | recid 96 | to 97:117

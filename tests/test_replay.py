@@ -189,9 +189,9 @@ def test_prepare_window_pads_to_pow2_not_full_window():
                           capacity=256, batch_pad=64, window=16)
     engine.warm_senders(blocks[0])
     batch = engine._classify(blocks[0])
-    txds, t_idxs, _, _, _ = engine._prepare_window([(blocks[0], batch)])
+    txds, t_idxs, *_ = engine._prepare_window([(blocks[0], batch)])
     assert txds.shape[0] == 1
-    txds2, _, _, _, _ = engine._prepare_window(
+    txds2, *_ = engine._prepare_window(
         [(blocks[0], batch),
          (blocks[1], engine._classify(blocks[1])),
          (blocks[2], engine._classify(blocks[2]))])

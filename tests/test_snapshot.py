@@ -88,8 +88,8 @@ def test_diff_layers_and_flatten_on_accept():
     sa.finalise(True)
     root_a = sa.intermediate_root(True)
     sa.commit(True)
-    acc_a, sto_a = diff_from_statedb(sa)
-    tree.update(b"\xAA" * 32, GENESIS_HASH, root_a, acc_a, sto_a)
+    acc_a, sto_a, des_a = diff_from_statedb(sa)
+    tree.update(b"\xAA" * 32, GENESIS_HASH, root_a, acc_a, sto_a, des_a)
 
     # competing sibling B: +999 to ADDRS[1]
     sb = StateDB(root, db, snap=tree.snapshot(GENESIS_HASH))
@@ -97,8 +97,8 @@ def test_diff_layers_and_flatten_on_accept():
     sb.finalise(True)
     root_b = sb.intermediate_root(True)
     sb.commit(True)
-    acc_b, sto_b = diff_from_statedb(sb)
-    tree.update(b"\xBB" * 32, GENESIS_HASH, root_b, acc_b, sto_b)
+    acc_b, sto_b, des_b = diff_from_statedb(sb)
+    tree.update(b"\xBB" * 32, GENESIS_HASH, root_b, acc_b, sto_b, des_b)
 
     # child of A
     sc = StateDB(root_a, db, snap=tree.snapshot(b"\xAA" * 32))
@@ -107,8 +107,8 @@ def test_diff_layers_and_flatten_on_accept():
     sc.finalise(True)
     root_c = sc.intermediate_root(True)
     sc.commit(True)
-    acc_c, sto_c = diff_from_statedb(sc)
-    tree.update(b"\xCC" * 32, b"\xAA" * 32, root_c, acc_c, sto_c)
+    acc_c, sto_c, des_c = diff_from_statedb(sc)
+    tree.update(b"\xCC" * 32, b"\xAA" * 32, root_c, acc_c, sto_c, des_c)
 
     # accept A: flattens into disk, discards sibling B, keeps child C
     tree.flatten(b"\xAA" * 32)
@@ -149,3 +149,23 @@ def test_update_requires_parent():
     tree = generate_from_trie(db, root, GENESIS_HASH)
     with pytest.raises(SnapshotError):
         tree.update(b"\x01" * 32, b"\x99" * 32, b"\x00" * 32, {}, {})
+
+
+def test_destruct_resurrect_masks_old_storage():
+    """A destruct+re-create in one block: the destructs channel masks
+    pre-destruct storage even though the account re-exists."""
+    db, root = build_state()
+    tree = generate_from_trie(db, root, GENESIS_HASH)
+    ah = keccak256(TOKEN)
+    from coreth_tpu.state.statedb import normalize_state_key
+    sh = keccak256(normalize_state_key(balance_slot(ADDRS[0])))
+    assert tree.disk.storage_slot(ah, sh) is not None
+    # block: token destroyed AND re-created with fresh (empty) storage
+    tree.update(b"\xAA" * 32, GENESIS_HASH, b"\x01" * 32,
+                {ah: b"\xc0"}, {}, destructs={ah})
+    layer = tree.snapshot(b"\xAA" * 32)
+    assert layer.account(ah) == b"\xc0"          # re-created
+    assert layer.storage_slot(ah, sh) is None    # old storage masked
+    tree.flatten(b"\xAA" * 32)
+    assert tree.disk.account(ah) == b"\xc0"
+    assert tree.disk.storage_slot(ah, sh) is None
