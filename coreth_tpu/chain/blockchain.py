@@ -135,6 +135,16 @@ class BlockChain:
         # canonical index below the flushed height stays on disk only;
         # get_block_by_number falls back to the store
 
+    def publish_metrics(self, registry=None, prefix: str = "chain"
+                        ) -> None:
+        """Feed the per-phase insert timers into a metrics registry
+        (the blockchain.go:1343-1357 timer split as gauges)."""
+        from coreth_tpu.metrics import Gauge, get_or_register
+        for name, value in self.timers.row().items():
+            g = get_or_register(f"{prefix}/insert/{name}", Gauge,
+                                registry)
+            g.update(value)
+
     def close(self) -> None:
         """Flush every pending trie node + the store (clean shutdown)."""
         if self.trie_writer is not None:

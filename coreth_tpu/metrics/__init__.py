@@ -1,0 +1,19 @@
+"""Metrics registry: counters, gauges, meters, histograms, timers.
+
+Twin of reference metrics/ (the go-metrics fork: registry.go +
+metrics.go Enabled gate + prometheus/ gatherer): components register
+named instruments in a hierarchy-by-name registry; the Prometheus
+exposition renders the whole registry as text for scraping (the
+endpoint AvalancheGo aggregates, vm.go:674 initializeMetrics).
+"""
+
+from coreth_tpu.metrics.registry import (
+    Counter, Gauge, Histogram, Meter, Registry, Timer, default_registry,
+    get_or_register,
+)
+from coreth_tpu.metrics.prometheus import render_prometheus
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Meter", "Registry", "Timer",
+    "default_registry", "get_or_register", "render_prometheus",
+]

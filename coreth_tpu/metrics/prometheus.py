@@ -1,0 +1,49 @@
+"""Prometheus text exposition of a metrics registry.
+
+Twin of reference metrics/prometheus/ (the gatherer AvalancheGo scrapes
+through its own endpoint): metric names sanitize '/' and '.' into '_',
+histograms/timers expose count/sum and quantile gauges.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from coreth_tpu.metrics.registry import Registry, default_registry
+
+
+def _sanitize(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    return s if not s[:1].isdigit() else "_" + s
+
+
+def render_prometheus(registry: Optional[Registry] = None) -> str:
+    reg = registry or default_registry
+    lines = []
+    for name, metric in reg.each():
+        snap = metric.snapshot()
+        base = _sanitize(name)
+        kind = snap.pop("type")
+        if kind == "counter":
+            lines.append(f"# TYPE {base} counter")
+            lines.append(f"{base} {snap['count']}")
+        elif kind == "gauge":
+            lines.append(f"# TYPE {base} gauge")
+            lines.append(f"{base} {snap['value']}")
+        elif kind == "meter":
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total {snap['count']}")
+            lines.append(f"# TYPE {base}_rate_mean gauge")
+            lines.append(f"{base}_rate_mean {snap['rate_mean']}")
+        else:  # histogram / timer
+            lines.append(f"# TYPE {base} summary")
+            for q in ("p50", "p95", "p99"):
+                quant = q[1:] if q != "p50" else "50"
+                lines.append(
+                    f'{base}{{quantile="0.{quant}"}} {snap[q]}')
+            lines.append(f"{base}_sum {snap['sum']}")
+            lines.append(f"{base}_count {snap['count']}")
+    return "\n".join(lines) + "\n"

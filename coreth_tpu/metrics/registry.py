@@ -1,0 +1,177 @@
+"""The instrument types + registry (metrics/registry.go role).
+
+`Enabled` gates cost the way the reference's metrics.Enabled /
+EnabledExpensive do: when disabled, instruments become no-ops so hot
+paths never pay for bookkeeping they do not report.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional
+
+ENABLED = True
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        if not ENABLED:
+            return
+        with self._lock:
+            self.value += n
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "count": self.value}
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def update(self, v: float) -> None:
+        if ENABLED:
+            self.value = v
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self.value}
+
+
+class Meter:
+    """Rate-of-events meter (count + mean rate since start)."""
+    __slots__ = ("count", "start", "_lock")
+
+    def __init__(self, clock=_time.monotonic):
+        self.count = 0
+        self.start = clock()
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        if not ENABLED:
+            return
+        with self._lock:
+            self.count += n
+
+    def rate_mean(self, clock=_time.monotonic) -> float:
+        dt = clock() - self.start
+        return self.count / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        return {"type": "meter", "count": self.count,
+                "rate_mean": self.rate_mean()}
+
+
+class Histogram:
+    """Reservoir-free histogram: count/sum/min/max + fixed quantile
+    estimation over a bounded ring of recent samples."""
+
+    def __init__(self, window: int = 1028):
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._ring: List[float] = []
+        self._window = window
+        self._lock = threading.Lock()
+
+    def update(self, v: float) -> None:
+        if not ENABLED:
+            return
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._ring) >= self._window:
+                self._ring[self.count % self._window] = v
+            else:
+                self._ring.append(v)
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._ring:
+                return 0.0
+            s = sorted(self._ring)
+            return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
+
+    def snapshot(self) -> dict:
+        return {"type": "histogram", "count": self.count,
+                "sum": self.sum, "min": self.min or 0.0,
+                "max": self.max or 0.0,
+                "p50": self.quantile(0.5), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+
+class Timer(Histogram):
+    """Histogram over durations with a context-manager clock."""
+
+    def time(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = _time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                timer.update(_time.monotonic() - self.t0)
+                return False
+
+        return _Ctx()
+
+    def snapshot(self) -> dict:
+        out = super().snapshot()
+        out["type"] = "timer"
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, metric) -> object:
+        with self._lock:
+            if name in self._metrics:
+                raise ValueError(f"metric {name!r} already registered")
+            self._metrics[name] = metric
+        return metric
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def get_or_register(self, name: str, factory: Callable):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def each(self):
+        with self._lock:
+            return sorted(self._metrics.items())
+
+    def snapshot(self) -> Dict[str, dict]:
+        return {name: m.snapshot() for name, m in self.each()}
+
+
+default_registry = Registry()
+
+
+def get_or_register(name: str, factory: Callable,
+                    registry: Optional[Registry] = None):
+    return (registry or default_registry).get_or_register(name, factory)

@@ -1,0 +1,49 @@
+"""Unclean-shutdown tracking.
+
+Twin of reference internal/shutdowncheck/shutdown_tracker.go (:41-90):
+a marker written at startup and removed on clean shutdown; markers
+found at startup are previous unclean exits, reported (with their
+timestamps) and bounded to the most recent N.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import List
+
+from coreth_tpu.rawdb.kv import KVStore
+
+MARKER_KEY = b"uncleanShutdowns"
+MAX_TRACKED = 10
+
+
+class ShutdownTracker:
+    def __init__(self, kv: KVStore, clock=_time.time):
+        self.kv = kv
+        self.clock = clock
+        self.previous: List[int] = []
+
+    def _load(self) -> List[int]:
+        raw = self.kv.get(MARKER_KEY)
+        if not raw:
+            return []
+        return [int.from_bytes(raw[i:i + 8], "big")
+                for i in range(0, len(raw), 8)]
+
+    def _store(self, stamps: List[int]) -> None:
+        self.kv.put(MARKER_KEY, b"".join(
+            s.to_bytes(8, "big") for s in stamps[-MAX_TRACKED:]))
+        self.kv.flush()
+
+    def mark_startup(self) -> List[int]:
+        """Record this boot; whatever markers already exist are unclean
+        shutdowns from previous runs (returned for logging)."""
+        self.previous = self._load()
+        self._store(self.previous + [int(self.clock())])
+        return list(self.previous)
+
+    def mark_clean_shutdown(self) -> None:
+        """Remove this run's marker (ShutdownTracker Stop)."""
+        stamps = self._load()
+        if stamps:
+            self._store(stamps[:-1])

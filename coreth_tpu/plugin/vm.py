@@ -21,8 +21,10 @@ from typing import Deque, Dict, List, Optional, Union
 from coreth_tpu.chain import BlockChain
 from coreth_tpu.miner import Miner
 from coreth_tpu.plugin.block import PluginBlock, Status
+from coreth_tpu.plugin.config import parse_config
 from coreth_tpu.plugin.genesis_json import parse_genesis_json
 from coreth_tpu.txpool import TxPool
+from coreth_tpu.txpool.pool import TxPoolConfig
 from coreth_tpu.types import Block, Transaction
 
 PENDING_TXS = "PendingTxs"  # the message on the toEngine channel
@@ -48,14 +50,21 @@ class VM:
     # ------------------------------------------------------------ lifecycle
     def initialize(self, genesis_bytes: Union[bytes, str, dict],
                    config_bytes: bytes = b"") -> None:
-        """VM.Initialize (vm.go:368): decode genesis, build the chain
-        stack.  config_bytes (the per-chain JSON config, vm.go:379) is
-        accepted and currently ignored field-by-field."""
+        """VM.Initialize (vm.go:368): decode genesis + the per-chain
+        JSON config (vm.go:379, plugin/config.py twin) and build the
+        chain stack from them."""
         if self.initialized:
             raise VMError("already initialized")
         genesis = parse_genesis_json(genesis_bytes)
-        self.chain = BlockChain(genesis)
-        self.txpool = TxPool(genesis.config, self.chain)
+        self.config = parse_config(config_bytes)
+        self.chain = BlockChain(genesis,
+                                commit_interval=self.config.commit_interval)
+        self.txpool = TxPool(genesis.config, self.chain, TxPoolConfig(
+            price_limit=self.config.tx_pool_price_limit,
+            account_slots=self.config.tx_pool_account_slots,
+            global_slots=self.config.tx_pool_global_slots,
+            account_queue=self.config.tx_pool_account_queue,
+            global_queue=self.config.tx_pool_global_queue))
         self.miner = Miner(genesis.config, self.chain, self.txpool,
                            engine=self.chain.engine, clock=self.clock)
         g = self.chain.genesis_block
@@ -64,14 +73,20 @@ class VM:
         self._blocks[gb.id] = gb
         self.preferred_id = gb.id
         from coreth_tpu.plugin.builder import BlockBuilder
-        self.builder = BlockBuilder(self, clock=self.clock)
+        self.builder = BlockBuilder(
+            self, clock=self.clock,
+            min_interval=self.config.min_block_build_interval_ms / 1000)
         self.initialized = True
 
     def shutdown(self) -> None:
         self.initialized = False
 
     def health(self) -> dict:
-        return {"healthy": self.initialized}
+        out = {"healthy": self.initialized}
+        if self.initialized:
+            out["lastAcceptedHeight"] = self.chain.last_accepted.number
+            out["configWarnings"] = list(self.config.warnings)
+        return out
 
     # -------------------------------------------------------------- blocks
     def _require_init(self) -> None:

@@ -193,3 +193,102 @@ def test_atomic_mempool_eviction_cap():
     weak = _import_tx(b"\x0D" * 32, 10_000_000, burn=1_000)
     with pytest.raises(MempoolError):
         pool.add_tx(weak)   # cheaper than everything resident
+
+
+# ---------------------------------------------------- metrics + config
+
+def test_metrics_registry_and_prometheus():
+    from coreth_tpu.metrics import (
+        Counter, Gauge, Meter, Registry, Timer, render_prometheus,
+    )
+    reg = Registry()
+    c = reg.get_or_register("chain/blocks", Counter)
+    c.inc(3)
+    g = reg.get_or_register("pool/pending", Gauge)
+    g.update(17)
+    m = reg.get_or_register("txs/accepted", Meter)
+    m.mark(5)
+    t = reg.get_or_register("insert/duration", Timer)
+    with t.time():
+        pass
+    t.update(0.5)
+    snap = reg.snapshot()
+    assert snap["chain/blocks"]["count"] == 3
+    assert snap["pool/pending"]["value"] == 17
+    assert snap["txs/accepted"]["count"] == 5
+    assert snap["insert/duration"]["count"] == 2
+    text = render_prometheus(reg)
+    assert "chain_blocks 3" in text
+    assert "pool_pending 17" in text
+    assert "insert_duration_count 2" in text
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        reg.register("chain/blocks", Counter())
+
+
+def test_chain_publishes_phase_metrics():
+    from coreth_tpu.metrics import Registry
+    chain = make_chain()
+    reg = Registry()
+    chain.publish_metrics(reg)
+    snap = reg.snapshot()
+    assert "chain/insert/total" in snap
+    assert "chain/insert/execution" in snap
+
+
+def test_vm_config_parsing_and_application():
+    import json as _json
+    from coreth_tpu.plugin.config import parse_config
+    cfg = parse_config(_json.dumps({
+        "tx-pool-price-limit": 7,
+        "commit-interval": 128,
+        "min-block-build-interval": 250,
+        "corethAdminApiEnabled": True,     # deprecated key
+        "banana": 1,                       # unknown key
+    }))
+    assert cfg.tx_pool_price_limit == 7
+    assert cfg.commit_interval == 128
+    assert cfg.admin_api_enabled is True
+    assert any("deprecated" in w for w in cfg.warnings)
+    assert any("banana" in w for w in cfg.warnings)
+    assert parse_config(None).rpc_gas_cap == 50_000_000
+
+
+def test_vm_initialize_applies_config():
+    import json as _json
+    from coreth_tpu.plugin import VM
+    from tests.test_plugin import genesis_json
+    vm = VM()
+    vm.initialize(genesis_json(), _json.dumps({
+        "tx-pool-price-limit": 5,
+        "min-block-build-interval": 2000,
+    }).encode())
+    assert vm.txpool.pool_config.price_limit == 5
+    assert vm.builder.min_interval == 2.0
+    health = vm.health()
+    assert health["healthy"] and health["lastAcceptedHeight"] == 0
+
+
+def test_shutdown_tracker(tmp_path):
+    from coreth_tpu.plugin.shutdown import ShutdownTracker
+    from coreth_tpu.rawdb import FileDB
+    path = str(tmp_path / "meta.log")
+    t = [1000]
+    kv = FileDB(path)
+    st = ShutdownTracker(kv, clock=lambda: t[0])
+    assert st.mark_startup() == []      # first boot: clean history
+    st.mark_clean_shutdown()
+    kv.close()
+    # clean cycle leaves nothing behind
+    kv = FileDB(path)
+    st2 = ShutdownTracker(kv, clock=lambda: t[0])
+    assert st2.mark_startup() == []
+    # crash: no clean shutdown recorded
+    kv.close()
+    kv = FileDB(path)
+    t[0] = 2000
+    st3 = ShutdownTracker(kv, clock=lambda: t[0])
+    prev = st3.mark_startup()
+    assert prev == [1000]               # the crashed run is reported
+    st3.mark_clean_shutdown()
+    kv.close()
