@@ -9,5 +9,7 @@ per-account reductions cross shards with psum_scatter.
 
 from coreth_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    sharded_recover,
+    sharded_slot_step,
     sharded_transfer_step,
 )

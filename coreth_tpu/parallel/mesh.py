@@ -105,3 +105,63 @@ def sharded_transfer_step(mesh: Mesh, num_accounts: int):
                   spec_tx2, spec_tx2, spec_tx1, spec_tx1, spec_tx1, PS()),
         out_specs=(spec_acc2, spec_acc1, PS()))
     return jax.jit(sharded)
+
+
+def sharded_slot_step(mesh: Mesh, num_slots: int):
+    """Mesh-sharded ERC-20 slot step: slot values sharded over dp, tx
+    shards compute full-width partial debit/credit segment sums,
+    psum_scatter reduces them back onto the slot sharding (the same
+    annotate -> reduce-scatter recipe as the account step)."""
+    n_dev = mesh.devices.size
+    assert num_slots % n_dev == 0
+
+    def step(slot_vals, from_slot, to_slot, amount16, mask):
+        mask_i = mask.astype(jnp.int32)
+        amt = amount16 * mask_i[:, None]
+        debit_part = jax.ops.segment_sum(amt, from_slot,
+                                         num_segments=num_slots)
+        credit_part = jax.ops.segment_sum(amt, to_slot,
+                                          num_segments=num_slots)
+        debit_tot = u256.normalize(
+            jax.lax.psum_scatter(debit_part, "dp", scatter_dimension=0,
+                                 tiled=True))
+        credit_tot = u256.normalize(
+            jax.lax.psum_scatter(credit_part, "dp", scatter_dimension=0,
+                                 tiled=True))
+        solvent = u256.gte(slot_vals, debit_tot)
+        ok = jax.lax.psum(jnp.all(solvent).astype(jnp.int32),
+                          "dp") == n_dev
+        new_vals = u256.sub(u256.add(slot_vals, credit_tot), debit_tot)
+        return new_vals, ok
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(PS("dp", None), PS("dp"), PS("dp"), PS("dp", None),
+                  PS("dp")),
+        out_specs=(PS("dp", None), PS()))
+    return jax.jit(sharded)
+
+
+def sharded_recover(mesh: Mesh):
+    """Mesh-sharded batched ECDSA recovery: the signature batch shards
+    over dp and every device runs the Shamir-ladder kernel on its
+    shard (the sender_cacher fan-out, here across chips instead of
+    goroutines — embarrassingly parallel, no collectives)."""
+    from coreth_tpu.ops.secp import recover_kernel
+
+    def step(x_bytes, parity, u1w, u2w):
+        # pin dtypes: shard_map re-traces per shard and weak-typed
+        # inputs would break the ladder's int32 carry scan
+        return recover_kernel.__wrapped__(
+            x_bytes.astype(jnp.uint8), parity.astype(jnp.int32),
+            u1w.astype(jnp.int32), u2w.astype(jnp.int32))
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(PS("dp", None), PS("dp"), PS("dp", None),
+                  PS("dp", None)),
+        out_specs=PS("dp", None),
+        # the ladder's internal scans build unvarying carries; this is
+        # a per-shard elementwise kernel, so vma tracking adds nothing
+        check_vma=False)
+    return jax.jit(sharded)
