@@ -130,3 +130,17 @@ class AtomicMempool:
         """Accepted block included these txs (IssuedTxs cleanup)."""
         for tx_id in tx_ids:
             self._remove(tx_id)
+
+    def remove_conflicts(self, inputs) -> int:
+        """Drop every resident tx spending any of `inputs` — an
+        accepted foreign block consumed those UTXOs, so local spenders
+        can never be valid again (reference mempool RemoveTx on
+        accepted-block conflicts).  Returns the count removed."""
+        victims = set()
+        for inp in inputs:
+            owner = self._utxo_spenders.get(inp)
+            if owner is not None:
+                victims.add(owner)
+        for tx_id in victims:
+            self._remove(tx_id)
+        return len(victims)

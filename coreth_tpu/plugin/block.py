@@ -72,6 +72,7 @@ class PluginBlock:
         """Consensus rejected this block (block.go:269)."""
         self.vm.chain.reject(self.id)
         self.status = Status.REJECTED
+        self.vm._on_reject(self)
 
     def __repr__(self) -> str:  # debugging aid
         return (f"PluginBlock(height={self.height}, "

@@ -37,7 +37,12 @@ class VMError(Exception):
 class VM:
     """Consensus-driven EVM execution engine (vm.go:242)."""
 
-    def __init__(self, clock=_time.time):
+    def __init__(self, clock=_time.time, shared_memory=None,
+                 chain_ctx=None):
+        """shared_memory/chain_ctx: supplying an atomic.SharedMemory
+        (and optionally a ChainContext) wires the full atomic subsystem
+        — backend, mempool, ExtData packing at build, accept-time
+        shared-memory application (vm.go:986 / :979 / block.go:177)."""
         self.clock = clock
         self.initialized = False
         self.chain: Optional[BlockChain] = None
@@ -46,6 +51,11 @@ class VM:
         self._blocks: Dict[bytes, PluginBlock] = {}
         self.to_engine: Deque[str] = deque()
         self.preferred_id: Optional[bytes] = None
+        self.shared_memory = shared_memory
+        self.chain_ctx = chain_ctx
+        self.atomic_backend = None
+        self.atomic_mempool = None
+        self._building_atomic = []
 
     # ------------------------------------------------------------ lifecycle
     def initialize(self, genesis_bytes: Union[bytes, str, dict],
@@ -57,7 +67,21 @@ class VM:
             raise VMError("already initialized")
         genesis = parse_genesis_json(genesis_bytes)
         self.config = parse_config(config_bytes)
-        self.chain = BlockChain(genesis,
+        engine = None
+        if self.shared_memory is not None:
+            from coreth_tpu.atomic import (
+                AtomicBackend, ChainContext, make_callbacks,
+            )
+            from coreth_tpu.atomic.mempool import AtomicMempool
+            from coreth_tpu.consensus.engine import DummyEngine
+            ctx = self.chain_ctx or ChainContext()
+            self.chain_ctx = ctx
+            self.atomic_backend = AtomicBackend(ctx, self.shared_memory)
+            self.atomic_mempool = AtomicMempool(ctx)
+            cb = make_callbacks(self.atomic_backend, genesis.config,
+                                pending_atomic_txs=self._pending_atomic)
+            engine = DummyEngine(cb=cb)  # config lands in BlockChain
+        self.chain = BlockChain(genesis, engine=engine,
                                 commit_interval=self.config.commit_interval)
         self.txpool = TxPool(genesis.config, self.chain, TxPoolConfig(
             price_limit=self.config.tx_pool_price_limit,
@@ -99,17 +123,68 @@ class VM:
     def _on_accept(self, blk: PluginBlock) -> None:
         # drop included txs from the pool (txpool reset loop analog)
         self.txpool.reset()
+        if self.atomic_backend is not None:
+            from coreth_tpu.atomic import decode_ext_data
+            self.atomic_backend.accept(blk.id)
+            txs = decode_ext_data(blk.block.ext_data())
+            if txs:
+                self.atomic_mempool.remove_accepted(
+                    [t.id() for t in txs])
+                # local txs spending the same UTXOs can never be valid
+                # again — drop them rather than letting the next build
+                # pull a guaranteed-to-fail spender
+                consumed = [i for t in txs
+                            for i in t.unsigned.input_utxos()]
+                self.atomic_mempool.remove_conflicts(consumed)
+
+    def _on_reject(self, blk: PluginBlock) -> None:
+        if self.atomic_backend is not None:
+            from coreth_tpu.atomic import decode_ext_data
+            self.atomic_backend.reject(blk.id)
+            restored = False
+            for t in decode_ext_data(blk.block.ext_data()):
+                self.atomic_mempool.cancel_current_tx(t.id())
+                restored = True
+            if restored:
+                # the cancelled txs need a rebuild signal or they could
+                # sit in the pool forever (liveness)
+                self.builder.signal_txs_ready()
+
+    def _pending_atomic(self):
+        """Atomic txs for the next built block (vm.go:979
+        onFinalizeAndAssemble pulls from the mempool).  Issued ids are
+        tracked so a failed build can discard them instead of leaving
+        them stranded in the issued set."""
+        if self.atomic_mempool is None:
+            return []
+        tx = self.atomic_mempool.next_tx()
+        if tx is None:
+            return []
+        self._building_atomic.append(tx.id())
+        return [tx]
 
     def build_block(self) -> PluginBlock:
         """buildBlock (vm.go:1262): assemble from pending txs and verify
         immediately (the built block enters processing state)."""
         self._require_init()
         pending, _ = self.txpool.stats()
-        if pending == 0:
+        atomic_pending = (self.atomic_mempool.pending_len()
+                          if self.atomic_mempool is not None else 0)
+        if pending == 0 and atomic_pending == 0:
             raise VMError("no pending transactions")
-        block = self.miner.generate_block()
-        blk = PluginBlock(self, block)
-        blk.verify()
+        self._building_atomic = []
+        try:
+            block = self.miner.generate_block()
+            blk = PluginBlock(self, block)
+            blk.verify()
+        except Exception:
+            # a failed build must not strand issued atomic txs: discard
+            # them (onFinalizeAndAssemble-error semantics — the tx was
+            # pulled and found unbuildable)
+            if self.atomic_mempool is not None:
+                for tx_id in self._building_atomic:
+                    self.atomic_mempool.discard_current_tx(tx_id)
+            raise
         self.builder.handle_generate_block()
         return blk
 
@@ -156,6 +231,20 @@ class VM:
         errs = self.txpool.add_remotes([tx])
         if errs and errs[0] is not None:
             raise errs[0]
+        self.builder.signal_txs_ready()
+
+    def issue_atomic_tx(self, tx) -> None:
+        """Feed an atomic tx: semantic-verify against the current tip
+        fee, pool it, signal the engine (vm.go issueTx for avax.*)."""
+        self._require_init()
+        if self.atomic_backend is None:
+            raise VMError("atomic subsystem not configured")
+        rules = self.chain.config.rules(
+            self.chain.current_block().number + 1,
+            int(self.clock()))
+        self.atomic_backend.semantic_verify(
+            tx, self.chain.current_block().base_fee, rules)
+        self.atomic_mempool.add_tx(tx)
         self.builder.signal_txs_ready()
 
     def mempool_stats(self):

@@ -107,7 +107,7 @@ def intrinsic_gas(data: bytes, access_list, is_contract_creation: bool,
     else:
         gas = P.TX_GAS
     if data:
-        nz = sum(1 for b in data if b)
+        nz = len(data) - data.count(0)  # C-speed zero-byte census
         nonzero_gas = (P.TX_DATA_NON_ZERO_GAS_EIP2028 if rules.is_istanbul
                        else P.TX_DATA_NON_ZERO_GAS_FRONTIER)
         gas += nz * nonzero_gas

@@ -203,3 +203,62 @@ def test_parse_genesis_json_storage_and_code():
     assert acct.code == b"\x60\x01"
     assert acct.storage[(1).to_bytes(32, "big")] == (2).to_bytes(32, "big")
     assert g.config.apricot_phase1_time is None  # fork keys absent
+
+
+def test_vm_atomic_import_end_to_end():
+    """The VM assembles the atomic subsystem from a shared-memory hub:
+    issue an ImportTx, build a block carrying it as ExtData, accept,
+    and the UTXO is consumed + the EVM balance credited."""
+    from coreth_tpu.atomic import (
+        ChainContext, EVMOutput, Memory, TransferableInput,
+        TransferableOutput, Tx, UnsignedImportTx, UTXO, X2C_RATE,
+        short_id,
+    )
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+    from coreth_tpu.crypto.secp256k1 import _g_mul, _to_affine
+
+    ctx = ChainContext()
+    memory = Memory()
+    out = TransferableOutput(asset_id=ctx.avax_asset_id,
+                             amount=5_000_000_000,
+                             addrs=[short_id(_to_affine(_g_mul(KEY)))])
+    utxo = UTXO(b"\x91" * 32, 0, out)
+    memory.new_shared_memory(ctx.x_chain_id).apply(
+        {ctx.chain_id: Requests(put_requests=[
+            Element(utxo.input_id(), utxo.encode(), out.addrs)])})
+
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm = VM(clock=clock, shared_memory=memory.new_shared_memory(
+        ctx.chain_id), chain_ctx=ctx)
+    vm.initialize(genesis_json())
+    atx = Tx(UnsignedImportTx(
+        network_id=ctx.network_id, blockchain_id=ctx.chain_id,
+        source_chain=ctx.x_chain_id,
+        imported_inputs=[TransferableInput(
+            tx_id=utxo.tx_id, output_index=0, asset_id=out.asset_id,
+            amount=out.amount, sig_indices=[0])],
+        outs=[EVMOutput(ADDR, 4_990_000_000, ctx.avax_asset_id)]))
+    atx.sign([[KEY]])
+    vm.issue_tx(make_tx(0))       # an EVM tx rides along
+    vm.issue_atomic_tx(atx)
+    blk = vm.build_block()
+    assert blk.block.ext_data() != b""
+    pre = vm.chain.state_at(
+        vm.chain.genesis_block.root).get_balance(ADDR)
+    blk.accept()
+    state = vm.chain.state_at(blk.block.root)
+    # import credit minus the EVM tx's value+fees still nets way up
+    assert state.get_balance(ADDR) > pre + 4_900_000_000 * X2C_RATE - 10**18
+    # UTXO consumed from shared memory
+    import pytest as _p
+    with _p.raises(Exception):
+        memory.new_shared_memory(ctx.chain_id).get(
+            ctx.x_chain_id, [utxo.input_id()])
+    # mempool drained
+    assert vm.atomic_mempool.pending_len() == 0
+    assert len(vm.atomic_mempool) == 0
