@@ -226,6 +226,62 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
     def eth_syncing():
         return False
 
+    def eth_accounts():
+        return []
+
+    def eth_getBlockTransactionCountByNumber(tag):
+        try:
+            return qty(len(b.resolve_block(tag).transactions))
+        except RPCError:
+            return None
+
+    def eth_getTransactionByBlockNumberAndIndex(tag, index):
+        try:
+            block = b.resolve_block(tag)
+        except RPCError:
+            return None  # probing past head yields null, not an error
+        i = int(index, 16) if isinstance(index, str) else int(index)
+        if i < 0 or i >= len(block.transactions):
+            return None
+        return tx_json(block.transactions[i], block, i, b.signer)
+
+    def eth_getProof(addr, slots, tag="latest"):
+        """EIP-1186 Merkle proofs over the account + storage tries
+        (internal/ethapi GetProof), built on mpt/proof.prove."""
+        from coreth_tpu.crypto import keccak256
+        from coreth_tpu.mpt.proof import prove
+        from coreth_tpu.state.statedb import normalize_state_key
+        from coreth_tpu.types import StateAccount
+        block = b.resolve_block(tag)
+        address = _addr(addr)
+        trie = b.chain.db.open_trie(block.root)
+        raw = trie.get(address)
+        acct = StateAccount.from_rlp(raw) if raw else StateAccount()
+        account_proof = prove(trie, keccak256(address))
+        from coreth_tpu import rlp as _rlp
+        storage_proof = []
+        st = b.chain.db.open_trie(acct.root)
+        for slot in slots or []:
+            key = int(slot, 16).to_bytes(32, "big")
+            nkey = normalize_state_key(key)
+            raw_v = st.get(nkey)
+            value = int.from_bytes(_rlp.decode(raw_v), "big") \
+                if raw_v else 0
+            storage_proof.append({
+                "key": slot,
+                "value": qty(value),
+                "proof": [data(p) for p in prove(st, keccak256(nkey))],
+            })
+        return {
+            "address": addr,
+            "accountProof": [data(p) for p in account_proof],
+            "balance": qty(acct.balance),
+            "nonce": qty(acct.nonce),
+            "codeHash": data(acct.code_hash),
+            "storageHash": data(acct.root),
+            "storageProof": storage_proof,
+        }
+
     for fn in (eth_chainId, eth_blockNumber, eth_getBalance,
                eth_getTransactionCount, eth_getCode, eth_getStorageAt,
                eth_getBlockByNumber, eth_getBlockByHash,
@@ -235,6 +291,8 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
                eth_getLogs, eth_newFilter, eth_newBlockFilter,
                eth_getFilterChanges, eth_getFilterLogs,
                eth_uninstallFilter, net_version, web3_clientVersion,
-               eth_syncing):
+               eth_syncing, eth_accounts,
+               eth_getBlockTransactionCountByNumber,
+               eth_getTransactionByBlockNumberAndIndex, eth_getProof):
         server.register(fn.__name__, fn)
     return filters

@@ -1,0 +1,87 @@
+"""Offline state pruning.
+
+Twin of reference core/state/pruner/ (pruner.go + bloom.go, driven by
+eth/backend.go:404 handleOfflinePruning): with the node stopped, walk
+the live state under the pinned root — the account trie, every storage
+trie it references, and every code blob — into a live set, then drop
+every other trie node from the durable store.  The live-set membership
+structure here is an exact set rather than the reference's bloom
+filter (no false-positive retention; the trade is memory, fine at
+these scales).
+"""
+
+from __future__ import annotations
+
+from typing import Set, Tuple
+
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.mpt.iterator import leaves
+from coreth_tpu.mpt.trie import (
+    BRANCH, EXT, HASHREF, LEAF, Trie,
+)
+from coreth_tpu.rawdb.kv import KVStore
+from coreth_tpu.rawdb.state_manager import PersistentNodeDict
+from coreth_tpu.types import StateAccount
+from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
+
+NODE_PREFIX = PersistentNodeDict.PREFIX
+CODE_PREFIX = b"c"
+
+
+def _collect_nodes(trie: Trie, live: Set[bytes]) -> None:
+    """Hashes of every node reachable under the trie's root."""
+    def walk(node):
+        node = trie._resolve(node)
+        if node is None:
+            return
+        encoded, ref = trie._encode_node(node, None)
+        if isinstance(ref, bytes) and len(ref) == 32:
+            live.add(ref)
+        kind = node[0]
+        if kind == EXT:
+            walk(node[2])
+        elif kind == BRANCH:
+            for c in node[1]:
+                if c is not None:
+                    walk(c)
+
+    walk(trie.root)
+
+
+def prune(kv: KVStore, state_root: bytes) -> Tuple[int, int]:
+    """Drop every trie node and code blob not reachable from
+    `state_root`; returns (kept, removed) counts.  Run offline — the
+    chain must not be writing the store concurrently."""
+    nodes = PersistentNodeDict(kv)
+    live_nodes: Set[bytes] = set()
+    live_code: Set[bytes] = set()
+
+    account_trie = Trie(root_hash=state_root, db=nodes)
+    _collect_nodes(account_trie, live_nodes)
+    for _key, raw in leaves(account_trie):
+        acct = StateAccount.from_rlp(raw)
+        if acct.root not in (EMPTY_ROOT, EMPTY_ROOT_HASH):
+            st = Trie(root_hash=acct.root, db=nodes)
+            _collect_nodes(st, live_nodes)
+        if acct.code_hash != EMPTY_CODE_HASH:
+            live_code.add(acct.code_hash)
+
+    kept = 0
+    removed = 0
+    for key, _v in list(kv.items()):
+        if key[:1] == NODE_PREFIX and len(key) == 33:
+            if key[1:] in live_nodes:
+                kept += 1
+            else:
+                kv.delete(key)
+                removed += 1
+        elif key[:1] == CODE_PREFIX and len(key) == 33:
+            if key[1:] in live_code:
+                kept += 1
+            else:
+                kv.delete(key)
+                removed += 1
+    kv.flush()
+    if hasattr(kv, "compact"):
+        kv.compact()
+    return kept, removed

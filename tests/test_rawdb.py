@@ -186,3 +186,68 @@ def test_receipts_survive_reopen(tmp_path):
     raw = schema.read_raw_receipts(kv, 1, blocks[0].hash())
     assert raw is not None and len(raw) == len(blocks[0].transactions)
     kv.close()
+
+
+def test_offline_pruner_drops_dead_state(tmp_path):
+    """Build a chain with per-block archive flushes, prune to the tip
+    root: historical-only trie nodes disappear, the tip state (incl.
+    storage + code) survives and reopens bit-identically."""
+    from coreth_tpu.state.pruner import prune
+    from coreth_tpu.workloads.erc20 import (
+        balance_slot, token_genesis_account,
+    )
+
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    token = bytes([0x7D]) * 20
+    alloc[token] = token_genesis_account({ADDRS[0]: 10**18})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+
+    # build blocks against a scratch db
+    build_db = Database()
+    gblock = genesis.to_block(build_db)
+    nonces = [0] * len(KEYS)
+
+    def gen(i, bg):
+        for j in range(4):
+            k = (i * 4 + j) % len(KEYS)
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=21_000,
+                to=bytes([0x61 + j]) * 20, value=5), KEYS[k],
+                CFG.chain_id))
+            nonces[k] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, build_db, 5, gen, gap=2)
+
+    path = str(tmp_path / "chain.log")
+    chain = BlockChain(Genesis(config=CFG, gas_limit=8_000_000,
+                               alloc=alloc),
+                       chain_kv=FileDB(path), archive=True)
+    chain.insert_chain(blocks)
+    tip_root = chain.last_accepted.root
+    chain.close()
+
+    kv = FileDB(path)
+    n_before = sum(1 for k, _ in kv.items() if k[:1] == b"n")
+    kept, removed = prune(kv, tip_root)
+    assert removed > 0
+    n_after = sum(1 for k, _ in kv.items() if k[:1] == b"n")
+    assert n_after < n_before
+    kv.close()
+
+    # reopen: tip state fully readable; an historical root is NOT
+    chain2 = BlockChain(Genesis(config=CFG, gas_limit=8_000_000,
+                                alloc=alloc),
+                        chain_kv=FileDB(path), archive=True)
+    state = chain2.state_at(tip_root)
+    assert state.get_balance(bytes([0x61]) * 20) > 0
+    assert state.get_code(token) != b""
+    assert int.from_bytes(
+        state.get_state(token, balance_slot(ADDRS[0])), "big") == 10**18
+    from coreth_tpu.mpt.trie import MissingNodeError, SecureTrie
+    with pytest.raises(MissingNodeError):
+        old = SecureTrie(root_hash=blocks[0].root,
+                         db=chain2.db.node_db)
+        for a in ADDRS:
+            old.get(a)
+    chain2.close()

@@ -218,3 +218,45 @@ def test_trace_block_and_log_index(stack):
     traced = call(server, "debug_traceBlockByNumber", "0x2")
     assert len(traced) == len(blocks[1].transactions)
     assert not traced[0]["result"]["failed"]
+
+
+def test_eth_get_proof(stack):
+    """EIP-1186 proofs verify against the header state root via the
+    proof module itself."""
+    from coreth_tpu.crypto import keccak256
+    from coreth_tpu.mpt.proof import verify_proof
+    from coreth_tpu.state.statedb import normalize_state_key
+    from coreth_tpu.types import StateAccount
+    from coreth_tpu.workloads.erc20 import balance_slot
+
+    server, backend, chain, blocks = stack
+    head = chain.current_block()
+    proof = call(server, "eth_getProof", "0x" + TOKEN.hex(),
+                 ["0x0"], "latest")
+    acct_proof = [bytes.fromhex(p[2:]) for p in proof["accountProof"]]
+    raw = verify_proof(head.root, keccak256(TOKEN), acct_proof)
+    acct = StateAccount.from_rlp(raw)
+    assert hex(acct.balance) == proof["balance"]
+    assert "0x" + acct.root.hex() == proof["storageHash"]
+    # a real token slot proves against the storage root
+    slot_hex = "0x" + balance_slot(ADDR2).hex()
+    proof2 = call(server, "eth_getProof", "0x" + TOKEN.hex(),
+                  [slot_hex], "latest")
+    sp = proof2["storageProof"][0]
+    nkey = normalize_state_key(balance_slot(ADDR2))
+    raw_v = verify_proof(acct.root, keccak256(nkey),
+                         [bytes.fromhex(p[2:]) for p in sp["proof"]])
+    assert raw_v is not None
+    assert int(sp["value"], 16) == 777
+
+
+def test_misc_rpc_methods(stack):
+    server, backend, chain, blocks = stack
+    assert call(server, "eth_accounts") == []
+    assert call(server, "eth_getBlockTransactionCountByNumber",
+                "0x1") == "0x1"
+    tx = call(server, "eth_getTransactionByBlockNumberAndIndex",
+              "0x1", "0x0")
+    assert tx["from"] == "0x" + ADDR.hex()
+    assert call(server, "eth_getTransactionByBlockNumberAndIndex",
+                "0x1", "0x5") is None
