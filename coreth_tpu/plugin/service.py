@@ -8,8 +8,9 @@ JSON frames ({"id", "method", "params"} -> {"id", "result"} |
 surface mirrors the snowman ChainVM + Block interfaces:
 
   initialize, buildBlock, parseBlock, getBlock, setPreference,
-  lastAccepted, issueTx, blockVerify, blockAccept, blockReject,
-  blockStatus, mempoolStats, health, shutdown
+  lastAccepted, issueTx, issueAtomicTx, blockVerify, blockAccept,
+  blockReject, blockStatus, mempoolStats, atomicMempoolStats, health,
+  shutdown
 
 VMServer hosts a VM instance; VMClient is the in-Python consensus-side
 stub (the role AvalancheGo's rpcchainvm client plays).
@@ -71,6 +72,13 @@ class VMServer:
         if method == "issueTx":
             vm.issue_tx(Transaction.decode(bytes.fromhex(params["tx"])))
             return {}
+        if method == "issueAtomicTx":
+            from coreth_tpu.atomic import Tx as AtomicTx
+            vm.issue_atomic_tx(
+                AtomicTx.decode(bytes.fromhex(params["tx"])))
+            return {}
+        if method == "atomicMempoolStats":
+            return vm.atomic_mempool_stats()
         if method == "blockVerify":
             blk = vm.get_block(bytes.fromhex(params["id"]))
             blk.verify()
@@ -201,6 +209,12 @@ class VMClient:
 
     def block_reject(self, block_id: bytes):
         return self.call("blockReject", id=block_id.hex())
+
+    def issue_atomic_tx(self, tx_bytes: bytes):
+        return self.call("issueAtomicTx", tx=tx_bytes.hex())
+
+    def atomic_mempool_stats(self):
+        return self.call("atomicMempoolStats")
 
     def poll_engine_message(self):
         return self.call("pollEngineMessage")["message"]

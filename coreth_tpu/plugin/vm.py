@@ -250,3 +250,10 @@ class VM:
     def mempool_stats(self):
         self._require_init()
         return self.txpool.stats()
+
+    def atomic_mempool_stats(self):
+        self._require_init()
+        pool = self.atomic_mempool
+        if pool is None:
+            return {"pending": 0, "total": 0}
+        return {"pending": pool.pending_len(), "total": len(pool)}

@@ -1095,6 +1095,15 @@ class ReplayEngine:
         self.stats.t_fallback += time.monotonic() - t0
         return root
 
+    def publish_metrics(self, registry=None,
+                        prefix: str = "replay") -> None:
+        """Feed the replay phase split into a metrics registry (the
+        engine-side analog of the blockchain.go timer metrics)."""
+        from coreth_tpu.metrics import Gauge, get_or_register
+        for name, value in self.stats.row().items():
+            get_or_register(f"{prefix}/{name}", Gauge,
+                            registry).update(value)
+
     def commit(self) -> bytes:
         """Persist the engine tries so host StateDBs can open the state."""
         if self._native:

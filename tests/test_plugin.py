@@ -262,3 +262,66 @@ def test_vm_atomic_import_end_to_end():
     # mempool drained
     assert vm.atomic_mempool.pending_len() == 0
     assert len(vm.atomic_mempool) == 0
+
+
+def test_service_atomic_methods(tmp_path):
+    from coreth_tpu.atomic import (
+        ChainContext, EVMOutput, Memory, TransferableInput,
+        TransferableOutput, Tx, UnsignedImportTx, UTXO, short_id,
+    )
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+    from coreth_tpu.crypto.secp256k1 import _g_mul, _to_affine
+
+    ctx = ChainContext()
+    memory = Memory()
+    out = TransferableOutput(asset_id=ctx.avax_asset_id,
+                             amount=5_000_000_000,
+                             addrs=[short_id(_to_affine(_g_mul(KEY)))])
+    utxo = UTXO(b"\x92" * 32, 0, out)
+    memory.new_shared_memory(ctx.x_chain_id).apply(
+        {ctx.chain_id: Requests(put_requests=[
+            Element(utxo.input_id(), utxo.encode(), out.addrs)])})
+    vm = VM(shared_memory=memory.new_shared_memory(ctx.chain_id),
+            chain_ctx=ctx)
+    sock = str(tmp_path / "vm.sock")
+    server = serve(vm, sock)
+    try:
+        client = VMClient(sock)
+        client.initialize(genesis_json())
+        atx = Tx(UnsignedImportTx(
+            network_id=ctx.network_id, blockchain_id=ctx.chain_id,
+            source_chain=ctx.x_chain_id,
+            imported_inputs=[TransferableInput(
+                tx_id=utxo.tx_id, output_index=0,
+                asset_id=out.asset_id, amount=out.amount,
+                sig_indices=[0])],
+            outs=[EVMOutput(ADDR, 4_990_000_000, ctx.avax_asset_id)]))
+        atx.sign([[KEY]])
+        client.issue_atomic_tx(atx.encode())
+        assert client.atomic_mempool_stats() == \
+            {"pending": 1, "total": 1}
+        built = client.build_block()
+        client.block_accept(bytes.fromhex(built["id"]))
+        assert client.atomic_mempool_stats() == \
+            {"pending": 0, "total": 0}
+        client.close()
+    finally:
+        server.close()
+
+
+def test_engine_publishes_metrics():
+    from coreth_tpu.metrics import Registry
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.state import Database
+    from coreth_tpu.chain import Genesis, GenesisAccount
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDR: GenesisAccount(balance=10**20)})
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256)
+    reg = Registry()
+    engine.publish_metrics(reg)
+    snap = reg.snapshot()
+    assert "replay/t_device" in snap and "replay/blocks_device" in snap
