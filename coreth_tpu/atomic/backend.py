@@ -53,14 +53,23 @@ def merge_requests(base: Dict[bytes, Requests],
         dst.put_requests.extend(req.put_requests)
 
 
+@dataclass
+class _PendingBlock:
+    """One verified-but-undecided block's atomic effect."""
+    height: int
+    requests: Dict[bytes, Requests]
+    parent_hash: bytes
+    inputs: frozenset
+
+
 class AtomicBackend:
     def __init__(self, ctx: ChainContext, shared_memory: SharedMemory,
                  trie: Optional[AtomicTrie] = None):
         self.ctx = ctx
         self.shared_memory = shared_memory
         self.trie = trie or AtomicTrie()
-        # blockHash -> (height, requests) for verified, undecided blocks
-        self._pending: Dict[bytes, Tuple[int, Dict[bytes, Requests]]] = {}
+        # blockHash -> effect of verified, undecided blocks
+        self._pending: Dict[bytes, _PendingBlock] = {}
 
     # -------------------------------------------------------------- verify
     def semantic_verify(self, tx: Tx, base_fee: Optional[int],
@@ -121,24 +130,54 @@ class AtomicBackend:
                     raise AtomicTxError(
                         "export input not signed by its address")
 
+    # ------------------------------------------------------------- conflicts
+    def check_ancestor_conflicts(self, parent_hash: bytes,
+                                 inputs) -> None:
+        """Reject inputs already consumed by a verified-but-unaccepted
+        ancestor (vm.go:1482 conflicts() walks processing ancestors).
+        Without this, two consecutive processing blocks could each
+        import the same UTXO: semantic_verify reads SharedMemory, which
+        reflects only *accepted* state, so both would verify — and both
+        Accepts would credit the EVM balance twice."""
+        inputs = frozenset(inputs)
+        if not inputs:
+            return
+        cursor = parent_hash
+        while cursor in self._pending:
+            anc = self._pending[cursor]
+            clash = inputs & anc.inputs
+            if clash:
+                raise AtomicTxError(
+                    "input conflicts with processing ancestor: "
+                    + next(iter(clash)).hex())
+            cursor = anc.parent_hash
+
     # ------------------------------------------------------------- lifecycle
     def insert_txs(self, block_hash: bytes, height: int,
-                   txs: List[Tx]) -> None:
+                   txs: List[Tx], parent_hash: bytes) -> None:
         """Track a verified block's atomic effect (backend :420)."""
         requests: Dict[bytes, Requests] = {}
+        inputs = set()
         for tx in txs:
             merge_requests(requests, tx_requests(tx))
-        self._pending[block_hash] = (height, requests)
+            inputs.update(tx.unsigned.input_utxos())
+        self._pending[block_hash] = _PendingBlock(
+            height, requests, parent_hash, frozenset(inputs))
 
     def accept(self, block_hash: bytes) -> bytes:
         """Accept: index in the atomic trie + apply to shared memory
         (block.go:177 Accept -> atomicState.Accept)."""
-        height, requests = self._pending.pop(block_hash, (None, None))
-        if height is None:
+        pend = self._pending.get(block_hash)
+        if pend is None:
             return self.trie.root()
-        self.trie.update_trie(height, requests)
-        self.trie.accept_trie(height)
-        self.shared_memory.apply(requests)
+        # validate the shared-memory effect BEFORE mutating anything so
+        # a double-spend caught by the backstop leaves trie + pending
+        # map + shared memory all consistent
+        self.shared_memory.validate_removes(pend.requests)
+        del self._pending[block_hash]
+        self.trie.update_trie(pend.height, pend.requests)
+        self.trie.accept_trie(pend.height)
+        self.shared_memory.apply(pend.requests)
         return self.trie.root()
 
     def reject(self, block_hash: bytes) -> None:
@@ -158,7 +197,7 @@ def make_callbacks(backend: AtomicBackend, config,
     """
     ctx = backend.ctx
 
-    def _apply_txs(txs, base_fee, number, time, statedb):
+    def _apply_txs(txs, base_fee, number, time, statedb, parent_hash):
         rules = config.rules(number, time)
         contribution = 0
         gas_used = 0
@@ -169,6 +208,9 @@ def make_callbacks(backend: AtomicBackend, config,
                     raise AtomicTxError("conflicting atomic inputs")
                 seen_inputs.add(inp)
             backend.semantic_verify(tx, base_fee, rules)
+        # and none spent by a verified-but-unaccepted ancestor either
+        backend.check_ancestor_conflicts(parent_hash, seen_inputs)
+        for tx in txs:
             if rules.is_apricot_phase4:
                 c, g = tx.block_fee_contribution(
                     rules.is_apricot_phase5, ctx.avax_asset_id, base_fee)
@@ -184,8 +226,10 @@ def make_callbacks(backend: AtomicBackend, config,
         if not txs:
             return None, None
         contribution, gas_used = _apply_txs(
-            txs, block.base_fee, block.number, block.time, statedb)
-        backend.insert_txs(block.hash(), block.number, txs)
+            txs, block.base_fee, block.number, block.time, statedb,
+            block.parent_hash)
+        backend.insert_txs(block.hash(), block.number, txs,
+                           parent_hash=block.parent_hash)
         return contribution, gas_used
 
     def on_finalize_and_assemble(header, statedb, txs):
@@ -193,7 +237,8 @@ def make_callbacks(backend: AtomicBackend, config,
         if not atxs:
             return b"", None, None
         contribution, gas_used = _apply_txs(
-            atxs, header.base_fee, header.number, header.time, statedb)
+            atxs, header.base_fee, header.number, header.time, statedb,
+            header.parent_hash)
         return encode_ext_data(atxs), contribution, gas_used
 
     return ConsensusCallbacks(

@@ -61,16 +61,35 @@ class SharedMemory:
                         return seen
         return seen
 
+    def validate_removes(self, requests: Dict[bytes, Requests]) -> None:
+        """Raise if any remove targets an absent key, before anything
+        is mutated (callers use this to keep accept-time state — trie,
+        pending maps, shared memory — consistent on failure)."""
+        for peer_chain, req in requests.items():
+            inbound = self.memory._space(peer_chain, self.chain_id)
+            for k in req.remove_requests:
+                if k not in inbound:
+                    raise KeyError(
+                        f"shared-memory remove of absent key {k.hex()}")
+
     def apply(self, requests: Dict[bytes, Requests]) -> None:
         """Apply a block's atomic ops (atomic_backend.go:252 shape):
         removes target OUR inbound view (consuming imports), puts land
-        in the PEER's inbound view (exports)."""
+        in the PEER's inbound view (exports).
+
+        Removing a key that is not present raises: a silent no-op here
+        would mask a double-spend that slipped past verification (the
+        backend's ancestor-conflict check is the first line of defense;
+        this is the backstop).  All removes are validated up front so a
+        rejected batch leaves shared memory untouched — atomicity is
+        part of this method's contract."""
+        self.validate_removes(requests)
         for peer_chain, req in requests.items():
             inbound = self.memory._space(peer_chain, self.chain_id)
             in_traits = self.memory._traits(peer_chain, self.chain_id)
             in_rev = self.memory._key_traits(peer_chain, self.chain_id)
             for k in req.remove_requests:
-                inbound.pop(k, None)
+                del inbound[k]
                 for t in in_rev.pop(k, []):
                     lst = in_traits.get(t)
                     if lst and k in lst:

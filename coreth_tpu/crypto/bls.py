@@ -20,6 +20,7 @@ exists.
 from __future__ import annotations
 
 import hashlib
+from functools import lru_cache
 from typing import List, Optional, Sequence, Tuple
 
 # ---------------------------------------------------------------- params
@@ -283,14 +284,7 @@ def g1_neg(p1):
 
 
 def g1_mul(pt, k: int):
-    k %= R
-    acc = None
-    while k:
-        if k & 1:
-            acc = g1_add(acc, pt)
-        pt = g1_add(pt, pt)
-        k >>= 1
-    return acc
+    return _g1_mul_raw(pt, k % R)
 
 
 def g2_add(p1, p2):
@@ -397,6 +391,33 @@ def pairing(q_g2, p_g1) -> Fq12:
     return miller_loop(_twist(q_g2), _g1_to_fq12(p_g1))
 
 
+# ------------------------------------------------------- subgroup checks
+
+def _g1_mul_raw(pt, k: int):
+    """Scalar mul WITHOUT reducing k mod R (g1_mul reduces, which would
+    make a subgroup check k=R trivially pass)."""
+    acc = None
+    while k:
+        if k & 1:
+            acc = g1_add(acc, pt)
+        pt = g1_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def g1_in_subgroup(pt) -> bool:
+    """Prime-order subgroup membership: [r]P == O.  E1(Fq) has order
+    h1*r with cofactor h1 = 0x396c8c005555e1568c00aaab0000aaab, so an
+    on-curve point can still lie outside G1."""
+    return pt is None or _g1_mul_raw(pt, R) is None
+
+
+def g2_in_subgroup(pt) -> bool:
+    """[r]Q == O on E2 (the E2 cofactor is ~2^381, so the check is
+    essential for untrusted 96-byte inputs)."""
+    return pt is None or g2_mul(pt, R) is None
+
+
 # ------------------------------------------------------------- encoding
 
 def g1_compress(pt) -> bytes:
@@ -409,7 +430,12 @@ def g1_compress(pt) -> bytes:
     return bytes(raw)
 
 
+@lru_cache(maxsize=4096)
 def g1_decompress(data: bytes):
+    """Decompress + subgroup-check, memoized: validator pubkeys recur
+    on every warp verification, and the [r]P membership check is the
+    expensive part — the cache makes it once-per-key.  Safe because the
+    returned point is an immutable tuple of ints."""
     if len(data) != 48:
         raise ValueError("bad G1 encoding length")
     if data[0] & 0x40:
@@ -422,7 +448,13 @@ def g1_decompress(data: bytes):
         raise ValueError("x not on curve")
     if (y > (P - 1) // 2) != y_flag:
         y = P - y
-    return (x, y)
+    pt = (x, y)
+    # blst enforces subgroup membership on deserialization; accepting
+    # points outside G1 enables small-subgroup/malleability attacks on
+    # warp pubkeys (advisor finding, round 3)
+    if not g1_in_subgroup(pt):
+        raise ValueError("point not in the r-order subgroup")
+    return pt
 
 
 def g2_compress(pt) -> bytes:
@@ -438,6 +470,7 @@ def g2_compress(pt) -> bytes:
     return bytes(raw)
 
 
+@lru_cache(maxsize=4096)
 def g2_decompress(data: bytes):
     if len(data) != 96:
         raise ValueError("bad G2 encoding length")
@@ -453,7 +486,10 @@ def g2_decompress(data: bytes):
     neg = -y
     if ((y[1], y[0]) > (neg[1], neg[0])) != y_flag:
         y = neg
-    return (x, y)
+    pt = (x, y)
+    if not g2_in_subgroup(pt):
+        raise ValueError("point not in the r-order subgroup")
+    return pt
 
 
 # -------------------------------------------------------- hash to curve

@@ -202,7 +202,7 @@ def test_export_tx_state_transfer_and_utxo_creation():
     with pytest.raises(AtomicTxError, match="invalid nonce"):
         unsigned.evm_state_transfer(CTX, statedb)
 
-    backend.insert_txs(b"\xB1" * 32, 1, [tx])
+    backend.insert_txs(b"\xB1" * 32, 1, [tx], parent_hash=b"\x00" * 32)
     backend.accept(b"\xB1" * 32)
     # destination chain sees the new UTXO, indexed by owner trait
     sm_x = memory.new_shared_memory(CTX.x_chain_id)
@@ -236,7 +236,7 @@ def test_reject_discards_pending_atomic_state():
     backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
     utxo = seed_import_utxo(memory, 5_000_000_000, KEY)
     tx = make_import_tx(utxo, ADDR, 1)
-    backend.insert_txs(b"\xB2" * 32, 1, [tx])
+    backend.insert_txs(b"\xB2" * 32, 1, [tx], parent_hash=b"\x00" * 32)
     backend.reject(b"\xB2" * 32)
     # nothing applied: the UTXO is still there, trie unindexed
     sm = memory.new_shared_memory(CTX.chain_id)
@@ -285,6 +285,48 @@ def test_import_duplicate_input_rejected():
     backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
     with pytest.raises(AtomicTxError, match="duplicate input"):
         backend.semantic_verify(tx, None, CFG.rules(1, 1000))
+
+
+def test_processing_ancestor_conflict_rejected():
+    """Two consecutive *processing* (verified, unaccepted) blocks must
+    not both import the same UTXO (vm.go:1482 conflicts() walks
+    processing ancestors).  semantic_verify alone cannot catch this —
+    it reads SharedMemory, which reflects only accepted state."""
+    memory = Memory()
+    utxo = seed_import_utxo(memory, 5_000_000_000, KEY)
+    tx1 = make_import_tx(utxo, ADDR, 4_000_000_000)
+    tx2 = make_import_tx(utxo, ADDR, 3_999_999_999)  # same input, new id
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    genesis_hash = b"\x60" * 32
+    b1 = b"\xB1" * 32
+    backend.insert_txs(b1, 1, [tx1], parent_hash=genesis_hash)
+    # child of the processing block: conflict
+    with pytest.raises(AtomicTxError, match="processing ancestor"):
+        backend.check_ancestor_conflicts(
+            b1, tx2.unsigned.input_utxos())
+    # sibling branch (same parent as b1, not a descendant): no conflict
+    backend.check_ancestor_conflicts(
+        genesis_hash, tx2.unsigned.input_utxos())
+    # once b1 is accepted it leaves the processing set; the conflict is
+    # then caught by the shared-memory backstop instead
+    backend.accept(b1)
+    backend.check_ancestor_conflicts(b1, tx2.unsigned.input_utxos())
+    backend.insert_txs(b"\xB2" * 32, 2, [tx2], parent_hash=b1)
+    with pytest.raises(KeyError, match="absent key"):
+        backend.accept(b"\xB2" * 32)
+
+
+def test_shared_memory_double_remove_raises():
+    """apply() must not silently no-op a remove of a missing key — that
+    would mask a double-spend reaching shared memory."""
+    memory = Memory()
+    utxo = seed_import_utxo(memory, 1_000, KEY)
+    sm = memory.new_shared_memory(CTX.chain_id)
+    req = {CTX.x_chain_id: Requests(
+        remove_requests=[utxo.input_id()])}
+    sm.apply(req)
+    with pytest.raises(KeyError, match="absent key"):
+        sm.apply(req)
 
 
 def test_import_empty_credential_rejected():

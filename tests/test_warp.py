@@ -44,6 +44,34 @@ VSET = ValidatorSet([
     for i in range(N_VALIDATORS)])
 
 
+def test_decompress_rejects_out_of_subgroup_points():
+    """blst enforces subgroup membership on deserialization; so must we
+    — an on-curve point outside the r-order subgroup is a malleability
+    vector for attacker-supplied warp pubkeys/signatures.  x=4 is on E1
+    (y^2 = 64+4 is a QR mod p) but E1 has order h1*r with h1 ~ 2^125,
+    and [r]P != O for it; likewise x=(2,0) on E2."""
+    x = 4
+    y2 = (pow(x, 3, bls.P) + 4) % bls.P
+    y = pow(y2, (bls.P + 1) // 4, bls.P)
+    assert y * y % bls.P == y2          # on curve...
+    assert not bls.g1_in_subgroup((x, y))  # ...but not in G1
+    raw = bls.g1_compress((x, y))
+    with pytest.raises(ValueError, match="subgroup"):
+        bls.g1_decompress(raw)
+
+    xx = bls.Fq2(2, 0)
+    yy = (xx.sq() * xx + bls.B2).sqrt()
+    assert yy is not None
+    assert not bls.g2_in_subgroup((xx, yy))
+    raw2 = bls.g2_compress((xx, yy))
+    with pytest.raises(ValueError, match="subgroup"):
+        bls.g2_decompress(raw2)
+
+    # honest keys/signatures still round-trip through the check
+    pk = bls.public_key(bls.secret_from_bytes(b"ok"))
+    assert bls.g1_in_subgroup(bls.g1_decompress(pk))
+
+
 def test_predicate_pack_roundtrip():
     for n in (0, 1, 31, 32, 33, 100):
         data = bytes(range(256))[:n]
