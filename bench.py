@@ -46,17 +46,35 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 os.environ.setdefault("CORETH_RECOVER_SPLIT", "0.8")
 N_BLOCKS = int(os.environ.get("BENCH_BLOCKS", "1024"))
 TXS_PER_BLOCK = int(os.environ.get("BENCH_TXS", "128"))
-BASELINE_BLOCKS = int(os.environ.get("BENCH_BASELINE_BLOCKS", "8"))
+# >=64 blocks so the extrapolated py-host denominator is not a ~1s
+# noise-dominated sample (round-3 verdict weak #9)
+BASELINE_BLOCKS = int(os.environ.get("BENCH_BASELINE_BLOCKS", "64"))
 # ~45k avg gas/tx against the 15M Cortina block gas limit caps token
 # blocks at ~300 txs; 256 keeps a pow2 batch shape
 ERC20_TXS = int(os.environ.get("BENCH_ERC20_TXS", "256"))
 ERC20_BASELINE_BLOCKS = int(
-    os.environ.get("BENCH_ERC20_BASELINE_BLOCKS", "4"))
+    os.environ.get("BENCH_ERC20_BASELINE_BLOCKS", "32"))
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 GWEI = 10**9
 N_KEYS = int(os.environ.get("BENCH_KEYS", "1024"))
 TOKEN = bytes([0x77]) * 20
+
+# Single-run ratios on this contended 1-core host proved unfalsifiable
+# (round-3 recorded 0.29x while reruns gave 1.30x and 2.61x) — every
+# timed region now runs BENCH_REPS times and the JSON reports the
+# median with min/max spread.
+REPS = int(os.environ.get("BENCH_REPS", "3"))
+
+
+def _median(xs):
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2
+
+
+def _spread(xs):
+    return [round(min(xs), 1), round(max(xs), 1)]
 
 
 def _txs_per_block(workload):
@@ -178,15 +196,18 @@ def run_native_baseline(genesis, wire_blocks):
         addr + acct.balance.to_bytes(32, "big")
         + acct.nonce.to_bytes(8, "big")
         for addr, acct in genesis.alloc.items())
-    t0 = time.monotonic()
-    rc, phases = native.baseline_replay(
-        bytes(recs), offs, bytes(roots), bytes(cbs), accounts,
-        len(genesis.alloc))
-    dt = time.monotonic() - t0
-    if rc != 0:
-        raise RuntimeError(f"native baseline failed rc={rc}")
     txs = sum(len(b.transactions) for b in blocks)
-    return txs / dt, {"t_sender": round(phases[0], 3),
+    tps_runs, phases = [], None
+    for _ in range(REPS):
+        t0 = time.monotonic()
+        rc, phases = native.baseline_replay(
+            bytes(recs), offs, bytes(roots), bytes(cbs), accounts,
+            len(genesis.alloc))
+        dt = time.monotonic() - t0
+        if rc != 0:
+            raise RuntimeError(f"native baseline failed rc={rc}")
+        tps_runs.append(txs / dt)
+    return tps_runs, {"t_sender": round(phases[0], 3),
                       "t_exec": round(phases[1], 3),
                       "t_trie": round(phases[2], 3)}
 
@@ -195,13 +216,17 @@ def run_baseline(genesis, wire_blocks, n_blocks):
     """Sequential host insert (fresh sender cache) over a block subset."""
     from coreth_tpu.chain import BlockChain
     from coreth_tpu.types import Block
-    blocks = [Block.decode(w) for w in wire_blocks[:n_blocks]]
-    chain = BlockChain(genesis)
-    t0 = time.monotonic()
-    chain.insert_chain(blocks)
-    dt = time.monotonic() - t0
-    txs = sum(len(b.transactions) for b in blocks)
-    return txs / dt, chain.timers.row()
+    tps_runs, timers = [], None
+    for _ in range(REPS):
+        blocks = [Block.decode(w) for w in wire_blocks[:n_blocks]]
+        chain = BlockChain(genesis)
+        t0 = time.monotonic()
+        chain.insert_chain(blocks)
+        dt = time.monotonic() - t0
+        txs = sum(len(b.transactions) for b in blocks)
+        tps_runs.append(txs / dt)
+        timers = chain.timers.row()
+    return tps_runs, timers
 
 
 def _fresh_engine(genesis, txs_per_block):
@@ -218,7 +243,7 @@ def _fresh_engine(genesis, txs_per_block):
                         parent_header=gblock.header,
                         batch_pad=txs_per_block, capacity=capacity,
                         slot_capacity=1 << 14,
-                        window=int(os.environ.get("BENCH_WINDOW", "32")))
+                        window=int(os.environ.get("BENCH_WINDOW", "128")))
 
 
 def run_tpu(genesis, wire_blocks, txs_per_block):
@@ -236,56 +261,71 @@ def run_tpu(genesis, wire_blocks, txs_per_block):
     assert warm.root == warm_blocks[-1].header.root
     assert warm.stats.blocks_fallback == 0, warm.stats.row()
 
-    # Timed pass: fresh Block objects (no cached senders), fresh state.
-    blocks = [Block.decode(w) for w in wire_blocks]
-    engine = _fresh_engine(genesis, txs_per_block)
-    engine.replay_block(blocks[0])
-    t0 = time.monotonic()
-    engine.replay(blocks[1:])
-    dt = time.monotonic() - t0
-    txs = sum(len(b.transactions) for b in blocks[1:])
-    assert engine.root == blocks[-1].header.root
-    assert engine.stats.blocks_fallback == 0, engine.stats.row()
-    return txs / dt, engine.stats.row()
+    # Timed passes: fresh Block objects (no cached senders), fresh state
+    # each rep; compiled executables are shared via the XLA cache.
+    tps_runs, stats = [], None
+    for _ in range(REPS):
+        blocks = [Block.decode(w) for w in wire_blocks]
+        engine = _fresh_engine(genesis, txs_per_block)
+        engine.replay_block(blocks[0])
+        t0 = time.monotonic()
+        engine.replay(blocks[1:])
+        dt = time.monotonic() - t0
+        txs = sum(len(b.transactions) for b in blocks[1:])
+        assert engine.root == blocks[-1].header.root
+        assert engine.stats.blocks_fallback == 0, engine.stats.row()
+        tps_runs.append(txs / dt)
+        stats = engine.stats.row()
+    return tps_runs, stats
 
 
 def run_workload(workload, baseline_blocks):
     genesis, blocks = build_or_load_chain(workload)
     wire = [b.encode() for b in blocks]
-    base_tps, base_timers = run_baseline(genesis, wire, baseline_blocks)
-    native_tps = None
+    base_runs, base_timers = run_baseline(genesis, wire, baseline_blocks)
+    native_runs = None
     from coreth_tpu.crypto import native as _native
     if workload == "transfer" and _native.load() is not None:
-        native_tps, native_phases = run_native_baseline(genesis, wire)
-    tpu_tps, tpu_stats = run_tpu(genesis, wire, _txs_per_block(workload))
+        native_runs, native_phases = run_native_baseline(genesis, wire)
+    tpu_runs, tpu_stats = run_tpu(genesis, wire, _txs_per_block(workload))
     if os.environ.get("BENCH_VERBOSE"):
-        print(f"[{workload}] py-host baseline", round(base_tps, 1),
+        print(f"[{workload}] py-host baseline", [round(x) for x in base_runs],
               "txs/s", base_timers, file=sys.stderr)
-        if native_tps:
-            print(f"[{workload}] native baseline", round(native_tps, 1),
-                  "txs/s", native_phases, file=sys.stderr)
-        print(f"[{workload}] tpu", round(tpu_tps, 1), "txs/s", tpu_stats,
-              file=sys.stderr)
-    return base_tps, tpu_tps, native_tps
+        if native_runs:
+            print(f"[{workload}] native baseline",
+                  [round(x) for x in native_runs], "txs/s", native_phases,
+                  file=sys.stderr)
+        print(f"[{workload}] tpu", [round(x) for x in tpu_runs], "txs/s",
+              tpu_stats, file=sys.stderr)
+    return base_runs, tpu_runs, native_runs
 
 
 def main():
-    py_tps, tpu_tps, native_tps = run_workload("transfer", BASELINE_BLOCKS)
+    py_runs, tpu_runs, native_runs = run_workload(
+        "transfer", BASELINE_BLOCKS)
     erc20_py, erc20_tpu, _ = run_workload("erc20", ERC20_BASELINE_BLOCKS)
+    py_tps, tpu_tps = _median(py_runs), _median(tpu_runs)
+    native_tps = _median(native_runs) if native_runs else None
     result = {
         "metric": "transfer_replay_throughput",
         "value": round(tpu_tps, 1),
         "unit": "txs/s",
-        # primary ratio: vs the compiled sequential C++ replay (the
-        # Go-proxy baseline, BASELINE.md) — the honest denominator;
-        # falls back to the Python host path where the native build
-        # is unavailable
+        # primary ratio: median TPU / median compiled sequential C++
+        # replay (the Go-proxy baseline, BASELINE.md) — the honest
+        # denominator; falls back to the Python host path where the
+        # native build is unavailable
         "vs_baseline": round(tpu_tps / (native_tps or py_tps), 2),
+        "reps": REPS,
+        "tpu_spread_txs_s": _spread(tpu_runs),
         "native_baseline_txs_s":
             round(native_tps, 1) if native_tps else None,
+        "native_spread_txs_s": _spread(native_runs) if native_runs else None,
         "vs_py_host": round(tpu_tps / py_tps, 2),
-        "erc20_txs_s": round(erc20_tpu, 1),
-        "erc20_vs_py_host": round(erc20_tpu / erc20_py, 2),
+        "erc20_txs_s": round(_median(erc20_tpu), 1),
+        "erc20_spread_txs_s": _spread(erc20_tpu),
+        "erc20_vs_py_host": round(_median(erc20_tpu) / _median(erc20_py), 2),
+        "host": {"cpus": os.cpu_count(),
+                 "loadavg": [round(x, 2) for x in os.getloadavg()]},
     }
     print(json.dumps(result))
 

@@ -62,6 +62,10 @@ def load():
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_double)]
     lib.coreth_baseline_replay.restype = ctypes.c_int
+    lib.coreth_receipt_root.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p]
+    lib.coreth_receipt_root.restype = None
     _lib = lib
     return _lib
 
@@ -125,6 +129,23 @@ def baseline_replay(tx_records: bytes, block_offsets, roots: bytes,
         tx_records, off, n_blocks, roots, coinbases, accounts,
         n_accounts, phases)
     return rc, list(phases)
+
+
+def receipt_root(cum_gas, tx_types: bytes, has_log: bytes,
+                 log_blob: bytes):
+    """Receipt-trie root + header bloom for a device-path block in one
+    C++ call (DeriveSha/StackTrie + CreateBloom role — reference
+    core/types/hashing.go:97, bloom9.go).  Receipts are status-1 with 0
+    or 1 Transfer-shaped log (addr20 ++ 3*topic32 ++ data32 = 148B).
+    Returns (root32, bloom256)."""
+    lib = _require()
+    n = len(tx_types)
+    cg = (ctypes.c_uint64 * n)(*cum_gas)
+    root = ctypes.create_string_buffer(32)
+    bloom = ctypes.create_string_buffer(256)
+    lib.coreth_receipt_root(cg, tx_types, has_log, log_blob, n, root,
+                            bloom)
+    return root.raw, bloom.raw
 
 
 def recover_prep(hashes: bytes, rs: bytes, ss: bytes, recids: bytes):

@@ -99,12 +99,20 @@ def decode_consensus_receipt(data: bytes) -> "Receipt":
     return r
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=1 << 16)
 def bloom9(value: bytes) -> int:
     """Bloom bits for one value as an int (reference bloom9.go:139-159).
 
     Three bit positions from the first 6 bytes of keccak256(value), each
     position = 11 low bits of a big-endian byte pair.
-    """
+
+    Memoized: bloomed values repeat heavily (contract addresses, event
+    signature topics, recurring account topics), and the replay hot
+    path blooms every log twice — once into the receipt bloom, once
+    into the header bloom."""
     h = keccak256(value)
     out = 0
     for i in (0, 2, 4):

@@ -124,8 +124,15 @@ TOKEN_RUNTIME = _assemble([
 TOKEN_CODE_HASH = keccak256(TOKEN_RUNTIME)
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=1 << 17)
 def balance_slot(addr: bytes) -> bytes:
-    """Storage slot key of balances[addr] (mapping slot 0)."""
+    """Storage slot key of balances[addr] (mapping slot 0).  Memoized:
+    the replay classifier derives two slot keys per token tx and the
+    sender/recipient population recurs across blocks, so the keccak
+    runs once per address instead of once per tx."""
     return keccak256(b"\x00" * 12 + addr + b"\x00" * 32)
 
 

@@ -14,7 +14,7 @@ from coreth_tpu.ops import u256
 from coreth_tpu.params import TEST_CHAIN_CONFIG
 from coreth_tpu.replay import ReplayEngine
 from coreth_tpu.state import Database
-from coreth_tpu.types import DynamicFeeTx, sign_tx
+from coreth_tpu.types import DynamicFeeTx, create_bloom, derive_sha, sign_tx
 
 GWEI = 10**9
 KEYS = [0x1000 + i for i in range(8)]
@@ -370,6 +370,87 @@ def test_replay_token_insufficient_falls_back_then_resumes():
     root = engine.replay(blocks)
     assert root == blocks[-1].root
     assert engine.stats.blocks_fallback == 1   # the overdraw block
+    assert engine.stats.blocks_device == 2
+
+
+def test_native_receipt_root_parity():
+    """The C++ receipt-root builder (native.receipt_root — the
+    DeriveSha + CreateBloom fast path) must be bit-identical to the
+    Python StackTrie/bloom path across the rlp-key length boundary
+    (127/129) and mixed typed/legacy receipts."""
+    from coreth_tpu.crypto import native
+    from coreth_tpu.types import Receipt, Log
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    for ntx in (1, 127, 129, 260):
+        receipts, cums, types, haslog = [], [], [], []
+        blob = b""
+        cum = 0
+        for i in range(ntx):
+            cum += 21000 + i
+            tx_type = 2 if i % 2 else 0
+            if i % 3 == 0:
+                lg = Log(address=bytes([i % 256]) * 20,
+                         topics=[bytes([7]) * 32, bytes([i % 251]) * 32,
+                                 bytes([3]) * 32],
+                         data=i.to_bytes(32, "big"))
+                logs = [lg]
+                haslog.append(1)
+                blob += lg.address + b"".join(lg.topics) + lg.data
+            else:
+                logs = []
+                haslog.append(0)
+            receipts.append(Receipt(tx_type=tx_type, status=1,
+                                    cumulative_gas_used=cum, logs=logs))
+            cums.append(cum)
+            types.append(tx_type)
+        root, bloom = native.receipt_root(
+            cums, bytes(types), bytes(haslog), blob)
+        assert root == derive_sha(receipts)
+        assert bloom == create_bloom(receipts)
+
+
+def test_replay_speculative_window_discard():
+    """The pipelined replay issues window k+1 before validating window
+    k.  With window=1, block 1's validation failure must discard the
+    already-issued speculative window for block 2 (computed on the
+    now-stale device state), rewind, run block 1 on the host path, and
+    re-derive block 2 — landing on the exact sequential root."""
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDRS[0]: GenesisAccount(balance=10**24),
+                             ADDRS[1]: GenesisAccount(balance=10**17),
+                             ADDRS[2]: GenesisAccount(balance=10**24)})
+    db0 = Database()
+    gblock = genesis.to_block(db0)
+    big = 5 * 10**23
+
+    def gen(i, bg):
+        if i == 1:
+            # sequentially valid, fails the conservative device check
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=1, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDRS[1],
+                value=big), KEYS[0], CFG.chain_id))
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=0, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDRS[2],
+                value=big // 2), KEYS[1], CFG.chain_id))
+        else:
+            nonce = {0: 0, 2: 2}[i]
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonce, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000,
+                to=bytes([0x52 + i]) * 20, value=777),
+                KEYS[0], CFG.chain_id))
+
+    blocks, _ = generate_chain(CFG, gblock, db0, 3, gen, gap=2)
+    db = Database()
+    gb = genesis.to_block(db)
+    engine = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                          capacity=256, batch_pad=64, window=1)
+    root = engine.replay(blocks)
+    assert root == blocks[-1].root
+    assert engine.stats.blocks_fallback == 1
     assert engine.stats.blocks_device == 2
 
 
