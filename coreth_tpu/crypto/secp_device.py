@@ -78,20 +78,24 @@ MAX_CHUNK = int(__import__("os").environ.get(
 
 
 def issue_recover(hashes: bytes, rs: bytes, ss: bytes,
-                  recids: bytes) -> list:
+                  recids: bytes, kernel=None) -> list:
     """Host prep + async kernel dispatch for a packed signature batch.
 
     Returns a list of per-chunk contexts; pass to complete_recover to
     block on the device results and finish on host.  The kernel calls
     are dispatched asynchronously (jax), so the caller can do host work
-    — or enqueue more device work — while the ladder runs."""
+    — or enqueue more device work — while the ladder runs.
+
+    kernel: alternative device entry with recover_kernel's signature —
+    the mesh-sharded ladder (parallel/mesh.py sharded_recover) plugs in
+    here so multi-chip recovery reuses all of the host prep/finish."""
     n = len(recids)
     ctxs = []
     for lo in range(0, n, MAX_CHUNK):
         hi = min(lo + MAX_CHUNK, n)
         ctxs.append(_issue_chunk(
             hashes[32 * lo:32 * hi], rs[32 * lo:32 * hi],
-            ss[32 * lo:32 * hi], recids[lo:hi]))
+            ss[32 * lo:32 * hi], recids[lo:hi], kernel))
     return ctxs
 
 
@@ -112,7 +116,8 @@ def recover_addresses_device(hashes: bytes, rs: bytes, ss: bytes,
     return complete_recover(issue_recover(hashes, rs, ss, recids))
 
 
-def _issue_chunk(hashes: bytes, rs: bytes, ss: bytes, recids: bytes):
+def _issue_chunk(hashes: bytes, rs: bytes, ss: bytes, recids: bytes,
+                 kernel=None):
     from coreth_tpu.ops import secp as S
 
     n = len(recids)
@@ -171,7 +176,7 @@ def _issue_chunk(hashes: bytes, rs: bytes, ss: bytes, recids: bytes):
     # --- device: sqrt + G+R table + Shamir ladder, async dispatch ------
     parity = np.frombuffer(recids, dtype=np.uint8).astype(np.int32) & 1
     parity = np.concatenate([parity, np.zeros(pad - n, np.int32)])
-    dev_out = S.recover_kernel(x_arr, parity, u1_arr, u2_arr)
+    dev_out = (kernel or S.recover_kernel)(x_arr, parity, u1_arr, u2_arr)
     return dict(n=n, dev_out=dev_out, ok=ok, hashes=hashes, rs=rs, ss=ss,
                 recids=recids)
 

@@ -127,6 +127,18 @@ def pack_txd(batch: dict, B: int, pad: int) -> np.ndarray:
     return txd
 
 
+def txd_cols(txd):
+    """Column views of a packed tx batch — the ONE decoder of the
+    pack_txd layout (both execution backends consume it through this,
+    so a layout change cannot silently diverge them).  Returns
+    (senders, recips, values16, fees16, required16, tx_nonce,
+    nonce_offset, mask, coinbase, from_slots, to_slots, amount16)."""
+    return (txd[:, 0], txd[:, 1], txd[:, 6:22], txd[:, 22:38],
+            txd[:, 38:54], txd[:, 2], txd[:, 3],
+            txd[:, 4].astype(bool), txd[0, 5], txd[:, 54], txd[:, 55],
+            txd[:, 56:72])
+
+
 def _gather_fetch(balances, nonces, slot_vals, ok, t_idx, s_idx):
     """[t_pad+s_pad+1, 17] fetch tensor: touched (balance, nonce) rows,
     touched storage-slot value rows, and the ok flag."""
@@ -143,13 +155,14 @@ def _gather_fetch(balances, nonces, slot_vals, ok, t_idx, s_idx):
 def _step_core(balances, nonces, slot_vals, txd, num_accounts: int,
                num_slots: int):
     """One block of transfers (native + token) from a packed batch."""
+    (senders, recips, values, fees, required, tx_nonce, offsets, mask,
+     coinbase, from_slots, to_slots, amounts) = txd_cols(txd)
     nb, nn, ok = _transfer_step(
-        balances, nonces, txd[:, 0], txd[:, 1], txd[:, 6:22],
-        txd[:, 22:38], txd[:, 38:54], txd[:, 2], txd[:, 3],
-        txd[:, 4].astype(bool), txd[0, 5], num_accounts=num_accounts)
+        balances, nonces, senders, recips, values, fees, required,
+        tx_nonce, offsets, mask, coinbase, num_accounts=num_accounts)
     sv, ok_slots = _slot_step(
-        slot_vals, txd[:, 54], txd[:, 55], txd[:, 56:72],
-        txd[:, 4].astype(bool), num_slots=num_slots)
+        slot_vals, from_slots, to_slots, amounts, mask,
+        num_slots=num_slots)
     return nb, nn, sv, ok & ok_slots
 
 
@@ -451,7 +464,9 @@ class _SenderPipeline:
                         issue_recover)
                     self.dev_sigs += n
                     h["kind"] = "device"
-                    h["ctxs"] = issue_recover(hashes, rs, ss, recids)
+                    h["ctxs"] = issue_recover(
+                        hashes, rs, ss, recids,
+                        kernel=eng._recover_kernel())
                 # else: no native lib, no accelerator — signer.sender's
                 # per-tx python path recovers lazily
         except Exception:  # noqa: BLE001 — degrade to lazy per-tx
@@ -495,9 +510,36 @@ class ReplayEngine:
     def __init__(self, config: ChainConfig, db: Database, state_root: bytes,
                  parent_header=None, batch_pad: int = 1024,
                  capacity: int = 1 << 14, window: int = 16,
-                 slot_capacity: Optional[int] = None):
+                 slot_capacity: Optional[int] = None, mesh=None):
+        """mesh: a jax.sharding.Mesh with >1 device switches execution
+        to the mesh-sharded kernels (parallel/mesh.py): tx batches and
+        state rows shard over the ``dp`` axis, per-account/per-slot
+        totals reduce with psum_scatter over ICI, and sender recovery
+        fans out across chips.  Bit-identical to the single-device path
+        (pinned by tests/test_parallel.py)."""
         self.config = config
         self.db = db
+        self.mesh = None
+        if mesh is not None and mesh.devices.size > 1:
+            from coreth_tpu.parallel import (
+                sharded_recover, sharded_slot_step, sharded_transfer_step)
+            cap = capacity
+            scap = slot_capacity or capacity
+            n_dev = mesh.devices.size
+            for name, dim in (("capacity", cap), ("slot_capacity", scap),
+                              ("batch_pad", batch_pad)):
+                if dim % n_dev:
+                    raise ValueError(
+                        f"{name}={dim} must divide by the mesh size "
+                        f"{n_dev} (rows/txs shard over the dp axis); "
+                        "doubling growth preserves divisibility, so fix "
+                        "the initial value")
+            self.mesh = mesh
+            self._mesh_cap = cap
+            self._mesh_scap = scap
+            self._mesh_transfer = sharded_transfer_step(mesh, cap)
+            self._mesh_slot = sharded_slot_step(mesh, scap)
+            self._mesh_recover = sharded_recover(mesh)
         from coreth_tpu.mpt import native_trie
         self._native = native_trie.available()
         self.trie = db.open_trie(state_root)
@@ -680,7 +722,8 @@ class ReplayEngine:
         from coreth_tpu.crypto.secp_device import (
             complete_recover, issue_recover)
         ctxs = issue_recover(hashes[:32 * n_dev], rs[:32 * n_dev],
-                             ss[:32 * n_dev], recids[:n_dev])
+                             ss[:32 * n_dev], recids[:n_dev],
+                             kernel=self._recover_kernel())
         out_dev, ok_dev = complete_recover(ctxs)
         if host_fut is None:
             return out_dev, ok_dev
@@ -692,6 +735,16 @@ class ReplayEngine:
             from concurrent.futures import ThreadPoolExecutor
             self._recover_pool = ThreadPoolExecutor(max_workers=1)
         return self._recover_pool
+
+    def _recover_kernel(self):
+        """The device recovery kernel: mesh-sharded fan-out when a mesh
+        is configured (sender_cacher across chips), else the single-chip
+        ladder (None = secp_device default).  The recover pad is a pow2
+        with floor 64 (secp_device._pad_pow2), so a mesh whose size does
+        not divide 64 cannot shard it — fall back to single-device."""
+        if self.mesh is not None and 64 % self.mesh.devices.size == 0:
+            return self._mesh_recover
+        return None
 
     # ------------------------------------------------------------- classify
     def _classify(self, block: Block) -> Optional[dict]:
@@ -968,10 +1021,99 @@ class ReplayEngine:
         return (txds, t_idxs, s_idxs, acct_gids, slot_gids,
                 touched_lists, slot_lists, flushed)
 
+    def _mesh_fns(self):
+        """Mesh step functions, rebuilt if the account table grew past
+        the capacity they were compiled for."""
+        if (self.state.capacity != self._mesh_cap
+                or self.state.slot_capacity != self._mesh_scap):
+            from coreth_tpu.parallel import (
+                sharded_slot_step, sharded_transfer_step)
+            self._mesh_cap = self.state.capacity
+            self._mesh_scap = self.state.slot_capacity
+            self._mesh_transfer = sharded_transfer_step(
+                self.mesh, self._mesh_cap)
+            self._mesh_slot = sharded_slot_step(self.mesh, self._mesh_scap)
+        return self._mesh_transfer, self._mesh_slot
+
+    def _issue_window_mesh(self, items: List[Tuple[Block, dict]],
+                           fetch: bool = True) -> dict:
+        """Mesh-sharded execution of a window (parallel/mesh.py): per
+        block, the tx batch shards over ``dp``, each device segment-sums
+        full-width partial totals from its tx shard, and psum_scatter
+        reduces them onto the account/slot row sharding over ICI.
+
+        Blocks dispatch individually — on a locally-attached mesh the
+        per-dispatch cost the single-chip tunnel amortizes with its
+        window scan is negligible next to the collective latency, and
+        per-block fetches are what the host trie fold needs anyway.
+        Returns the same win dict shape as _issue_window."""
+        t0 = time.monotonic()
+        flushed = self.state.flush_staged()
+        prev = (self.state.balances, self.state.nonces,
+                self.state.slot_vals)
+        step_fn, slot_fn = self._mesh_fns()
+        t_pad, s_pad = 256, 8
+        touched_lists, slot_lists = [], []
+        for block, batch in items:
+            touched = sorted(set(batch["senders"]) | set(batch["recips"])
+                             | {batch["coinbase"]})
+            touched_lists.append(touched)
+            while t_pad < len(touched):
+                t_pad *= 2
+            slots = sorted((set(batch["from_slots"])
+                            | set(batch["to_slots"])) - {0})
+            slot_lists.append(slots)
+            while s_pad < len(slots):
+                s_pad *= 2
+        K = len(items)
+        fetches = np.zeros((K, t_pad + s_pad + 1, u256.LIMBS + 1),
+                           dtype=np.int32)
+        failed = False
+        for k, (block, batch) in enumerate(items):
+            if failed:
+                break  # ok=0 rows already zeroed; rewind handles rest
+            B = len(block.transactions)
+            pad = self.batch_pad
+            while pad < B:
+                pad *= 2
+            txd = pack_txd(batch, B, pad)  # global indices: no remap
+            txj = jnp.asarray(txd)
+            (senders, recips, values, fees, required, tx_nonce, offsets,
+             mask, _cb, from_slots, to_slots, amounts) = txd_cols(txj)
+            nb, nn, ok1 = step_fn(
+                self.state.balances, self.state.nonces, senders, recips,
+                values, fees, required, tx_nonce, offsets, mask,
+                int(txd[0, 5]))
+            sv, ok2 = slot_fn(self.state.slot_vals, from_slots,
+                              to_slots, amounts, mask)
+            self.state.balances = nb
+            self.state.nonces = nn
+            self.state.slot_vals = sv
+            if not fetch:
+                continue  # rewind re-apply: state only, no downloads
+            ok = bool(ok1) and bool(ok2)
+            tl, sl = touched_lists[k], slot_lists[k]
+            if tl:
+                ti = jnp.asarray(np.asarray(tl, dtype=np.int32))
+                fetches[k, :len(tl), :u256.LIMBS] = np.asarray(nb[ti])
+                fetches[k, :len(tl), u256.LIMBS] = np.asarray(nn[ti])
+            if sl:
+                si = jnp.asarray(np.asarray(sl, dtype=np.int32))
+                fetches[k, t_pad:t_pad + len(sl), :u256.LIMBS] = \
+                    np.asarray(sv[si])
+            fetches[k, -1, 0] = 1 if ok else 0
+            failed = not ok
+        self.stats.t_device += time.monotonic() - t0
+        return dict(items=items, prev=prev, fetches=fetches,
+                    touched_lists=touched_lists, slot_lists=slot_lists,
+                    t_pad=t_pad, flushed=flushed)
+
     def _issue_window(self, items: List[Tuple[Block, dict]]) -> dict:
         """One device call for a whole run of transfer blocks: upload the
         stacked batches, lax.scan the steps, download one stacked fetch
         tensor.  Round-trip latency amortizes over the window."""
+        if self.mesh is not None:
+            return self._issue_window_mesh(items)
         t0 = time.monotonic()
         (txds, t_idxs, s_idxs, acct_gids, slot_gids, touched_lists,
          slot_lists, flushed) = self._prepare_window(items)
@@ -1054,16 +1196,20 @@ class ReplayEngine:
          self.state.slot_vals) = win["prev"]
         if k > 0:
             items = win["items"][:k]
-            (txds, t_idxs, s_idxs, acct_gids, slot_gids, _,
-             _, _) = self._prepare_window(items)
-            new_bal, new_non, new_sv, _ = _transfer_window(
-                self.state.balances, self.state.nonces,
-                self.state.slot_vals, jnp.asarray(acct_gids),
-                jnp.asarray(slot_gids), jnp.asarray(txds),
-                jnp.asarray(t_idxs), jnp.asarray(s_idxs))
-            self.state.balances = new_bal
-            self.state.nonces = new_non
-            self.state.slot_vals = new_sv
+            if self.mesh is not None:
+                # state-only re-apply; no per-block host downloads
+                self._issue_window_mesh(items, fetch=False)
+            else:
+                (txds, t_idxs, s_idxs, acct_gids, slot_gids, _,
+                 _, _) = self._prepare_window(items)
+                new_bal, new_non, new_sv, _ = _transfer_window(
+                    self.state.balances, self.state.nonces,
+                    self.state.slot_vals, jnp.asarray(acct_gids),
+                    jnp.asarray(slot_gids), jnp.asarray(txds),
+                    jnp.asarray(t_idxs), jnp.asarray(s_idxs))
+                self.state.balances = new_bal
+                self.state.nonces = new_non
+                self.state.slot_vals = new_sv
         self._fallback(blocks[start_idx + k])
         return start_idx + k + 1
 

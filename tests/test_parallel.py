@@ -108,6 +108,106 @@ def test_sharded_slot_step_matches_single_device():
         u256.to_ints(np.asarray(single_vals))
 
 
+def test_mesh_engine_replays_chain_bit_identical():
+    """The FULL ReplayEngine with a mesh (mesh=...) replays a mixed
+    transfer+token chain through the sharded kernels and lands on the
+    exact header roots, identically to the single-device engine — the
+    round-3 verdict's 'one engine, two backends, pinned equivalence'."""
+    from test_replay import build_token_chain, CFG, ADDRS, KEYS, GWEI, TOKEN
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.state import Database
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    from coreth_tpu.workloads.erc20 import transfer_calldata
+
+    def gen(i, bg):
+        # blocks mix plain value transfers with token transfer() calls
+        for j in range(16):
+            k = (i * 16 + j) % len(KEYS)
+            nonce = gen.nonces[k]
+            if j % 2 == 0:
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=CFG.chain_id, nonce=nonce,
+                    gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                    gas=21_000, to=bytes([0x60 + j]) * 20,
+                    value=500 + j), KEYS[k], CFG.chain_id))
+            else:
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=CFG.chain_id, nonce=nonce,
+                    gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                    gas=100_000, to=TOKEN, value=0,
+                    data=transfer_calldata(ADDRS[(k + 3) % len(ADDRS)],
+                                           7 + j)), KEYS[k],
+                    CFG.chain_id))
+            gen.nonces[k] += 1
+
+    gen.nonces = [0] * len(KEYS)
+    genesis, gblock, blocks, _ = build_token_chain(4, 16, gen_tx=gen)
+
+    def run(mesh):
+        db = Database()
+        gb = genesis.to_block(db)
+        eng = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                           capacity=256, batch_pad=64, window=2,
+                           mesh=mesh)
+        root = eng.replay(blocks)
+        return root, eng.stats.blocks_device, eng.stats.blocks_fallback
+
+    root_single, dev_s, fb_s = run(None)
+    mesh = make_mesh(jax.devices("cpu")[:8])
+    root_mesh, dev_m, fb_m = run(mesh)
+    assert root_single == root_mesh == blocks[-1].header.root
+    assert (dev_s, fb_s) == (dev_m, fb_m) == (4, 0)
+
+
+def test_mesh_engine_rewind_on_failed_block():
+    """Mesh path exercises the rewind/re-apply/fallback recovery too:
+    block 1 is sequentially valid but fails the conservative device
+    check; the mesh engine must fall back and resume, landing on the
+    sequential root."""
+    from test_replay import CFG, KEYS, ADDRS, GWEI
+    from coreth_tpu.chain import Genesis, GenesisAccount, generate_chain
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.state import Database
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDRS[0]: GenesisAccount(balance=10**24),
+                             ADDRS[1]: GenesisAccount(balance=10**17),
+                             ADDRS[2]: GenesisAccount(balance=10**24)})
+    db0 = Database()
+    gblock = genesis.to_block(db0)
+    big = 5 * 10**23
+
+    def gen(i, bg):
+        if i == 1:
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=1, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDRS[1],
+                value=big), KEYS[0], CFG.chain_id))
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=0, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDRS[2],
+                value=big // 2), KEYS[1], CFG.chain_id))
+        else:
+            nonce = {0: 0, 2: 2}[i]
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonce, gas_tip_cap_=GWEI,
+                gas_fee_cap_=300 * GWEI, gas=21_000,
+                to=bytes([0x72 + i]) * 20, value=777),
+                KEYS[0], CFG.chain_id))
+
+    blocks, _ = generate_chain(CFG, gblock, db0, 3, gen, gap=2)
+    db = Database()
+    gb = genesis.to_block(db)
+    eng = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                       capacity=256, batch_pad=64, window=16,
+                       mesh=make_mesh(jax.devices("cpu")[:8]))
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 1
+    assert eng.stats.blocks_device == 2
+
+
 def test_sharded_recover_matches_single_device():
     """The ECDSA ladder shards the signature batch across the mesh and
     recovers the same addresses as the single-device kernel."""
