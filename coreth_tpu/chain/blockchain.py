@@ -11,9 +11,11 @@ same way.
 
 from __future__ import annotations
 
+import queue as _queue
+import threading as _threading
 import time as _time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from coreth_tpu.chain.genesis import Genesis
 from coreth_tpu.consensus.engine import ConsensusError, DummyEngine
@@ -88,7 +90,19 @@ class BlockChain:
             g.hash(): _Entry(g, status="accepted")}
         self._canonical: Dict[int, bytes] = {0: g.hash()}
         self.last_accepted: Block = g
-        self._preferred: Block = g
+        self._head: Block = g
+        # acceptor pipeline (blockchain.go:566-648): accept() returns
+        # after the cheap canonical bookkeeping; durable writes + trie
+        # flush run on this queue's worker thread, drained by
+        # drain_acceptor_queue()/close().  acceptor_tip is the last
+        # block whose accept-side effects have fully landed
+        # (LastAcceptedBlock vs LastConsensusAcceptedBlock).
+        self.acceptor_tip: Block = g
+        self._acceptor_queue: _queue.Queue = _queue.Queue()
+        self._acceptor_thread: Optional[_threading.Thread] = None
+        self._acceptor_error: Optional[BaseException] = None
+        self._head_subs: List[Callable[[Block], None]] = []
+        self._accepted_subs: List[Callable[[Block, list], None]] = []
         self.timers = PhaseTimers()
         if chain_kv is not None:
             self._load_last_state()
@@ -131,7 +145,8 @@ class BlockChain:
                 self.insert_block(block)
                 self._blocks[h].status = "accepted"
             self.last_accepted = block
-            self._preferred = block
+            self._head = block
+            self.acceptor_tip = block
         # canonical index below the flushed height stays on disk only;
         # get_block_by_number falls back to the store
 
@@ -146,7 +161,10 @@ class BlockChain:
             g.update(value)
 
     def close(self) -> None:
-        """Flush every pending trie node + the store (clean shutdown)."""
+        """Drain the acceptor, flush every pending trie node + the
+        store (clean shutdown; blockchain.go Stop)."""
+        self.drain_acceptor_queue()
+        self._stop_acceptor()
         if self.trie_writer is not None:
             self.trie_writer.force_flush(self.last_accepted.number,
                                          self.last_accepted.root)
@@ -155,7 +173,18 @@ class BlockChain:
 
     # ------------------------------------------------------------- accessors
     def current_block(self) -> Block:
-        return self._preferred
+        return self._head
+
+    def subscribe_chain_head(self, cb: Callable[[Block], None]) -> None:
+        """chainHeadFeed analog: cb(block) on every head change (the
+        txpool's reset driver, txpool.go:379)."""
+        self._head_subs.append(cb)
+
+    def subscribe_chain_accepted(self, cb) -> None:
+        """chainAcceptedFeed analog: cb(block, receipts) once a block's
+        accept-side effects have landed (fired on the acceptor thread,
+        blockchain.go:597)."""
+        self._accepted_subs.append(cb)
 
     def get_block(self, block_hash: bytes) -> Optional[Block]:
         entry = self._blocks.get(block_hash)
@@ -290,6 +319,12 @@ class BlockChain:
             r.block_hash = block.hash()
             r.transaction_index = i
         self._blocks[block.hash()] = _Entry(block, receipts)
+        # writeBlockAndSetHead (blockchain.go:1134): a block extending
+        # the current head optimistically becomes the new canonical
+        # tip; a competing sibling stays a side block until consensus
+        # prefers or accepts it (newTip check, :1127)
+        if block.parent_hash == self._head.hash():
+            self._write_head_block(block)
         self.timers.total += _time.monotonic() - t_start
         self.timers.blocks += 1
 
@@ -299,44 +334,155 @@ class BlockChain:
             self.accept(b.hash())
         return len(blocks)
 
+    # ----------------------------------------------------------- head/reorg
+    def _write_head_block(self, block: Block) -> None:
+        """writeHeadBlock + chainHeadFeed: extend the canonical index,
+        move head, notify subscribers (every head transition routes
+        through here — optimistic insert tip, preference, reorg)."""
+        self._canonical[block.number] = block.hash()
+        self._head = block
+        for cb in self._head_subs:
+            cb(block)
+
+    def _reorg(self, old_head: Block, new_head: Block) -> None:
+        """reorg (blockchain.go:1429): rewind the canonical index to
+        the branch of [new_head].  Refuses to orphan accepted blocks —
+        the common ancestor must be at or above last_accepted."""
+        new_chain: List[Block] = []
+        old_block, new_block = old_head, new_head
+        while new_block.number > old_block.number:
+            new_chain.append(new_block)
+            new_block = self._require_block(new_block.parent_hash)
+        while old_block.number > new_block.number:
+            old_block = self._require_block(old_block.parent_hash)
+        while old_block.hash() != new_block.hash():
+            new_chain.append(new_block)
+            old_block = self._require_block(old_block.parent_hash)
+            new_block = self._require_block(new_block.parent_hash)
+        if new_block.number < self.last_accepted.number:
+            raise BadBlockError(
+                f"cannot orphan finalized block at height "
+                f"{self.last_accepted.number} to common block at height "
+                f"{new_block.number}")
+        # canonical entries for the new branch (reverse order), then
+        # delete stale assignments above the new head (old branch
+        # longer than new)
+        for b in reversed(new_chain):
+            self._canonical[b.number] = b.hash()
+        n = new_head.number + 1
+        while self._canonical.pop(n, None) is not None:
+            n += 1
+        # _head itself moves in the caller's _write_head_block
+
+    def _require_block(self, block_hash: bytes) -> Block:
+        b = self.get_block(block_hash)
+        if b is None:
+            raise BadBlockError("missing block during reorg walk")
+        return b
+
+    def set_preference(self, block_hash: bytes) -> None:
+        """SetPreference (blockchain.go:980): move the head to an
+        already-inserted block, reorging the canonical index across
+        branches when necessary, and notify head subscribers."""
+        entry = self._blocks.get(block_hash)
+        if entry is None:
+            raise BadBlockError("preferring unknown block")
+        block = entry.block
+        if self._head.hash() == block_hash:
+            return
+        if block.parent_hash != self._head.hash():
+            self._reorg(self._head, block)
+        self._write_head_block(block)
+
     # -------------------------------------------------------- accept/reject
     def accept(self, block_hash: bytes) -> None:
-        """Accept (blockchain.go:1041): make canonical + durable."""
+        """Accept (blockchain.go:1041): pin finality + enqueue the
+        durable side effects on the acceptor."""
         entry = self._blocks.get(block_hash)
         if entry is None:
             raise BadBlockError("accepting unknown block")
+        # surface a pending acceptor failure BEFORE mutating finality
+        # state, so a failed accept leaves the chain untouched
+        if self._acceptor_error is not None:
+            raise self._acceptor_error
         block = entry.block
         if block.parent_hash != self.last_accepted.hash():
             raise BadBlockError(
                 "accepted block is not a child of the last accepted block")
+        # accepting a non-canonical sibling reorgs preference to it
+        # (blockchain.go:1059)
+        if self._canonical.get(block.number) != block_hash:
+            self.set_preference(block_hash)
         entry.status = "accepted"
-        self._canonical[block.number] = block_hash
-        # preference follows acceptance unless consensus moved it to a
-        # competing branch already (SetPreference is the external
-        # authority — insert never touches it, blockchain.go:980)
-        if self._preferred.hash() == block.parent_hash:
-            self._preferred = block
         self.last_accepted = block
+        self._add_acceptor_queue(entry)
+
+    def reject(self, block_hash: bytes) -> None:
+        """Reject (blockchain.go:1074): drop the block's data."""
+        entry = self._blocks.get(block_hash)
+        if entry is not None:
+            entry.status = "rejected"
+            entry.receipts = []
+
+    # -------------------------------------------------------- acceptor queue
+    def _add_acceptor_queue(self, entry: _Entry) -> None:
+        if self._acceptor_thread is None:
+            self._acceptor_thread = _threading.Thread(
+                target=self._acceptor_loop, name="chain-acceptor",
+                daemon=True)
+            self._acceptor_thread.start()
+        self._acceptor_queue.put(entry)
+
+    def _acceptor_loop(self) -> None:
+        """startAcceptor (blockchain.go:566): durable accepted-block
+        effects off the consensus thread."""
+        while True:
+            entry = self._acceptor_queue.get()
+            if entry is None:
+                self._acceptor_queue.task_done()
+                return
+            try:
+                # a prior failure is fatal (the reference log.Crits):
+                # drain later entries without side effects so the
+                # durable last-accepted pointer never outruns a
+                # partially-written predecessor
+                if self._acceptor_error is None:
+                    self._accept_side_effects(entry)
+                    self.acceptor_tip = entry.block
+            except BaseException as exc:  # surfaced on drain/close
+                self._acceptor_error = exc
+            finally:
+                self._acceptor_queue.task_done()
+
+    def _accept_side_effects(self, entry: _Entry) -> None:
+        block = entry.block
         if self.chain_kv is not None:
             from coreth_tpu.rawdb import schema
             schema.write_block(self.chain_kv, block)
             schema.write_canonical_hash(self.chain_kv, block.number,
-                                        block_hash)
+                                        block.hash())
             if entry.receipts is not None:
                 schema.write_receipts(self.chain_kv, block,
                                       entry.receipts)
-            schema.write_last_accepted(self.chain_kv, block_hash)
+            schema.write_last_accepted(self.chain_kv, block.hash())
             self.trie_writer.accept_trie(block.number, block.root)
             self.chain_kv.flush()
+        for cb in self._accepted_subs:
+            cb(block, entry.receipts)
 
-    def reject(self, block_hash: bytes) -> None:
-        """Reject (blockchain.go:1074)."""
-        entry = self._blocks.get(block_hash)
-        if entry is not None:
-            entry.status = "rejected"
+    def drain_acceptor_queue(self) -> None:
+        """DrainAcceptorQueue (blockchain.go:634): block until every
+        queued accept has fully landed; re-raise any acceptor error."""
+        if self._acceptor_thread is not None:
+            self._acceptor_queue.join()
+        if self._acceptor_error is not None:
+            # sticky: a failed accept is fatal for this chain instance
+            # (the reference log.Crits); every later drain/accept
+            # re-raises rather than resuming on inconsistent state
+            raise self._acceptor_error
 
-    def set_preference(self, block_hash: bytes) -> None:
-        entry = self._blocks.get(block_hash)
-        if entry is None:
-            raise BadBlockError("preferring unknown block")
-        self._preferred = entry.block
+    def _stop_acceptor(self) -> None:
+        if self._acceptor_thread is not None:
+            self._acceptor_queue.put(None)
+            self._acceptor_thread.join()
+            self._acceptor_thread = None

@@ -91,6 +91,11 @@ class VM:
             global_queue=self.config.tx_pool_global_queue))
         self.miner = Miner(genesis.config, self.chain, self.txpool,
                            engine=self.chain.engine, clock=self.clock)
+        # chainHeadEvent -> txpool reset (the reference's pool reset
+        # loop subscribes to head events, txpool.go:379): covers the
+        # optimistic insert tip, SetPreference, and cross-branch accept
+        self.chain.subscribe_chain_head(
+            lambda _b: self.txpool.reset())
         g = self.chain.genesis_block
         gb = PluginBlock(self, g)
         gb.status = Status.ACCEPTED
@@ -121,8 +126,6 @@ class VM:
         self._blocks[blk.id] = blk
 
     def _on_accept(self, blk: PluginBlock) -> None:
-        # drop included txs from the pool (txpool reset loop analog)
-        self.txpool.reset()
         if self.atomic_backend is not None:
             from coreth_tpu.atomic import decode_ext_data
             self.atomic_backend.accept(blk.id)
@@ -213,10 +216,6 @@ class VM:
         self._require_init()
         self.chain.set_preference(block_id)
         self.preferred_id = block_id
-        # re-anchor the pool on the new head (the reference resets the
-        # pool on head events; without this the miner would build from
-        # pending state computed against the old branch)
-        self.txpool.reset()
 
     def last_accepted(self) -> PluginBlock:
         self._require_init()

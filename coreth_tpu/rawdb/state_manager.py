@@ -47,19 +47,29 @@ class PersistentNodeDict(dict):
         return self.get(key) is not None
 
     def __setitem__(self, key, value):
-        if not dict.__contains__(self, key):
-            self.pending.append(key)
+        is_new = not dict.__contains__(self, key)
+        # value before pending: a concurrent flush that pops the key
+        # must always see the value (nodes are never deleted, so a
+        # popped key with a visible value cannot be lost)
         dict.__setitem__(self, key, value)
+        if is_new:
+            self.pending.append(key)
 
     def flush(self) -> int:
-        """Write pending nodes to the store; returns the count."""
+        """Write pending nodes to the store; returns the count.
+        Pop-based so the acceptor thread can flush while the insert
+        thread keeps appending (each pop is GIL-atomic; a key appended
+        mid-flush is either written now or stays pending)."""
         n = 0
-        for key in self.pending:
+        while self.pending:
+            try:
+                key = self.pending.pop()
+            except IndexError:
+                break
             v = dict.get(self, key)
             if v is not None:
                 self.kv.put(self.PREFIX + key, v)
                 n += 1
-        self.pending = []
         return n
 
 

@@ -199,3 +199,140 @@ def test_sibling_blocks_accept_one():
     assert chain.last_accepted.hash() == blocks_b[0].hash()
     state = chain.state_at(blocks_b[0].root)
     assert state.get_balance(ADDR2) == 222
+
+
+# -------------------------------------------------- preference/reorg
+# Shapes of core/test_blockchain.go TestSetPreferenceRewind:531 and
+# TestAcceptNonCanonicalBlock:422 against the acceptor-queue chain.
+
+def _fork(config, n_blocks, value, gap):
+    """A branch of [n_blocks] from genesis, distinguished by the tx
+    value + block gap so sibling branches hash differently."""
+    genesis = make_genesis(config)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonce = [0]
+
+    def gen(i, bg):
+        bg.add_tx(sign_tx(DynamicFeeTx(
+            chain_id_=config.chain_id, nonce=nonce[0], gas_tip_cap_=GWEI,
+            gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDR2, value=value,
+        ), KEY1, config.chain_id))
+        nonce[0] += 1
+
+    blocks, _ = generate_chain(config, gblock, db, n_blocks, gen, gap=gap)
+    return blocks
+
+
+def test_insert_extends_canonical_head():
+    config = TEST_CHAIN_CONFIG
+    blocks = _fork(config, 3, 111, 2)
+    chain = BlockChain(make_genesis(config))
+    for b in blocks:
+        chain.insert_block(b)
+    # canonical index optimistically follows the inserted tip
+    # (writeBlockAndSetHead) even before any accept
+    assert chain.current_block().hash() == blocks[-1].hash()
+    for b in blocks:
+        assert chain.get_block_by_number(b.number).hash() == b.hash()
+
+
+def test_set_preference_rewind():
+    """TestSetPreferenceRewind shape: prefer a sibling at height 1
+    after inserting a 3-block branch; the canonical index rewinds."""
+    config = TEST_CHAIN_CONFIG
+    branch_a = _fork(config, 3, 111, 2)
+    branch_b = _fork(config, 1, 222, 3)
+    chain = BlockChain(make_genesis(config))
+    for b in branch_a:
+        chain.insert_block(b)
+    chain.insert_block(branch_b[0])  # side block, head unchanged
+    assert chain.current_block().hash() == branch_a[-1].hash()
+
+    chain.set_preference(branch_b[0].hash())
+    assert chain.current_block().hash() == branch_b[0].hash()
+    assert chain.get_block_by_number(1).hash() == branch_b[0].hash()
+    # stale canonical assignments above the new head are deleted
+    assert chain.get_block_by_number(2) is None
+    assert chain.get_block_by_number(3) is None
+
+    # move preference back across the fork: full branch re-canonicalized
+    chain.set_preference(branch_a[2].hash())
+    assert chain.current_block().hash() == branch_a[2].hash()
+    for b in branch_a:
+        assert chain.get_block_by_number(b.number).hash() == b.hash()
+
+
+def test_accept_non_canonical_block():
+    """TestAcceptNonCanonicalBlock shape: accepting a side block
+    reorgs preference to it."""
+    config = TEST_CHAIN_CONFIG
+    branch_a = _fork(config, 2, 111, 2)
+    branch_b = _fork(config, 1, 222, 3)
+    chain = BlockChain(make_genesis(config))
+    for b in branch_a:
+        chain.insert_block(b)
+    chain.insert_block(branch_b[0])
+    chain.accept(branch_b[0].hash())
+    chain.reject(branch_a[0].hash())
+    chain.reject(branch_a[1].hash())
+    chain.drain_acceptor_queue()
+    assert chain.last_accepted.hash() == branch_b[0].hash()
+    assert chain.acceptor_tip.hash() == branch_b[0].hash()
+    assert chain.current_block().hash() == branch_b[0].hash()
+    assert chain.get_block_by_number(1).hash() == branch_b[0].hash()
+    assert chain.get_block_by_number(2) is None
+    state = chain.state_at(chain.last_accepted.root)
+    assert state.get_balance(ADDR2) == 222
+
+
+def test_reorg_cannot_orphan_accepted_block():
+    config = TEST_CHAIN_CONFIG
+    branch_a = _fork(config, 2, 111, 2)
+    branch_b = _fork(config, 1, 222, 3)
+    chain = BlockChain(make_genesis(config))
+    chain.insert_block(branch_a[0])
+    chain.accept(branch_a[0].hash())
+    chain.insert_block(branch_b[0])
+    with pytest.raises(BadBlockError, match="orphan finalized"):
+        chain.set_preference(branch_b[0].hash())
+    chain.drain_acceptor_queue()
+
+
+def test_head_event_drives_txpool_reset_hook():
+    """chainHeadFeed analog: subscribers fire on preference changes."""
+    config = TEST_CHAIN_CONFIG
+    branch_a = _fork(config, 1, 111, 2)
+    branch_b = _fork(config, 1, 222, 3)
+    chain = BlockChain(make_genesis(config))
+    heads = []
+    chain.subscribe_chain_head(lambda b: heads.append(b.hash()))
+    chain.insert_block(branch_a[0])   # optimistic tip -> head event
+    chain.insert_block(branch_b[0])   # side block -> no event
+    chain.set_preference(branch_b[0].hash())
+    assert heads == [branch_a[0].hash(), branch_b[0].hash()]
+
+
+def test_reorg_reopen_consistency(tmp_path):
+    """checkBlockChainState shape (test_blockchain.go:106): after a
+    cross-branch accept, reopening the DB shows the accepted branch."""
+    from coreth_tpu.rawdb import FileDB
+    config = TEST_CHAIN_CONFIG
+    branch_a = _fork(config, 2, 111, 2)
+    branch_b = _fork(config, 1, 222, 3)
+    path = str(tmp_path / "chain.log")
+    chain = BlockChain(make_genesis(config), chain_kv=FileDB(path),
+                       commit_interval=1)
+    for b in branch_a:
+        chain.insert_block(b)
+    chain.insert_block(branch_b[0])
+    chain.accept(branch_b[0].hash())
+    chain.close()
+
+    chain2 = BlockChain(make_genesis(config), chain_kv=FileDB(path),
+                        commit_interval=1)
+    assert chain2.last_accepted.hash() == branch_b[0].hash()
+    assert chain2.get_block_by_number(1).hash() == branch_b[0].hash()
+    state = chain2.state_at(chain2.last_accepted.root)
+    assert state.get_balance(ADDR2) == 222
+    chain2.close()

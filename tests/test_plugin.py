@@ -146,7 +146,10 @@ def test_vm_reject_sibling():
     vm = genesis_vm(clock)
     vm.issue_tx(make_tx(0))
     a = vm.build_block()
-    # competing sibling: same height, different coinbase extra tx mix
+    # competing sibling: consensus moves preference back to the parent
+    # (the inserted block optimistically became head,
+    # writeBlockAndSetHead) so the next build forks at the same height
+    vm.set_preference(vm.last_accepted().id)
     vm.issue_tx(make_tx(0, key=KEY2))
     b = vm.build_block()
     assert a.id != b.id

@@ -142,10 +142,18 @@ def test_chain_reopen_crash_reexecutes_tail(tmp_path):
     blocks = _build_blocks(genesis, 6)
     path = str(tmp_path / "chain.log")
     chain = BlockChain(genesis, chain_kv=FileDB(path), commit_interval=4)
-    chain.insert_chain(blocks)
+    # drain between the interval boundary and the tail: the height-4
+    # flush runs on the acceptor thread and would otherwise race past
+    # the tail blocks' inserts, sweeping their nodes early (harmless
+    # write-ahead, but this test needs a deterministic unflushed tail)
+    chain.insert_chain(blocks[:4])
+    chain.drain_acceptor_queue()
+    chain.insert_chain(blocks[4:])
     tip_root = chain.last_accepted.root
-    # crash: flush the KV file itself (block/receipt writes are
-    # write-through) but drop the chain with pending trie nodes unflushed
+    # crash: drain the acceptor (its block/receipt writes have landed)
+    # and flush the KV file itself, but drop the chain with pending
+    # trie nodes unflushed
+    chain.drain_acceptor_queue()
     assert chain.db.node_db.pending, "test needs an unflushed tail"
     chain.chain_kv.flush()
     del chain
@@ -167,6 +175,7 @@ def test_chain_reopen_archive_mode(tmp_path):
     path = str(tmp_path / "chain.log")
     chain = BlockChain(genesis, chain_kv=FileDB(path), archive=True)
     chain.insert_chain(blocks)
+    chain.drain_acceptor_queue()
     assert not chain.db.node_db.pending  # everything flushed per accept
     chain.chain_kv.flush()
     del chain
