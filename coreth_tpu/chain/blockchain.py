@@ -58,7 +58,8 @@ class BlockChain:
     def __init__(self, genesis: Genesis, db: Optional[Database] = None,
                  engine: Optional[DummyEngine] = None,
                  chain_kv=None, commit_interval: int = 4096,
-                 archive: bool = False):
+                 archive: bool = False, snapshots: bool = True,
+                 prefetch: bool = False):
         """chain_kv: optional rawdb.KVStore making the chain durable —
         accepted blocks/receipts/canonical index persist immediately,
         trie nodes every `commit_interval` accepts (state_manager.go
@@ -104,8 +105,27 @@ class BlockChain:
         self._head_subs: List[Callable[[Block], None]] = []
         self._accepted_subs: List[Callable[[Block, list], None]] = []
         self.timers = PhaseTimers()
+        # flat-state snapshot tree (core/state/snapshot): one diff
+        # layer per processed block over a disk layer at the accepted
+        # base; StateDB reads go through it, bypassing trie traversal
+        self.snaps = None
+        self._want_snapshots = snapshots
+        # one persistent path-warming worker per chain (KV-backed only;
+        # measured OFF by default on the 1-core eval host, where the
+        # memory-indexed node store leaves no latency to hide and the
+        # GIL makes the warm thread pure contention — BASELINE.md)
+        self._prefetcher = None
+        if prefetch and chain_kv is not None:
+            from coreth_tpu.state.trie_prefetcher import TriePrefetcher
+            self._prefetcher = TriePrefetcher(self.db.node_db)
         if chain_kv is not None:
+            # _load_last_state seeds the snapshot at the on-disk base
+            # (genesis only for a fresh store), so it is not generated
+            # twice on reopen
             self._load_last_state()
+        elif snapshots:
+            from coreth_tpu.state.snapshot import generate_from_trie
+            self.snaps = generate_from_trie(self.db, g.root, g.hash())
 
     # ---------------------------------------------------------- durability
     def _load_last_state(self) -> None:
@@ -113,6 +133,7 @@ class BlockChain:
         resume at the persisted last-accepted block, re-executing any
         accepted tail whose trie state never reached disk."""
         from coreth_tpu.rawdb import schema
+        from coreth_tpu.state.snapshot import generate_from_trie
         g = self.genesis_block
         if schema.read_last_accepted(self.chain_kv) is None:
             # fresh database: persist genesis + its state
@@ -120,13 +141,27 @@ class BlockChain:
             schema.write_canonical_hash(self.chain_kv, 0, g.hash())
             schema.write_last_accepted(self.chain_kv, g.hash())
             self.trie_writer.force_flush(0, g.root)
+            if self._want_snapshots:
+                self.snaps = generate_from_trie(self.db, g.root,
+                                                g.hash())
             return
         last_hash = schema.read_last_accepted(self.chain_kv)
         last = schema.read_block_by_hash(self.chain_kv, last_hash)
         if last is None:
             raise BadBlockError("missing last accepted block body")
-        _, flushed_height = schema.read_last_flushed_root(self.chain_kv)
+        flushed_root, flushed_height = \
+            schema.read_last_flushed_root(self.chain_kv)
         flushed_height = flushed_height or 0
+        if self._want_snapshots:
+            # rebuild the flat state at the on-disk base (snapshot
+            # Rebuild, snapshot.go:745); tail re-execution below adds
+            # diff layers on top through insert_block
+            base_root = flushed_root if flushed_root is not None \
+                else g.root
+            base_hash = schema.read_canonical_hash(
+                self.chain_kv, flushed_height) or g.hash()
+            self.snaps = generate_from_trie(self.db, base_root,
+                                            base_hash)
         # walk the canonical chain from the last flushed state forward,
         # re-executing into memory (insert_block reads parent state
         # through the disk-backed node dict)
@@ -162,14 +197,25 @@ class BlockChain:
 
     def close(self) -> None:
         """Drain the acceptor, flush every pending trie node + the
-        store (clean shutdown; blockchain.go Stop)."""
-        self.drain_acceptor_queue()
+        store (clean shutdown; blockchain.go Stop).  A sticky acceptor
+        error is re-raised AFTER threads are stopped and the store is
+        closed, so shutdown never leaks handles or workers."""
+        if self._acceptor_thread is not None:
+            self._acceptor_queue.join()
         self._stop_acceptor()
-        if self.trie_writer is not None:
-            self.trie_writer.force_flush(self.last_accepted.number,
-                                         self.last_accepted.root)
-        if self.chain_kv is not None:
-            self.chain_kv.close()
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        err = self._acceptor_error
+        try:
+            if err is None and self.trie_writer is not None:
+                self.trie_writer.force_flush(self.last_accepted.number,
+                                             self.last_accepted.root)
+        finally:
+            if self.chain_kv is not None:
+                self.chain_kv.close()
+        if err is not None:
+            raise err
 
     # ------------------------------------------------------------- accessors
     def current_block(self) -> Block:
@@ -303,17 +349,48 @@ class BlockChain:
         for tx in block.transactions:
             signer.sender(tx)
         self.timers.sender_recover += _time.monotonic() - t0
-        statedb = StateDB(parent.root, self.db)
+        # read through the parent block's flat-state layer when one is
+        # live (statedb.go:147 New with snaps); the trie stays
+        # authoritative for hashing.  A missing layer (parent flattened
+        # away under a sibling) degrades to trie reads.
+        snap_layer = (self.snaps.snapshot(block.parent_hash)
+                      if self.snaps is not None else None)
+        statedb = StateDB(parent.root, self.db, snap=snap_layer)
+        if self._prefetcher is not None:
+            # StartPrefetcher (blockchain.go:1319): warm KV-resident
+            # trie paths concurrently with execution so the hashing
+            # phase hits the in-memory node cache.  Pointless without
+            # a KV store — then every node is already in memory.
+            statedb.prefetcher = self._prefetcher
         t0 = _time.monotonic()
         receipts, logs, used_gas = self.processor.process(
             block, parent.header, statedb,
             get_hash=self._ancestry_hash_fn(parent))
         self.timers.execution += _time.monotonic() - t0
+        if statedb.prefetcher is not None:
+            # drain before hashing (StopPrefetcher role); the hash
+            # phase below reads the now-warm node cache
+            statedb.prefetcher = None
+            self._prefetcher.drain()
         t0 = _time.monotonic()
         self._validate_state(block, statedb, receipts, used_gas)
         self.timers.validation += _time.monotonic() - t0
         t0 = _time.monotonic()
         statedb.commit(delete_empty_objects=True)
+        if snap_layer is not None:
+            # new diff layer for this block (snaps.Update at
+            # writeBlockWithState, blockchain.go:1384)
+            from coreth_tpu.state.snapshot import (SnapshotError,
+                                                   diff_from_statedb)
+            accounts, storage, destructs = diff_from_statedb(statedb)
+            try:
+                self.snaps.update(block.hash(), block.parent_hash,
+                                  block.root, accounts, storage,
+                                  destructs)
+            except SnapshotError:
+                # parent layer flattened past by the acceptor while
+                # this block executed: reads just degrade to the trie
+                pass
         self.timers.write += _time.monotonic() - t0
         for i, r in enumerate(receipts):
             r.block_hash = block.hash()
@@ -415,6 +492,16 @@ class BlockChain:
             self.set_preference(block_hash)
         entry.status = "accepted"
         self.last_accepted = block
+        # flatten synchronously: the disk layer is merged in place, and
+        # insert_block (same thread) reads through it — running this on
+        # the acceptor thread would let a concurrent sibling insert see
+        # a half-merged base (the reference swaps in a fresh disk layer
+        # instead, snapshot.go diffToDisk; in-place + same-thread is
+        # our equivalent since the merge is dict-cheap)
+        if self.snaps is not None \
+                and self.snaps.snapshot(block_hash) is not None \
+                and self.snaps.disk_block != block_hash:
+            self.snaps.flatten(block_hash)
         self._add_acceptor_queue(entry)
 
     def reject(self, block_hash: bytes) -> None:
@@ -423,6 +510,8 @@ class BlockChain:
         if entry is not None:
             entry.status = "rejected"
             entry.receipts = []
+        if self.snaps is not None:
+            self.snaps.discard(block_hash)
 
     # -------------------------------------------------------- acceptor queue
     def _add_acceptor_queue(self, entry: _Entry) -> None:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import Dict, Iterator, Optional, Tuple
 
 _TOMB = 0xFFFFFFFF
@@ -70,6 +71,10 @@ class FileDB(KVStore):
         self.path = path
         self._index: Dict[bytes, bytes] = {}
         self._garbage = 0
+        # put() frames a record as three file writes; the chain's
+        # acceptor thread and the insert thread (write-through code
+        # dict) both write, so framing must be atomic per record
+        self._wlock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._recover()
         self._f = open(path, "ab")
@@ -106,42 +111,47 @@ class FileDB(KVStore):
         return self._index.get(key)
 
     def put(self, key, value):
-        if key in self._index:
-            self._garbage += 1
-        self._index[key] = bytes(value)
-        self._f.write(_HDR.pack(len(key), len(value)))
-        self._f.write(key)
-        self._f.write(value)
+        with self._wlock:
+            if key in self._index:
+                self._garbage += 1
+            self._index[key] = bytes(value)
+            self._f.write(_HDR.pack(len(key), len(value)))
+            self._f.write(key)
+            self._f.write(value)
 
     def delete(self, key):
-        if self._index.pop(key, None) is None:
-            return
-        self._garbage += 1
-        self._f.write(_HDR.pack(len(key), _TOMB))
-        self._f.write(key)
+        with self._wlock:
+            if self._index.pop(key, None) is None:
+                return
+            self._garbage += 1
+            self._f.write(_HDR.pack(len(key), _TOMB))
+            self._f.write(key)
 
     def items(self):
         return iter(list(self._index.items()))
 
     def flush(self):
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        with self._wlock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
 
     def close(self):
         self.flush()
-        self._f.close()
+        with self._wlock:
+            self._f.close()
 
     def compact(self) -> None:
         """Rewrite only the live set (freezer-lite)."""
-        tmp = self.path + ".compact"
-        with open(tmp, "wb") as f:
-            for k, v in self._index.items():
-                f.write(_HDR.pack(len(k), len(v)))
-                f.write(k)
-                f.write(v)
-            f.flush()
-            os.fsync(f.fileno())
-        self._f.close()
-        os.replace(tmp, self.path)
-        self._garbage = 0
-        self._f = open(self.path, "ab")
+        with self._wlock:
+            tmp = self.path + ".compact"
+            with open(tmp, "wb") as f:
+                for k, v in self._index.items():
+                    f.write(_HDR.pack(len(k), len(v)))
+                    f.write(k)
+                    f.write(v)
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._garbage = 0
+            self._f = open(self.path, "ab")

@@ -16,6 +16,7 @@ and the StateDB read path can consult the snapshot before the trie.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from coreth_tpu.crypto import keccak256
@@ -100,6 +101,10 @@ class Tree:
         self.disk = DiskLayer(base_root)
         self.disk_block = genesis_hash
         self.layers: Dict[bytes, DiffLayer] = {}
+        # update() runs on the chain's insert thread while flatten()
+        # runs on its acceptor thread (blockchain.go guards the same
+        # pair with snapTree's lock)
+        self._lock = threading.Lock()
 
     # ------------------------------------------------------------- lookup
     def snapshot(self, block_hash: bytes):
@@ -117,71 +122,82 @@ class Tree:
         """New diff layer for a processed block (snapshot.go:326);
         values of DELETED mark removals; `destructs` carries accounts
         destroyed during the block even if re-created afterwards."""
-        parent = self.snapshot(parent_hash)
-        if parent is None:
-            raise SnapshotError(
-                f"parent snapshot {parent_hash.hex()} missing")
-        if block_hash in self.layers:
-            raise SnapshotError("duplicate snapshot layer")
-        self.layers[block_hash] = DiffLayer(
-            parent, block_hash, root, dict(accounts), dict(storage),
-            destructs)
+        with self._lock:
+            parent = self.snapshot(parent_hash)
+            if parent is None:
+                raise SnapshotError(
+                    f"parent snapshot {parent_hash.hex()} missing")
+            if block_hash in self.layers:
+                raise SnapshotError("duplicate snapshot layer")
+            self.layers[block_hash] = DiffLayer(
+                parent, block_hash, root, dict(accounts), dict(storage),
+                destructs)
+
+    # ------------------------------------------------------------ discard
+    def discard(self, block_hash: bytes) -> None:
+        """Drop a rejected block's diff layer (snapshot.go Discard).
+        Descendant layers keep their parent references and die with
+        their own rejections."""
+        with self._lock:
+            self.layers.pop(block_hash, None)
 
     # ------------------------------------------------------------ flatten
     def flatten(self, block_hash: bytes) -> None:
         """Consensus accepted `block_hash`: merge its (now unique) diff
         chain into the disk layer and drop rejected siblings
         (snapshot.go:400 Flatten — blockHash-keyed)."""
-        layer = self.layers.get(block_hash)
-        if layer is None:
-            raise SnapshotError(f"no layer for {block_hash.hex()}")
-        # collect the chain disk..block
-        chain: List[DiffLayer] = []
-        node = layer
-        while isinstance(node, DiffLayer):
-            chain.append(node)
-            node = node.parent
-        for diff in reversed(chain):
-            for ah in diff.destructs:
-                self.disk.storage.pop(ah, None)
-            for ah, v in diff.accounts.items():
-                if v == DELETED:
-                    self.disk.accounts.pop(ah, None)
-                    self.disk.storage.pop(ah, None)
-                else:
-                    self.disk.accounts[ah] = v
-            for (ah, sh), v in diff.storage.items():
-                if v == DELETED:
-                    sub = self.disk.storage.get(ah)
-                    if sub is not None:
-                        sub.pop(sh, None)
-                else:
-                    self.disk.storage.setdefault(ah, {})[sh] = v
-        self.disk.root = layer.root
-        self.disk_block = block_hash
-        # drop every layer at or below the accepted height band whose
-        # ancestry does not include the accepted block (rejected
-        # siblings), and re-parent direct children onto the disk layer
-        dead = set(d.block_hash for d in chain)
-        survivors: Dict[bytes, DiffLayer] = {}
-        for bh, l in self.layers.items():
-            if bh in dead:
-                continue
-            # walk ancestry: keep only layers descending from the
-            # accepted block
-            node = l
-            descends = False
+        with self._lock:
+            layer = self.layers.get(block_hash)
+            if layer is None:
+                raise SnapshotError(f"no layer for {block_hash.hex()}")
+            # collect the chain disk..block
+            chain: List[DiffLayer] = []
+            node = layer
             while isinstance(node, DiffLayer):
-                if node.block_hash == block_hash:
-                    descends = True
-                    break
+                chain.append(node)
                 node = node.parent
-            if descends:
+            for diff in reversed(chain):
+                for ah in diff.destructs:
+                    self.disk.storage.pop(ah, None)
+                for ah, v in diff.accounts.items():
+                    if v == DELETED:
+                        self.disk.accounts.pop(ah, None)
+                        self.disk.storage.pop(ah, None)
+                    else:
+                        self.disk.accounts[ah] = v
+                for (ah, sh), v in diff.storage.items():
+                    if v == DELETED:
+                        sub = self.disk.storage.get(ah)
+                        if sub is not None:
+                            sub.pop(sh, None)
+                    else:
+                        self.disk.storage.setdefault(ah, {})[sh] = v
+            self.disk.root = layer.root
+            self.disk_block = block_hash
+            # drop every layer whose ancestry does not include the
+            # accepted block (rejected siblings).  Two passes: classify
+            # everything BEFORE re-parenting, because re-parenting a
+            # direct child onto the disk layer would cut grandchildren
+            # off from the ancestry walk mid-iteration.
+            dead = set(d.block_hash for d in chain)
+            survivors: Dict[bytes, DiffLayer] = {}
+            for bh, l in self.layers.items():
+                if bh in dead:
+                    continue
+                node = l
+                descends = False
+                while isinstance(node, DiffLayer):
+                    if node.block_hash == block_hash:
+                        descends = True
+                        break
+                    node = node.parent
+                if descends:
+                    survivors[bh] = l
+            for l in survivors.values():
                 if isinstance(l.parent, DiffLayer) \
                         and l.parent.block_hash == block_hash:
                     l.parent = self.disk
-                survivors[bh] = l
-        self.layers = survivors
+            self.layers = survivors
 
 
 # ----------------------------------------------------------- generation
@@ -210,20 +226,24 @@ def generate_from_trie(db, state_root: bytes,
 def diff_from_statedb(statedb):
     """Extract a processed block's (accounts, storage, destructs) delta
     in snapshot key space from a finalised+hashed StateDB (the Update
-    feed at blockchain.go writeBlockWithState).  destructs carries
-    every account destroyed during the block — including destruct+
-    re-create sequences, whose pre-destruct storage must be masked."""
+    feed at blockchain.go writeBlockWithState).  Only mutated accounts
+    (statedb._mutated) and actually-written slots (written_storage)
+    enter the diff — origin_storage also caches pure reads, which must
+    not bloat every layer.  destructs carries every account destroyed
+    during the block — including destruct+re-create sequences, whose
+    pre-destruct storage must be masked."""
     accounts: Dict[bytes, bytes] = {}
     storage: Dict[Tuple[bytes, bytes], bytes] = {}
     destructs = {keccak256(a) for a in getattr(statedb, "_destructed",
                                                ())}
-    for addr, obj in statedb._objects.items():
+    for addr in statedb._mutated:
+        obj = statedb._objects.get(addr)
         ah = keccak256(addr)
-        if obj.deleted or obj.suicided:
+        if obj is None or obj.deleted or obj.suicided:
             accounts[ah] = DELETED
             continue
         accounts[ah] = obj.account.rlp()
-        for key, value in obj.origin_storage.items():
+        for key, value in obj.written_storage.items():
             sh = keccak256(key)
             if value == b"\x00" * 32:
                 storage[(ah, sh)] = DELETED

@@ -54,8 +54,9 @@ def normalize_state_key(key: bytes) -> bytes:
 
 class StateObject:
     __slots__ = ("address", "account", "code", "origin_storage",
-                 "dirty_storage", "pending_storage", "suicided", "deleted",
-                 "dirty_code", "fresh", "initial_root")
+                 "dirty_storage", "pending_storage", "written_storage",
+                 "suicided", "deleted", "dirty_code", "fresh",
+                 "initial_root")
 
     def __init__(self, address: bytes, account: StateAccount,
                  fresh: bool) -> None:
@@ -68,6 +69,9 @@ class StateObject:
         self.dirty_storage: Dict[bytes, bytes] = {}
         # finalised writes from earlier txs in this block
         self.pending_storage: Dict[bytes, bytes] = {}
+        # every slot actually written over the object's lifetime (the
+        # snapshot diff feed — origin_storage also caches pure reads)
+        self.written_storage: Dict[bytes, bytes] = {}
         self.suicided = False
         self.deleted = False
         self.dirty_code = False
@@ -90,10 +94,16 @@ class StateDB:
         self.db = db if db is not None else Database()
         self.original_root = root
         self.snap = snap
+        # optional TriePrefetcher warming paths during execution
+        # (StartPrefetcher, blockchain.go:1319)
+        self.prefetcher = None
         self._trie = self.db.open_trie(root)
         self._objects: Dict[bytes, StateObject] = {}
         self._destructed: Set[bytes] = set()
         self._pending: Set[bytes] = set()
+        # addresses that ever went dirty (survives commit clearing
+        # _pending — the snapshot diff feed)
+        self._mutated: Set[bytes] = set()
         self._journal: List = []  # (undo_fn, dirty_addr | None)
         self._dirty_counts: Dict[bytes, int] = {}
         self.refund = 0
@@ -534,6 +544,16 @@ class StateDB:
                 obj.pending_storage.update(obj.dirty_storage)
                 obj.dirty_storage = {}
             self._pending.add(addr)
+            self._mutated.add(addr)
+            if self.prefetcher is not None:
+                # warm the paths intermediate_root will rewrite
+                # (statedb.go Finalise -> prefetcher.prefetch)
+                self.prefetcher.prefetch(self.original_root,
+                                         [keccak256(addr)])
+                if obj.pending_storage and not obj.fresh:
+                    self.prefetcher.prefetch(
+                        obj.initial_root,
+                        [keccak256(k) for k in obj.pending_storage])
         self._journal = []
         self._dirty_counts = {}
         self.refund = 0
@@ -556,6 +576,7 @@ class StateDB:
                     else:
                         trie.update(key, rlp.encode(value.lstrip(b"\x00")))
                     obj.origin_storage[key] = value
+                    obj.written_storage[key] = value
                 obj.pending_storage = {}
                 obj.account.root = trie.hash()
             self._trie.update(addr, obj.account.rlp())
@@ -600,12 +621,14 @@ class StateDB:
             cp.origin_storage = dict(obj.origin_storage)
             cp.dirty_storage = dict(obj.dirty_storage)
             cp.pending_storage = dict(obj.pending_storage)
+            cp.written_storage = dict(obj.written_storage)
             cp.suicided = obj.suicided
             cp.deleted = obj.deleted
             cp.dirty_code = obj.dirty_code
             cp.initial_root = obj.initial_root
             new._objects[addr] = cp
         new._destructed = set(self._destructed)
+        new._mutated = set(self._mutated)
         new._pending = set(self._pending)
         new.refund = self.refund
         new.logs = [Log(l.address, list(l.topics), l.data, l.block_number,

@@ -336,3 +336,48 @@ def test_reorg_reopen_consistency(tmp_path):
     state = chain2.state_at(chain2.last_accepted.root)
     assert state.get_balance(ADDR2) == 222
     chain2.close()
+
+
+def test_snapshot_layers_follow_sibling_acceptance():
+    """Pinned: the flat-state tree tracks competing siblings and the
+    disk layer reflects only the accepted branch after flatten."""
+    from coreth_tpu.crypto import keccak256
+    config = TEST_CHAIN_CONFIG
+    branch_a = _fork(config, 1, 111, 2)
+    branch_b = _fork(config, 1, 222, 3)
+    chain = BlockChain(make_genesis(config))
+    assert chain.snaps is not None
+    chain.insert_block(branch_a[0])
+    chain.insert_block(branch_b[0])
+    # both siblings carry live diff layers over the genesis disk layer
+    la = chain.snaps.snapshot(branch_a[0].hash())
+    lb = chain.snaps.snapshot(branch_b[0].hash())
+    assert la is not None and lb is not None
+    from coreth_tpu.types import StateAccount
+    bal_a = StateAccount.from_rlp(la.account(keccak256(ADDR2))).balance
+    bal_b = StateAccount.from_rlp(lb.account(keccak256(ADDR2))).balance
+    assert (bal_a, bal_b) == (111, 222)
+
+    chain.accept(branch_b[0].hash())
+    chain.reject(branch_a[0].hash())
+    chain.drain_acceptor_queue()
+    # flattened: disk layer is branch B's state, sibling layer dropped
+    assert chain.snaps.disk_block == branch_b[0].hash()
+    disk_bal = StateAccount.from_rlp(
+        chain.snaps.disk.account(keccak256(ADDR2))).balance
+    assert disk_bal == 222
+    assert chain.snaps.snapshot(branch_a[0].hash()) is None
+
+
+def test_chain_inserts_read_through_snapshot():
+    """The execution read path consults the snapshot, not the trie:
+    poisoning the flat state changes the replayed balance check."""
+    config = TEST_CHAIN_CONFIG
+    genesis, blocks, _ = transfer_chain(config, 2, 2)
+    chain = BlockChain(genesis)
+    chain.insert_block(blocks[0])
+    # the processed block's diff layer exists and holds the sender
+    from coreth_tpu.crypto import keccak256
+    layer = chain.snaps.snapshot(blocks[0].hash())
+    assert layer is not None
+    assert layer.account(keccak256(ADDR1)) is not None
