@@ -40,6 +40,8 @@ class BlockGen:
         self.signer = LatestSigner(config.chain_id)
         self._used_gas = [0]
         self._evm: Optional[EVM] = None
+        from coreth_tpu.warp.predicate import PredicateResults
+        self.predicate_results = PredicateResults()
 
     def set_coinbase(self, addr: bytes) -> None:
         self.header.coinbase = addr
@@ -55,8 +57,15 @@ class BlockGen:
         """AddTx (chain_makers.go:103): applies immediately; panics
         (raises) if the tx is invalid."""
         if self._evm is None:
-            ctx = new_block_context(self.header)
+            ctx = new_block_context(
+                self.header, predicate_results=self.predicate_results)
             self._evm = EVM(ctx, TxContext(), self.statedb, self.config)
+        from coreth_tpu.warp.predicate import check_tx_predicates
+        # rules resolved at add time: set_timestamp() may have moved
+        # the block across a fork/activation boundary since __init__
+        rules = self.config.rules(self.header.number, self.header.time)
+        for addr, bits in check_tx_predicates(rules, tx).items():
+            self.predicate_results.set_result(len(self.txs), addr, bits)
         msg = tx_to_message(tx, self.signer, self.header.base_fee)
         self.statedb.set_tx_context(tx.hash(), len(self.txs))
         receipt = apply_transaction(
@@ -115,6 +124,10 @@ def generate_chain(config: ChainConfig, parent: Block, db: Database,
         if gen is not None:
             gen(i, bg)
         bg.header.gas_used = bg.used_gas
+        if config.is_durango(bg.header.time):
+            # results bytes follow the fee window (worker.go:333-337)
+            bg.header.extra = bg.header.extra \
+                + bg.predicate_results.encode()
         block = engine.finalize_and_assemble(
             config, bg.header, parent.header, statedb, bg.txs, [],
             bg.receipts)

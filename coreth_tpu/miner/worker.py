@@ -67,9 +67,13 @@ class Worker:
         statedb = self.chain.state_at(parent.root)
         apply_upgrades(self.config, parent.time, Block(header), statedb)
         txs = self.txpool.txs_by_price_and_nonce(header.base_fee)
-        receipts, included, used = self._commit_transactions(
+        receipts, included, used, results = self._commit_transactions(
             header, statedb, txs)
         header.gas_used = used
+        if self.config.is_durango(timestamp):
+            # predicate results ride the header Extra after the fee
+            # window (worker.go:333-337)
+            header.extra = header.extra + results.encode()
         block = self.engine.finalize_and_assemble(
             self.config, header, parent.header, statedb, included, [],
             receipts)
@@ -80,36 +84,50 @@ class Worker:
         return block
 
     def _commit_transactions(self, header: Header, statedb, txs):
-        """commitTransactions (worker.go:274)."""
+        """commitTransactions (worker.go:274).  Predicate results are
+        checked per tx BEFORE execution and dropped again when the tx
+        is dropped (worker.go:253/:264), keyed by the tx's final index
+        in the block."""
+        from coreth_tpu.warp.predicate import (
+            PredicateResults, check_tx_predicates,
+        )
         gas_pool = GasPool(header.gas_limit)
         receipts = []
         included: List[Transaction] = []
         used_gas = [0]
-        evm = EVM(new_block_context(header), TxContext(), statedb,
-                  self.config)
+        results = PredicateResults()
+        rules = self.config.rules(header.number, header.time)
+        evm = EVM(new_block_context(header, predicate_results=results),
+                  TxContext(), statedb, self.config)
         for tx in txs:
             if gas_pool.gas < P.TX_GAS:
                 break
+            index = len(included)
+            for addr, bits in check_tx_predicates(rules, tx).items():
+                results.set_result(index, addr, bits)
             snap = statedb.snapshot()
             try:
                 msg = tx_to_message(tx, self.signer, header.base_fee)
-                statedb.set_tx_context(tx.hash(), len(included))
+                statedb.set_tx_context(tx.hash(), index)
                 receipt = apply_transaction(
                     msg, gas_pool, statedb, header.number, b"\x00" * 32,
                     tx, used_gas, evm)
             except ErrGasLimitReached:
                 statedb.revert_to_snapshot(snap)
+                results.results.pop(index, None)
                 break
             except (ErrNonceTooLow, ErrNonceTooHigh):
                 statedb.revert_to_snapshot(snap)
+                results.results.pop(index, None)
                 continue
             except ConsensusError:
                 statedb.revert_to_snapshot(snap)
+                results.results.pop(index, None)
                 continue
-            receipt.transaction_index = len(included)
+            receipt.transaction_index = index
             receipts.append(receipt)
             included.append(tx)
-        return receipts, included, used_gas[0]
+        return receipts, included, used_gas[0], results
 
 
 class Miner:

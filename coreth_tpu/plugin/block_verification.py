@@ -1,0 +1,140 @@
+"""Syntactic block verification at the consensus seam.
+
+Twin of reference plugin/evm/block_verification.go (SyntacticVerify
+:40-273): the structural checks a block must pass BEFORE the chain
+executes it — header sanity, fork-keyed extra/gas-limit/base-fee
+shapes, tx/uncle/ext-data hashes, coinbase pinning, minimum gas prices
+pre-dynamic-fees, future-timestamp bound, and the AP4 ext-data gas
+accounting against the block's atomic txs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from coreth_tpu.params import protocol as P
+from coreth_tpu.types import derive_sha
+from coreth_tpu.types.block import calc_ext_data_hash
+
+# Blocks may be at most this far ahead of the wall clock
+# (plugin/evm/block_verification.go maxFutureBlockTime)
+MAX_FUTURE_BLOCK_TIME = 10
+
+# This framework pins the burn coinbase to the zero address (the
+# reference pins constants.BlackholeAddr 0x0100...00; the role —
+# a fixed fee sink unless fee recipients are explicitly allowed —
+# is identical)
+EXPECTED_COINBASE = b"\x00" * 20
+
+
+class BlockVerificationError(Exception):
+    pass
+
+
+def _fail(msg: str) -> None:
+    raise BlockVerificationError(msg)
+
+
+class SyntacticBlockValidator:
+    """blockValidator (block_verification.go:30)."""
+
+    def __init__(self, expected_coinbase: bytes = EXPECTED_COINBASE,
+                 allow_fee_recipients: bool = False):
+        self.expected_coinbase = expected_coinbase
+        self.allow_fee_recipients = allow_fee_recipients
+
+    def syntactic_verify(self, block, rules, atomic_txs=None,
+                         now: Optional[int] = None) -> None:
+        header = block.header
+
+        # ext-data hash matches the body (AP1+; pre-AP1 it must be
+        # empty — this framework starts its fork schedule at AP1+ for
+        # all served networks)
+        if rules.is_apricot_phase1:
+            if header.ext_data_hash != calc_ext_data_hash(block.ext_data()):
+                _fail("ext data hash mismatch")
+        elif header.ext_data_hash != b"\x00" * 32:
+            _fail("expected empty ext data hash before AP1")
+
+        # header sanity (block_verification.go:89-103)
+        if header.number < 0:
+            _fail("invalid block number")
+        if header.difficulty != 1:
+            _fail(f"invalid difficulty {header.difficulty}")
+
+        # static gas limit per fork (:107-120)
+        if rules.is_cortina:
+            if header.gas_limit != P.CORTINA_GAS_LIMIT:
+                _fail(f"expected cortina gas limit {P.CORTINA_GAS_LIMIT}, "
+                      f"got {header.gas_limit}")
+        elif rules.is_apricot_phase1:
+            if header.gas_limit != P.APRICOT_PHASE1_GAS_LIMIT:
+                _fail(f"expected AP1 gas limit {P.APRICOT_PHASE1_GAS_LIMIT},"
+                      f" got {header.gas_limit}")
+
+        # extra-data size per fork (:123-154)
+        size = len(header.extra)
+        if rules.is_durango:
+            if size < P.DYNAMIC_FEE_EXTRA_DATA_SIZE:
+                _fail(f"expected extra >= {P.DYNAMIC_FEE_EXTRA_DATA_SIZE},"
+                      f" got {size}")
+        elif rules.is_apricot_phase3:
+            if size != P.DYNAMIC_FEE_EXTRA_DATA_SIZE:
+                _fail(f"expected extra == {P.DYNAMIC_FEE_EXTRA_DATA_SIZE},"
+                      f" got {size}")
+        elif rules.is_apricot_phase1:
+            if size != 0:
+                _fail(f"expected empty extra, got {size}")
+        elif size > P.MAXIMUM_EXTRA_DATA_SIZE:
+            _fail(f"extra too large: {size}")
+
+        # body hashes (:161-169)
+        if derive_sha(block.transactions) != header.tx_hash:
+            _fail("tx hash mismatch")
+        if block.uncles:
+            _fail("uncles unsupported")
+
+        # coinbase pinned to the burn address (:171-174)
+        if not self.allow_fee_recipients \
+                and header.coinbase != self.expected_coinbase:
+            _fail(f"invalid coinbase {header.coinbase.hex()}")
+
+        # block must not be empty (:180-184)
+        atomic_txs = atomic_txs or []
+        if not block.transactions and not atomic_txs:
+            _fail("empty block")
+
+        # minimum gas prices before dynamic fees (:186-203)
+        if not rules.is_apricot_phase3:
+            floor = (P.APRICOT_PHASE1_MIN_GAS_PRICE
+                     if rules.is_apricot_phase1
+                     else P.LAUNCH_MIN_GAS_PRICE)
+            for tx in block.transactions:
+                if tx.gas_price < floor:
+                    _fail(f"tx gas price below minimum {floor}")
+
+        # future-timestamp bound (:205-210)
+        if now is not None and header.time > now + MAX_FUTURE_BLOCK_TIME:
+            _fail(f"block timestamp too far in the future: {header.time}")
+
+        # base fee presence (:212-221)
+        if rules.is_apricot_phase3 and header.base_fee is None:
+            _fail("nil base fee after AP3")
+
+        # AP4 ext-data gas accounting against the atomic txs (:223-262)
+        if rules.is_apricot_phase4:
+            if header.ext_data_gas_used is None:
+                _fail("nil extDataGasUsed after AP4")
+            if rules.is_apricot_phase5 \
+                    and header.ext_data_gas_used > P.ATOMIC_GAS_LIMIT:
+                _fail(f"too large extDataGasUsed "
+                      f"{header.ext_data_gas_used}")
+            total = 0
+            for atx in atomic_txs:
+                total += atx.unsigned.gas_used(rules.is_apricot_phase5,
+                                               len(atx.encode()))
+            if header.ext_data_gas_used != total:
+                _fail(f"invalid extDataGasUsed: have "
+                      f"{header.ext_data_gas_used}, want {total}")
+            if header.block_gas_cost is None:
+                _fail("nil blockGasCost after AP4")

@@ -1,0 +1,74 @@
+"""AppRequest dispatch: sync handlers + warp signature handler.
+
+Twin of reference plugin/evm/network_handler.go: one request handler
+registered on the peer network routes incoming messages by type —
+leafs/code/block requests to the state-sync server handlers,
+signature requests to the warp backend
+(warp/handlers/signature_request.go).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from coreth_tpu.sync.messages import SignatureRequest, SignatureResponse
+
+
+class SignatureRequestHandler:
+    """Serves this node's BLS signatures to aggregating validators
+    (warp/handlers/signature_request.go:~30 OnSignatureRequest).
+    Unknown messages produce an EMPTY signature response, never an
+    error — a peer's ignorance must not poison the aggregate."""
+
+    def __init__(self, warp_backend):
+        self.backend = warp_backend
+        self.served = 0
+        self.unknown = 0
+
+    def on_signature_request(self, req: SignatureRequest
+                             ) -> SignatureResponse:
+        try:
+            if req.message_id:
+                sig = self.backend.get_message_signature(req.message_id)
+            elif req.block_hash:
+                sig = self.backend.get_block_signature(req.block_hash)
+            else:
+                raise KeyError("empty signature request")
+        except KeyError:
+            self.unknown += 1
+            return SignatureResponse(b"")
+        self.served += 1
+        return SignatureResponse(sig)
+
+
+class NetworkHandler:
+    """networkHandler (plugin/evm/network_handler.go): the single
+    request_handler joined to the AppNetwork."""
+
+    def __init__(self, sync_handler=None, warp_backend=None):
+        self.sync_handler = sync_handler
+        self.signature_handler = (SignatureRequestHandler(warp_backend)
+                                  if warp_backend is not None else None)
+
+    def handle(self, raw: bytes) -> bytes:
+        kind = raw[0]
+        if kind == 6:
+            if self.signature_handler is None:
+                return SignatureResponse(b"").encode()
+            return self.signature_handler.on_signature_request(
+                SignatureRequest.decode(raw)).encode()
+        if self.sync_handler is None:
+            raise ValueError(f"no handler for message kind {kind}")
+        return self.sync_handler.handle(raw)
+
+
+def network_signature_fetcher(peer, node_ids=None):
+    """Build the Aggregator's fetch_signature callable over an
+    AppNetwork Peer: request node_id's signature for a message
+    (aggregator/signature_getter.go role)."""
+    def fetch(node_id: bytes, msg) -> Optional[bytes]:
+        raw = peer.send_request(
+            node_id, SignatureRequest(message_id=msg.id()).encode())
+        resp = SignatureResponse.decode(raw)
+        return resp.signature or None
+    return fetch

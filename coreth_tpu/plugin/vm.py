@@ -56,6 +56,17 @@ class VM:
         self.atomic_backend = None
         self.atomic_mempool = None
         self._building_atomic = []
+        from coreth_tpu.plugin.block_verification import (
+            SyntacticBlockValidator,
+        )
+        self.block_validator = SyntacticBlockValidator()
+        # set False while consensus bootstraps (SetState analog);
+        # UTXO-presence verification is skipped before normal op
+        self.bootstrapped = True
+        # warp subsystem (vm.go warp backend + handlers): wired by
+        # enable_warp() before initialize
+        self.warp_backend = None
+        self.warp_config = None
 
     # ------------------------------------------------------------ lifecycle
     def initialize(self, genesis_bytes: Union[bytes, str, dict],
@@ -96,6 +107,12 @@ class VM:
         # optimistic insert tip, SetPreference, and cross-branch accept
         self.chain.subscribe_chain_head(
             lambda _b: self.txpool.reset())
+        if self.warp_backend is not None:
+            # only accepted blocks may receive block-hash signatures
+            def _accepted(h: bytes) -> bool:
+                entry = self.chain._blocks.get(h)
+                return entry is not None and entry.status == "accepted"
+            self.warp_backend.accepted_block_fn = _accepted
         g = self.chain.genesis_block
         gb = PluginBlock(self, g)
         gb.status = Status.ACCEPTED
@@ -125,7 +142,56 @@ class VM:
     def _register(self, blk: PluginBlock) -> None:
         self._blocks[blk.id] = blk
 
+    # ------------------------------------------------------------- warp
+    def enable_warp(self, network_id: int, source_chain_id: bytes,
+                    secret_key: int, validator_set_fn=None,
+                    quorum_num: int = 67, quorum_den: int = 100) -> None:
+        """Wire the warp subsystem (vm.go warpBackend init + module
+        registration): the backend stores/signs this chain's outgoing
+        messages; the registered stateful precompile serves
+        sendWarpMessage/getVerifiedWarpMessage; validator_set_fn is
+        the P-Chain view used to verify inbound predicates.  Call
+        before initialize(); the module registry is global, so tests
+        must disable_warp() when done."""
+        from coreth_tpu.precompile.modules import register_module
+        from coreth_tpu.precompile.warp_contract import (
+            WarpConfig, make_warp_module,
+        )
+        from coreth_tpu.warp.backend import WarpBackend
+        self.warp_config = WarpConfig(
+            network_id, source_chain_id,
+            validator_set_fn=validator_set_fn,
+            quorum_num=quorum_num, quorum_den=quorum_den)
+        self.warp_backend = WarpBackend(network_id, source_chain_id,
+                                        secret_key)
+        register_module(make_warp_module(self.warp_config))
+
+    def disable_warp(self) -> None:
+        from coreth_tpu.precompile.modules import unregister_module
+        from coreth_tpu.precompile.warp_contract import WARP_ADDRESS
+        unregister_module(WARP_ADDRESS)
+        self.warp_backend = None
+        self.warp_config = None
+
+    def _harvest_warp_messages(self, blk: PluginBlock) -> None:
+        """Accepted-block hook (block.go:234 handlePrecompileAccept):
+        every SendWarpMessage log in the accepted block lands in the
+        warp backend, which can then sign it for aggregators."""
+        from coreth_tpu.precompile.warp_contract import (
+            SEND_WARP_MESSAGE_TOPIC, WARP_ADDRESS,
+        )
+        from coreth_tpu.warp.messages import UnsignedMessage
+        receipts = self.chain.get_receipts(blk.id) or []
+        for receipt in receipts:
+            for log in receipt.logs:
+                if log.address == WARP_ADDRESS and log.topics \
+                        and log.topics[0] == SEND_WARP_MESSAGE_TOPIC:
+                    self.warp_backend.add_message(
+                        UnsignedMessage.decode(log.data))
+
     def _on_accept(self, blk: PluginBlock) -> None:
+        if self.warp_backend is not None:
+            self._harvest_warp_messages(blk)
         if self.atomic_backend is not None:
             from coreth_tpu.atomic import decode_ext_data
             self.atomic_backend.accept(blk.id)

@@ -92,7 +92,19 @@ class Processor:
         all_logs: list = []
         apply_upgrades(self.config, parent_header.time if parent_header
                        else None, block, statedb)
-        ctx = new_block_context(header, get_hash or self.get_hash)
+        # post-Durango the header Extra carries the block's predicate
+        # results after the fee window (core/evm.go:60 ParseResults);
+        # execution-time getVerifiedWarpMessage reads them
+        predicate_results = None
+        if self.config.is_durango(header.time):
+            from coreth_tpu.warp.predicate import (
+                PredicateResults, results_bytes_from_extra,
+            )
+            raw = results_bytes_from_extra(header.extra)
+            if raw is not None:
+                predicate_results = PredicateResults.decode(raw)
+        ctx = new_block_context(header, get_hash or self.get_hash,
+                                predicate_results=predicate_results)
         evm = EVM(ctx, TxContext(), statedb, self.config, vm_config)
         signer = LatestSigner(self.config.chain_id)
         for i, tx in enumerate(block.transactions):

@@ -12,10 +12,12 @@ from coreth_tpu.rpc.ethapi import register_eth_api
 from coreth_tpu.rpc.filters import FilterSystem, filter_logs
 from coreth_tpu.rpc.gasprice import Oracle
 from coreth_tpu.rpc.tracers import register_debug_api
+from coreth_tpu.rpc.warpapi import register_warp_api
 
 __all__ = [
     "Backend", "FilterSystem", "Oracle", "RPCError", "RPCServer",
     "filter_logs", "register_debug_api", "register_eth_api",
+    "register_warp_api",
 ]
 
 

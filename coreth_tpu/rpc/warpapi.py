@@ -1,0 +1,68 @@
+"""warp_* JSON-RPC service.
+
+Twin of reference warp/service.go (:24-93): getMessage /
+getMessageSignature / getBlockSignature return this node's view;
+getMessageAggregateSignature / getBlockAggregateSignature fan out to
+validators through the aggregator and return the quorum-signed
+message.
+"""
+
+from __future__ import annotations
+
+from coreth_tpu.rpc.server import RPCError
+
+
+def _hex32(value: str, what: str) -> bytes:
+    try:
+        raw = bytes.fromhex(value[2:] if value.startswith("0x") else value)
+    except ValueError as exc:
+        raise RPCError(f"invalid {what}: {exc}", -32602)
+    if len(raw) != 32:
+        raise RPCError(f"{what} must be 32 bytes", -32602)
+    return raw
+
+
+def register_warp_api(server, warp_backend, aggregator=None) -> None:
+    """Register the warp_* namespace (service.go API)."""
+
+    def warp_getMessage(message_id: str):
+        msg = warp_backend.get_message(_hex32(message_id, "messageID"))
+        if msg is None:
+            raise RPCError("message not found", -32000)
+        return "0x" + msg.encode().hex()
+
+    def warp_getMessageSignature(message_id: str):
+        try:
+            sig = warp_backend.get_message_signature(
+                _hex32(message_id, "messageID"))
+        except KeyError:
+            raise RPCError("message not found", -32000)
+        return "0x" + sig.hex()
+
+    def warp_getBlockSignature(block_hash: str):
+        try:
+            sig = warp_backend.get_block_signature(
+                _hex32(block_hash, "blockHash"))
+        except KeyError:
+            raise RPCError("block not accepted", -32000)
+        return "0x" + sig.hex()
+
+    def warp_getMessageAggregateSignature(message_id: str,
+                                          quorum_num: int = 67):
+        if aggregator is None:
+            raise RPCError("aggregator not configured", -32000)
+        msg = warp_backend.get_message(_hex32(message_id, "messageID"))
+        if msg is None:
+            raise RPCError("message not found", -32000)
+        from coreth_tpu.warp.aggregator import AggregateError
+        try:
+            signed = aggregator.aggregate(msg, quorum_num=quorum_num)
+        except AggregateError as exc:
+            raise RPCError(str(exc), -32000)
+        return "0x" + signed.encode().hex()
+
+    server.register("warp_getMessage", warp_getMessage)
+    server.register("warp_getMessageSignature", warp_getMessageSignature)
+    server.register("warp_getBlockSignature", warp_getBlockSignature)
+    server.register("warp_getMessageAggregateSignature",
+                    warp_getMessageAggregateSignature)

@@ -152,8 +152,50 @@ class BlockResponse:
         return cls([u.var_bytes() for _ in range(u.u32())])
 
 
+@dataclass
+class SignatureRequest:
+    """Warp signature request (message/signature_request.go): exactly
+    one of message_id (sign a stored warp message) or block_hash (sign
+    an accepted block hash) is set."""
+    message_id: bytes = b""
+    block_hash: bytes = b""
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(6)
+        p.var_bytes(self.message_id)
+        p.var_bytes(self.block_hash)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignatureRequest":
+        u = Unpacker(data)
+        assert u.u8() == 6
+        return cls(u.var_bytes(), u.var_bytes())
+
+
+@dataclass
+class SignatureResponse:
+    """96-byte BLS signature; empty = this node cannot sign the
+    request (message/signature_request.go SignatureResponse)."""
+    signature: bytes = b""
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(7)
+        p.var_bytes(self.signature)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignatureResponse":
+        u = Unpacker(data)
+        assert u.u8() == 7
+        return cls(u.var_bytes())
+
+
 def decode_message(data: bytes):
     kind = data[0]
     return {0: LeafsRequest, 1: LeafsResponse, 2: CodeRequest,
-            3: CodeResponse, 4: BlockRequest,
-            5: BlockResponse}[kind].decode(data)
+            3: CodeResponse, 4: BlockRequest, 5: BlockResponse,
+            6: SignatureRequest,
+            7: SignatureResponse}[kind].decode(data)

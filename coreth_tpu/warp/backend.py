@@ -21,12 +21,18 @@ SIGNATURE_CACHE = 256
 
 class WarpBackend:
     def __init__(self, network_id: int, source_chain_id: bytes,
-                 secret_key: int, store: Optional[dict] = None):
+                 secret_key: int, store: Optional[dict] = None,
+                 accepted_block_fn=None):
+        """accepted_block_fn(block_hash) -> bool: when set, block-hash
+        signing is limited to ACCEPTED blocks — signing arbitrary
+        hashes would let a peer harvest forged acceptance attestations
+        (the reference checks its block index in GetBlockSignature)."""
         self.network_id = network_id
         self.source_chain_id = source_chain_id
         self.sk = secret_key
         self.public_key = bls.public_key(secret_key)
         self.store: Dict[bytes, bytes] = store if store is not None else {}
+        self.accepted_block_fn = accepted_block_fn
         self._sig_cache: "OrderedDict[bytes, bytes]" = OrderedDict()
 
     # ------------------------------------------------------------ messages
@@ -62,7 +68,11 @@ class WarpBackend:
 
     def get_block_signature(self, block_hash: bytes) -> bytes:
         """Sign an accepted block hash (GetBlockSignature :158) wrapped
-        as a block-hash payload message."""
+        as a block-hash payload message; refuses hashes the chain has
+        not accepted when an acceptance check is wired."""
+        if self.accepted_block_fn is not None \
+                and not self.accepted_block_fn(block_hash):
+            raise KeyError(f"block {block_hash.hex()} not accepted")
         msg = UnsignedMessage(self.network_id, self.source_chain_id,
                               block_hash)
         return self._sign_cached(b"blk" + block_hash, msg.encode())

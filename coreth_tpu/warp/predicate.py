@@ -40,6 +40,38 @@ def slots_to_bytes(slots: List[bytes]) -> bytes:
     return b"".join(slots)
 
 
+def results_bytes_from_extra(extra: bytes):
+    """Extract the predicate-results bytes carried after the 80-byte
+    dynamic-fee window in a post-Durango header Extra
+    (predicate.GetPredicateResultBytes)."""
+    from coreth_tpu.params import protocol as P
+    if len(extra) <= P.DYNAMIC_FEE_EXTRA_DATA_SIZE:
+        return None
+    return extra[P.DYNAMIC_FEE_EXTRA_DATA_SIZE:]
+
+
+def check_tx_predicates(rules, tx) -> Dict[bytes, bytes]:
+    """One tx's per-predicater-address failure bitsets
+    (core/predicate_check.go:30 CheckPredicates): group the tx's
+    access-list tuples by predicater address in order, verify each
+    tuple's packed predicate, set the bit on failure."""
+    out: Dict[bytes, bytes] = {}
+    if not rules.predicaters:
+        return out
+    per_addr: Dict[bytes, List[List[bytes]]] = {}
+    for addr, keys in (tx.access_list or []):
+        if addr in rules.predicaters:
+            per_addr.setdefault(addr, []).append(list(keys))
+    for addr, tuple_list in per_addr.items():
+        predicater = rules.predicaters[addr]
+        bits = bytearray((len(tuple_list) + 7) // 8)
+        for i, keys in enumerate(tuple_list):
+            if not predicater.verify_predicate(slots_to_bytes(keys)):
+                bits[i // 8] |= 1 << (i % 8)
+        out[addr] = bytes(bits)
+    return out
+
+
 class PredicateResults:
     """txIndex -> per-predicate failure bitset (results.go)."""
 

@@ -1,0 +1,124 @@
+"""Syntactic block verification ladder at the plugin seam.
+
+Mirrors reference plugin/evm/block_verification.go checks and the
+Verify ladder in block.go:366 (syntactic -> predicates -> UTXO
+presence -> execution), driven through the VM the way vm_test.go
+table cases do.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.plugin.block_verification import (
+    BlockVerificationError, SyntacticBlockValidator,
+)
+from tests.test_plugin import genesis_vm, make_tx
+
+RULES = CFG.rules(1, 1_000)
+V = SyntacticBlockValidator()
+
+
+def _built_block(clock_start=1_000):
+    t = [clock_start]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm = genesis_vm(clock)
+    vm.issue_tx(make_tx(0))
+    return vm, vm.build_block()
+
+
+def test_built_block_passes_syntactic_verify():
+    vm, blk = _built_block()
+    V.syntactic_verify(blk.block, RULES, now=blk.block.time)
+
+
+def test_rejects_wrong_coinbase():
+    vm, blk = _built_block()
+    blk.block.header.coinbase = b"\x99" * 20
+    with pytest.raises(BlockVerificationError, match="coinbase"):
+        V.syntactic_verify(blk.block, RULES, now=blk.block.time)
+
+
+def test_rejects_wrong_gas_limit_post_cortina():
+    vm, blk = _built_block()
+    blk.block.header.gas_limit = 8_000_000
+    with pytest.raises(BlockVerificationError, match="cortina gas limit"):
+        V.syntactic_verify(blk.block, RULES, now=blk.block.time)
+
+
+def test_rejects_future_timestamp():
+    vm, blk = _built_block()
+    with pytest.raises(BlockVerificationError, match="future"):
+        V.syntactic_verify(blk.block, RULES, now=blk.block.time - 60)
+
+
+def test_rejects_empty_block():
+    vm, blk = _built_block()
+    blk.block.transactions = []
+    with pytest.raises(BlockVerificationError):
+        V.syntactic_verify(blk.block, RULES, now=blk.block.time)
+
+
+def test_rejects_short_extra_post_durango():
+    vm, blk = _built_block()
+    blk.block.header.extra = b"\x00" * 10
+    with pytest.raises(BlockVerificationError, match="extra"):
+        V.syntactic_verify(blk.block, RULES, now=blk.block.time)
+
+
+def test_rejects_tampered_tx_root():
+    vm, blk = _built_block()
+    blk.block.header.tx_hash = b"\x11" * 32
+    with pytest.raises(BlockVerificationError, match="tx hash"):
+        V.syntactic_verify(blk.block, RULES, now=blk.block.time)
+
+
+# ------------------------------------------------- ladder via the VM
+
+def test_vm_verify_rejects_tampered_block():
+    """parse a valid block on a second VM, tamper the coinbase, and
+    the Verify ladder (not just state execution) rejects it."""
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm1 = genesis_vm(clock)
+    vm2 = genesis_vm(clock)
+    vm1.issue_tx(make_tx(0))
+    built = vm1.build_block()
+    parsed = vm2.parse_block(built.bytes())
+    parsed.block.header.coinbase = b"\x99" * 20
+    parsed.block._hash = None
+    with pytest.raises(BlockVerificationError, match="coinbase"):
+        parsed.verify()
+
+
+def test_vm_verify_requires_predicate_results_bytes():
+    """post-Durango headers must carry the predicate-results bytes
+    after the fee window (block.go:413 verifyPredicates)."""
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm1 = genesis_vm(clock)
+    vm2 = genesis_vm(clock)
+    vm1.issue_tx(make_tx(0))
+    built = vm1.build_block()
+    parsed = vm2.parse_block(built.bytes())
+    # strip the results bytes: extra becomes bare fee window
+    parsed.block.header.extra = parsed.block.header.extra[:80]
+    parsed.block._hash = None
+    with pytest.raises(BlockVerificationError, match="predicate results"):
+        parsed.verify()

@@ -246,3 +246,130 @@ def test_warp_backend_signing():
     bsig = backend.get_block_signature(b"\x42" * 32)
     blk_msg = UnsignedMessage(NETWORK_ID, SOURCE_CHAIN, b"\x42" * 32)
     assert bls.verify(PKS[0], blk_msg.encode(), bsig)
+
+# ---------------------------------------------- two-VM end-to-end
+
+def test_vm_warp_end_to_end():
+    """vm_warp_test.go:679 shape, all the way through the stack:
+    sendWarpMessage tx on chain A -> accept harvests the message into
+    A's warp backend -> validators serve signatures over the app
+    network (SignatureRequest wire handler) -> aggregate via the
+    warp_* RPC -> chain B includes a tx presenting the signed message
+    as a predicate -> B's build/verify ladder records + checks the
+    results bitset -> execution reads the verified payload.
+
+    The stateful-module registry is process-global, so the two chains
+    run sequentially, each registering its own warp config (the
+    reference runs one registry per VM process)."""
+    from coreth_tpu.peer.network import AppNetwork
+    from coreth_tpu.plugin import VM
+    from coreth_tpu.plugin.network_handler import (
+        NetworkHandler, network_signature_fetcher,
+    )
+    from coreth_tpu.rpc import RPCServer, register_warp_api
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    from coreth_tpu.warp.predicate import (
+        PredicateResults, results_bytes_from_extra,
+    )
+    from tests.test_plugin import CHAIN_ID, KEY, genesis_json
+
+    GWEI = 10**9
+    DEST_CHAIN = b"\xBB" * 32
+    payload = b"cross-subnet e2e payload"
+
+    def make_clock():
+        t = [1_000]
+
+        def clock():
+            t[0] += 10
+            return t[0]
+        return clock
+
+    # ---------------- chain A: emit + sign + aggregate ----------------
+    vm_a = VM(clock=make_clock())
+    vm_a.enable_warp(NETWORK_ID, SOURCE_CHAIN, SKS[0],
+                     validator_set_fn=lambda: VSET)
+    try:
+        vm_a.initialize(genesis_json())
+        calldata = (SEND_WARP_MESSAGE + abi_word(32)
+                    + abi_pack_bytes(payload))
+        vm_a.issue_tx(sign_tx(DynamicFeeTx(
+            chain_id_=CHAIN_ID, nonce=0, gas_tip_cap_=GWEI,
+            gas_fee_cap_=300 * GWEI, gas=200_000, to=WARP_ADDRESS,
+            value=0, data=calldata), KEY, CHAIN_ID))
+        blk_a = vm_a.build_block()
+        blk_a.accept()
+        # accept-side hook harvested the emitted message
+        assert len(vm_a.warp_backend.store) == 1
+        mid = next(iter(vm_a.warp_backend.store))
+        unsigned = vm_a.warp_backend.get_message(mid)
+        assert AddressedCall.decode(unsigned.payload).payload == payload
+
+        # validators (other nodes that accepted the same block) serve
+        # signatures over the app network
+        net = AppNetwork()
+        for i in range(N_VALIDATORS):
+            backend = WarpBackend(NETWORK_ID, SOURCE_CHAIN, SKS[i])
+            backend.add_message(unsigned)
+            net.join(bytes([i]) * 20,
+                     request_handler=NetworkHandler(
+                         warp_backend=backend).handle)
+        client = net.join(b"\xCC" * 20)
+        agg = Aggregator(VSET, network_signature_fetcher(client))
+
+        server = RPCServer()
+        register_warp_api(server, vm_a.warp_backend, aggregator=agg)
+        out = server.handle_request({
+            "jsonrpc": "2.0", "id": 1,
+            "method": "warp_getMessageAggregateSignature",
+            "params": ["0x" + mid.hex()]})
+        assert "result" in out, out
+        signed = SignedMessage.decode(bytes.fromhex(out["result"][2:]))
+        assert signed.verify(VSET, 67, 100)
+        # the plain signature RPC serves this node's own share
+        one = server.handle_request({
+            "jsonrpc": "2.0", "id": 2,
+            "method": "warp_getMessageSignature",
+            "params": ["0x" + mid.hex()]})
+        assert bls.verify(PKS[0], unsigned.encode(),
+                          bytes.fromhex(one["result"][2:]))
+    finally:
+        vm_a.disable_warp()
+
+    # ---------------- chain B: verify + execute -----------------------
+    vm_b = VM(clock=make_clock())
+    vm_b.enable_warp(NETWORK_ID, DEST_CHAIN, SKS[1],
+                     validator_set_fn=lambda: VSET)
+    try:
+        vm_b.initialize(genesis_json())
+        packed = pack_predicate(signed.encode())
+        slots = [packed[i:i + 32] for i in range(0, len(packed), 32)]
+        vm_b.issue_tx(sign_tx(DynamicFeeTx(
+            chain_id_=CHAIN_ID, nonce=0, gas_tip_cap_=GWEI,
+            gas_fee_cap_=300 * GWEI, gas=400_000, to=WARP_ADDRESS,
+            value=0, data=GET_VERIFIED_WARP_MESSAGE + abi_word(0),
+            al=[(WARP_ADDRESS, slots)]), KEY, CHAIN_ID))
+        blk_b = vm_b.build_block()
+        # the header carries the results bitset; the predicate passed
+        raw = results_bytes_from_extra(blk_b.block.header.extra)
+        results = PredicateResults.decode(raw)
+        assert results.get_result(0, WARP_ADDRESS) == b"\x00"
+        blk_b.accept()
+        receipts = vm_b.chain.get_receipts(blk_b.id)
+        assert receipts[0].status == 1
+        assert receipts[0].gas_used > 21_000  # predicate gas charged
+    finally:
+        vm_b.disable_warp()
+
+
+def test_block_signature_requires_acceptance():
+    """A backend wired with an acceptance check refuses to sign
+    arbitrary block hashes (forged-attestation guard; reference
+    GetBlockSignature consults the chain)."""
+    accepted = {b"\x0A" * 32}
+    backend = WarpBackend(NETWORK_ID, SOURCE_CHAIN, SKS[0],
+                          accepted_block_fn=lambda h: h in accepted)
+    sig = backend.get_block_signature(b"\x0A" * 32)
+    assert len(sig) == 96
+    with pytest.raises(KeyError, match="not accepted"):
+        backend.get_block_signature(b"\x0B" * 32)
