@@ -164,11 +164,16 @@ class AtomicBackend:
         self._pending[block_hash] = _PendingBlock(
             height, requests, parent_hash, frozenset(inputs))
 
-    def accept(self, block_hash: bytes) -> bytes:
+    def accept(self, block_hash: bytes, height: int = None) -> bytes:
         """Accept: index in the atomic trie + apply to shared memory
-        (block.go:177 Accept -> atomicState.Accept)."""
+        (block.go:177 Accept -> atomicState.Accept).  Runs the trie
+        commit policy for EVERY accepted height — commit boundaries
+        must advance even through blocks with no atomic ops
+        (atomic_trie.go AcceptTrie is called per accept)."""
         pend = self._pending.get(block_hash)
         if pend is None:
+            if height is not None:
+                self.trie.accept_trie(height)
             return self.trie.root()
         # validate the shared-memory effect BEFORE mutating anything so
         # a double-spend caught by the backstop leaves trie + pending

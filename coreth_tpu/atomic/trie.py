@@ -33,6 +33,19 @@ def encode_ops(requests) -> bytes:
     return rlp.encode(items)
 
 
+def decode_ops(data: bytes):
+    """Inverse of encode_ops: RLP -> {peer_chain: Requests}."""
+    from coreth_tpu import rlp
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+    out = {}
+    for chain, removes, puts in rlp.decode(data):
+        out[chain] = Requests(
+            remove_requests=list(removes),
+            put_requests=[Element(k, v, [bytes(t) for t in traits])
+                          for k, v, traits in puts])
+    return out
+
+
 class AtomicTrie:
     def __init__(self, node_db: Optional[dict] = None,
                  root: bytes = EMPTY_ROOT,
@@ -42,6 +55,9 @@ class AtomicTrie:
         self.commit_interval = commit_interval
         self.last_committed_root = root
         self.last_committed_height = 0
+        # height -> committed root, for state-sync summaries at past
+        # commit heights (atomic_trie.go height->root index)
+        self.committed_roots = {0: root}
 
     def update_trie(self, height: int, requests) -> None:
         """Index one accepted height's ops (atomic_trie.go:225)."""
@@ -55,6 +71,7 @@ class AtomicTrie:
             root = self.trie.commit()
             self.last_committed_root = root
             self.last_committed_height = height
+            self.committed_roots[height] = root
             return True, root
         return False, self.trie.hash()
 

@@ -67,6 +67,7 @@ class BlockChain:
         accepted block, re-executing any tail whose trie state was not
         yet flushed (blockchain.go:1750 reprocessState)."""
         self.chain_kv = chain_kv
+        self.commit_interval = commit_interval
         self.trie_writer = None
         if chain_kv is not None:
             if db is not None:
@@ -558,6 +559,38 @@ class BlockChain:
             self.chain_kv.flush()
         for cb in self._accepted_subs:
             cb(block, entry.receipts)
+
+    # ------------------------------------------------------------ sync pivot
+    def reset_to_synced(self, tip: Block, ancestors: List[Block] = ()
+                        ) -> None:
+        """finishSync pivot (syncervm_client.go:330): adopt a
+        state-synced block as the accepted tip WITHOUT executing it —
+        its state trie was downloaded verified into self.db.  The
+        ancestors (newest-first) become canonical accepted history.
+        The flat-state snapshot regenerates at the synced root."""
+        if not self.has_state(tip.root):
+            raise BadBlockError(
+                "cannot pivot: synced state root not resident")
+        for b in list(ancestors) + [tip]:
+            self._blocks[b.hash()] = _Entry(b, status="accepted")
+            self._canonical[b.number] = b.hash()
+        self._head = tip
+        self.last_accepted = tip
+        self.acceptor_tip = tip
+        if self.chain_kv is not None:
+            from coreth_tpu.rawdb import schema
+            for b in list(ancestors) + [tip]:
+                schema.write_block(self.chain_kv, b)
+                schema.write_canonical_hash(self.chain_kv, b.number,
+                                            b.hash())
+            schema.write_last_accepted(self.chain_kv, tip.hash())
+            self.trie_writer.force_flush(tip.number, tip.root)
+        if self._want_snapshots:
+            from coreth_tpu.state.snapshot import generate_from_trie
+            self.snaps = generate_from_trie(self.db, tip.root,
+                                            tip.hash())
+        for cb in self._head_subs:
+            cb(tip)
 
     def drain_acceptor_queue(self) -> None:
         """DrainAcceptorQueue (blockchain.go:634): block until every

@@ -1,0 +1,187 @@
+"""State-sync client/server at the consensus seam.
+
+Twin of reference plugin/evm/syncervm_server.go (:19-110 — serve
+SyncSummary at commit heights) and syncervm_client.go (:39-412 —
+select a summary, sync blocks + atomic trie + state trie over the app
+network, then finishSync: pivot the chain to the synced tip and reset
+the txpool), with message/syncable.go's SyncSummary codec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.atomic.wire import Packer, Unpacker
+
+# how many ancestor blocks the client fetches behind the summary
+# (syncervm_client.go parentsToGet = 256)
+PARENTS_TO_FETCH = 256
+
+
+class StateSyncError(Exception):
+    pass
+
+
+@dataclass
+class SyncSummary:
+    """message/syncable.go SyncSummary: everything a syncing node
+    needs to pivot to a trusted height."""
+    height: int = 0
+    block_hash: bytes = b"\x00" * 32
+    block_root: bytes = b"\x00" * 32
+    atomic_root: bytes = b"\x00" * 32
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u64(self.height)
+        p.fixed(self.block_hash, 32)
+        p.fixed(self.block_root, 32)
+        p.fixed(self.atomic_root, 32)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SyncSummary":
+        u = Unpacker(data)
+        return cls(u.u64(), u.fixed(32), u.fixed(32), u.fixed(32))
+
+    def id(self) -> bytes:
+        return keccak256(self.encode())
+
+
+class StateSyncServer:
+    """syncervm_server.go: summaries exist only at commit heights, so
+    a syncing peer can resolve the state trie from flushed storage."""
+
+    def __init__(self, vm, sync_interval: Optional[int] = None):
+        self.vm = vm
+        self.interval = sync_interval \
+            or getattr(vm.chain, "commit_interval", None) or 4096
+
+    def _summary_at(self, height: int) -> SyncSummary:
+        block = self.vm.chain.get_block_by_number(height)
+        if block is None:
+            raise StateSyncError(f"no canonical block at {height}")
+        atomic_root = b"\x00" * 32
+        backend = self.vm.atomic_backend
+        if backend is not None:
+            root = backend.trie.committed_roots.get(height)
+            if root is None:
+                raise StateSyncError(
+                    f"no committed atomic root at {height}")
+            atomic_root = root
+        return SyncSummary(height, block.hash(), block.root, atomic_root)
+
+    def get_last_state_summary(self) -> SyncSummary:
+        """GetLastStateSummary (:48): the newest commit-height
+        summary at or below the last accepted block."""
+        last = self.vm.chain.last_accepted.number
+        height = last - (last % self.interval)
+        if height == 0:
+            raise StateSyncError("no summary available yet")
+        return self._summary_at(height)
+
+    def get_state_summary(self, height: int) -> SyncSummary:
+        """GetStateSummary (:94): a specific commit-height summary."""
+        if height == 0 or height % self.interval != 0:
+            raise StateSyncError(f"not a summary height: {height}")
+        return self._summary_at(height)
+
+
+class StateSyncClient:
+    """syncervm_client.go: drives the whole sync from one summary."""
+
+    def __init__(self, vm, transport):
+        """transport: bytes -> bytes against a serving peer (the
+        peer.NetworkClient seam — e.g. peer.send_request_any)."""
+        from coreth_tpu.sync.client import SyncClient
+        self.vm = vm
+        self.client = SyncClient(transport)
+        self.stats: dict = {}
+
+    @staticmethod
+    def parse_state_summary(raw: bytes) -> SyncSummary:
+        return SyncSummary.decode(raw)
+
+    # ------------------------------------------------------------ phases
+    def _sync_blocks(self, summary: SyncSummary) -> List:
+        """syncBlocks (:237): fetch the summary block + up to 256
+        parents, hash-chain-verified by the client."""
+        from coreth_tpu.types import Block
+        want = min(PARENTS_TO_FETCH, summary.height)
+        raws = self.client.get_blocks(summary.block_hash, summary.height,
+                                      want)
+        if not raws:
+            raise StateSyncError("peer served no blocks")
+        blocks = [Block.decode(r) for r in raws]
+        self.stats["blocks"] = len(blocks)
+        return blocks  # newest first
+
+    def _sync_atomic_trie(self, summary: SyncSummary) -> None:
+        """atomic_syncer.go role: page the atomic trie's height-keyed
+        leaves, rebuild locally, verify the root, apply the ops to
+        shared memory, and swap the backend's trie."""
+        backend = self.vm.atomic_backend
+        if backend is None or summary.atomic_root == b"\x00" * 32:
+            return
+        from coreth_tpu.atomic.trie import AtomicTrie, decode_ops
+        from coreth_tpu.sync.messages import ATOMIC_TRIE_NODE
+        synced = AtomicTrie(commit_interval=backend.trie.commit_interval)
+        leaves = []
+        start = b""
+        while True:
+            keys, vals, more = self.client.get_leafs(
+                summary.atomic_root, start=start,
+                node_type=ATOMIC_TRIE_NODE)
+            for k, v in zip(keys, vals):
+                synced.trie.update(k, v)
+                leaves.append(v)
+            if not more or not keys:
+                break
+            start = _next_key(keys[-1])
+        root = synced.trie.commit()
+        if root != summary.atomic_root:
+            raise StateSyncError(
+                f"atomic trie root mismatch: {root.hex()}")
+        # apply ONLY after the full trie verified, and tolerantly —
+        # a retried sync must not trip over removes an earlier attempt
+        # already performed (atomic_backend.go:373 cursor semantics)
+        for v in leaves:
+            backend.shared_memory.apply_tolerant(decode_ops(v))
+        synced.last_committed_root = root
+        synced.last_committed_height = summary.height
+        synced.committed_roots[summary.height] = root
+        backend.trie = synced
+        self.stats["atomic_leafs"] = len(leaves)
+
+    def _sync_state_trie(self, summary: SyncSummary) -> None:
+        """syncStateTrie (:298): verified-range download of the full
+        state under the summary root, into the chain's database."""
+        from coreth_tpu.sync.statesync import StateSyncer
+        syncer = StateSyncer(self.client, db=self.vm.chain.db)
+        syncer.sync(summary.block_root)
+        self.stats.update(syncer.stats)
+
+    # ------------------------------------------------------------- accept
+    def accept_summary(self, summary: SyncSummary) -> None:
+        """acceptSyncSummary (:164) + finishSync (:330): run every
+        phase, then pivot the chain to the synced tip and re-anchor
+        the tx pool on it."""
+        blocks = self._sync_blocks(summary)
+        self._sync_atomic_trie(summary)
+        self._sync_state_trie(summary)
+        # pivot fires the chain-head event, which the VM already wires
+        # to a txpool reset; blocks[0]'s identity was hash-chain
+        # verified against summary.block_hash by get_blocks
+        self.vm.chain.reset_to_synced(blocks[0], blocks[1:])
+        from coreth_tpu.plugin.block import PluginBlock, Status
+        blk = PluginBlock(self.vm, blocks[0])
+        blk.status = Status.ACCEPTED
+        self.vm._register(blk)
+        self.vm.preferred_id = blk.id
+
+
+def _next_key(key: bytes) -> bytes:
+    n = int.from_bytes(key, "big") + 1
+    return n.to_bytes(len(key), "big")

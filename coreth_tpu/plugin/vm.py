@@ -87,7 +87,11 @@ class VM:
             from coreth_tpu.consensus.engine import DummyEngine
             ctx = self.chain_ctx or ChainContext()
             self.chain_ctx = ctx
-            self.atomic_backend = AtomicBackend(ctx, self.shared_memory)
+            from coreth_tpu.atomic.trie import AtomicTrie
+            self.atomic_backend = AtomicBackend(
+                ctx, self.shared_memory,
+                trie=AtomicTrie(
+                    commit_interval=self.config.commit_interval))
             self.atomic_mempool = AtomicMempool(ctx)
             cb = make_callbacks(self.atomic_backend, genesis.config,
                                 pending_atomic_txs=self._pending_atomic)
@@ -122,7 +126,30 @@ class VM:
         self.builder = BlockBuilder(
             self, clock=self.clock,
             min_interval=self.config.min_block_build_interval_ms / 1000)
+        from coreth_tpu.plugin.syncervm import StateSyncServer
+        self.state_sync_server = StateSyncServer(self)
         self.initialized = True
+
+    def app_request_handler(self):
+        """The request handler this VM joins the app network with
+        (network_handler.go): sync handlers over the chain database +
+        the warp signature handler."""
+        from coreth_tpu.plugin.network_handler import NetworkHandler
+        from coreth_tpu.sync.handlers import SyncHandler
+        # resolved per request: a state sync swaps the backend's trie
+        # (and its node store), and served leaves must follow it
+        atomic_db = ((lambda: self.atomic_backend.trie.node_db)
+                     if self.atomic_backend is not None else None)
+        return NetworkHandler(
+            sync_handler=SyncHandler(self.chain.db, self.chain,
+                                     atomic_node_db=atomic_db),
+            warp_backend=self.warp_backend).handle
+
+    def state_sync_client(self, transport):
+        """Build the syncervm client against a peer transport
+        (syncervm_client.go)."""
+        from coreth_tpu.plugin.syncervm import StateSyncClient
+        return StateSyncClient(self, transport)
 
     def shutdown(self) -> None:
         self.initialized = False
@@ -194,7 +221,7 @@ class VM:
             self._harvest_warp_messages(blk)
         if self.atomic_backend is not None:
             from coreth_tpu.atomic import decode_ext_data
-            self.atomic_backend.accept(blk.id)
+            self.atomic_backend.accept(blk.id, height=blk.height)
             txs = decode_ext_data(blk.block.ext_data())
             if txs:
                 self.atomic_mempool.remove_accepted(

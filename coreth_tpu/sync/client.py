@@ -41,13 +41,14 @@ class SyncClient:
         raise SyncClientError(f"transport failed: {err}")
 
     def get_leafs(self, root: bytes, start: bytes = ZERO_KEY,
-                  limit: int = 1024, account: bytes = b""
+                  limit: int = 1024, account: bytes = b"",
+                  node_type: int = 0
                   ) -> Tuple[List[bytes], List[bytes], bool]:
         """One verified leaf page: (keys, vals, more).  Raises
         BadProofError when the response fails proof verification —
         an untrusted peer cannot make us accept a wrong range."""
         req = LeafsRequest(root=root, account=account, start=start,
-                           limit=limit)
+                           limit=limit, node_type=node_type)
         resp = decode_message(self._call(req.encode()))
         if not isinstance(resp, LeafsResponse):
             raise SyncClientError("unexpected response type")

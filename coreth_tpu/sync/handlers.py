@@ -24,11 +24,17 @@ MAX_LEAFS = 1024
 class SyncHandler:
     """Answers sync requests for one chain (network_handler.go role)."""
 
-    def __init__(self, db, chain=None):
+    def __init__(self, db, chain=None, atomic_node_db=None):
         """db: state Database (node_db + code_db); chain: optional
-        BlockChain for block requests."""
+        BlockChain for block requests; atomic_node_db: the atomic
+        trie's node store — a dict or a zero-arg callable returning
+        one — served for ATOMIC_TRIE_NODE leaf requests
+        (leafs_request.go NodeType dispatch).  A callable is resolved
+        per request, so a state sync that swaps the backend's trie
+        (and its node store) is picked up by later requests."""
         self.db = db
         self.chain = chain
+        self.atomic_node_db = atomic_node_db
 
     # ------------------------------------------------------------- dispatch
     def handle(self, raw: bytes) -> bytes:
@@ -43,8 +49,16 @@ class SyncHandler:
 
     # -------------------------------------------------------------- leaves
     def on_leafs_request(self, req: LeafsRequest) -> LeafsResponse:
+        from coreth_tpu.sync.messages import ATOMIC_TRIE_NODE
         limit = min(req.limit, MAX_LEAFS)
-        trie = Trie(root_hash=req.root, db=self.db.node_db)
+        node_db = self.db.node_db
+        if req.node_type == ATOMIC_TRIE_NODE:
+            if self.atomic_node_db is None:
+                raise ValueError("atomic trie not served here")
+            node_db = (self.atomic_node_db()
+                       if callable(self.atomic_node_db)
+                       else self.atomic_node_db)
+        trie = Trie(root_hash=req.root, db=node_db)
         keys: List[bytes] = []
         vals: List[bytes] = []
         more = False

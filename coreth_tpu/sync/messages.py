@@ -14,6 +14,11 @@ from typing import List, Optional
 from coreth_tpu.atomic.wire import Packer, Unpacker
 
 
+# LeafsRequest node types (message/leafs_request.go NodeType)
+STATE_TRIE_NODE = 0
+ATOMIC_TRIE_NODE = 1
+
+
 @dataclass
 class LeafsRequest:
     """Range request against one trie (leafs_request.go:30)."""
@@ -21,6 +26,7 @@ class LeafsRequest:
     account: bytes = b""           # set for storage-trie requests
     start: bytes = b""             # first key (inclusive), raw trie key
     limit: int = 1024
+    node_type: int = STATE_TRIE_NODE
 
     def encode(self) -> bytes:
         p = Packer()
@@ -29,13 +35,15 @@ class LeafsRequest:
         p.var_bytes(self.account)
         p.var_bytes(self.start)
         p.u32(self.limit)
+        p.u8(self.node_type)
         return p.bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "LeafsRequest":
         u = Unpacker(data)
         assert u.u8() == 0
-        return cls(u.fixed(32), u.var_bytes(), u.var_bytes(), u.u32())
+        return cls(u.fixed(32), u.var_bytes(), u.var_bytes(), u.u32(),
+                   u.u8())
 
 
 @dataclass

@@ -1,0 +1,220 @@
+"""State sync at the consensus seam: two VMs wired by their app
+senders, one syncs from the other mid-chain and then accepts new
+blocks (the shape of reference syncervm_test.go:621)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.peer.network import AppNetwork
+from coreth_tpu.plugin import VM, Status
+from coreth_tpu.plugin.syncervm import StateSyncError, SyncSummary
+from tests.test_plugin import genesis_json, make_tx, KEY, KEY2
+
+CONFIG = json.dumps({"commit-interval": 4,
+                     "state-sync-enabled": True})
+
+
+def _clock(start=1_000):
+    t = [start]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+    return clock
+
+
+def _vm(clock=None):
+    vm = VM(**({"clock": clock} if clock else {}))
+    vm.initialize(genesis_json(), CONFIG.encode())
+    return vm
+
+
+def _grow(vm, n, start_nonce=0):
+    blocks = []
+    for i in range(n):
+        vm.issue_tx(make_tx(start_nonce + i))
+        blk = vm.build_block()
+        blk.accept()
+        blocks.append(blk)
+    return blocks
+
+
+def test_sync_summary_roundtrip():
+    s = SyncSummary(8, b"\x01" * 32, b"\x02" * 32, b"\x03" * 32)
+    assert SyncSummary.decode(s.encode()) == s
+    assert len(s.id()) == 32
+
+
+def test_server_serves_commit_height_summaries():
+    vm = _vm(_clock())
+    _grow(vm, 6)
+    summary = vm.state_sync_server.get_last_state_summary()
+    assert summary.height == 4
+    blk4 = vm.chain.get_block_by_number(4)
+    assert summary.block_hash == blk4.hash()
+    assert summary.block_root == blk4.root
+    # explicit height fetch + non-commit heights refused
+    assert vm.state_sync_server.get_state_summary(4) == summary
+    with pytest.raises(StateSyncError):
+        vm.state_sync_server.get_state_summary(3)
+
+
+def test_vm_state_sync_end_to_end():
+    """Server VM grows 10 blocks; a fresh VM syncs at the height-8
+    summary over the app network, pivots, then verifies + accepts the
+    remaining live blocks and new ones built after the sync."""
+    clock = _clock()
+    server_vm = _vm(clock)
+    _grow(server_vm, 10)
+    assert server_vm.chain.last_accepted.number == 10
+
+    net = AppNetwork()
+    net.join(b"\x01" * 20, request_handler=server_vm.app_request_handler())
+    client_peer = net.join(b"\x02" * 20)
+
+    sync_vm = _vm(clock)  # shares wall time with the server
+    summary = server_vm.state_sync_server.get_last_state_summary()
+    assert summary.height == 8
+    client = sync_vm.state_sync_client(client_peer.send_request_any)
+    client.accept_summary(client.parse_state_summary(summary.encode()))
+
+    # pivoted: tip == summary block, state resident, no execution done
+    assert sync_vm.chain.last_accepted.number == 8
+    assert sync_vm.chain.last_accepted.hash() == summary.block_hash
+    assert sync_vm.last_accepted().status == Status.ACCEPTED
+    state = sync_vm.chain.state_at(summary.block_root)
+    assert state.get_nonce(
+        __import__("tests.test_plugin", fromlist=["ADDR"]).ADDR) == 8
+    assert client.stats["blocks"] == 8  # summary block + 7 ancestors
+
+    # the synced VM now follows the live chain: catch up 9..10 and a
+    # block built after the sync
+    for height in (9, 10):
+        raw = server_vm.chain.get_block_by_number(height).encode()
+        blk = sync_vm.parse_block(raw)
+        blk.verify()
+        blk.accept()
+    server_vm.issue_tx(make_tx(10))
+    new_blk = server_vm.build_block()
+    new_blk.accept()
+    parsed = sync_vm.parse_block(new_blk.bytes())
+    parsed.verify()
+    parsed.accept()
+    assert sync_vm.chain.last_accepted.hash() == new_blk.id
+    # and it can build its own blocks on top
+    sync_vm.issue_tx(make_tx(0, key=KEY2))
+    own = sync_vm.build_block()
+    own.accept()
+    assert sync_vm.chain.last_accepted.number == 12
+
+
+def test_state_sync_includes_atomic_trie():
+    """Two atomic-enabled VMs: the server imports UTXOs across several
+    commit intervals; the syncing VM rebuilds the atomic trie from
+    leaf pages, verifies the root, and replays the ops into its own
+    shared memory (atomic_syncer.go role)."""
+    from coreth_tpu.atomic import (
+        ChainContext, EVMOutput, Memory, TransferableInput,
+        TransferableOutput, Tx, UnsignedImportTx, UTXO, short_id,
+    )
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+    from coreth_tpu.crypto.secp256k1 import _g_mul, _to_affine
+    from tests.test_plugin import ADDR
+
+    ctx = ChainContext()
+    clock = _clock()
+
+    def seed_utxo(memory, tag):
+        out = TransferableOutput(asset_id=ctx.avax_asset_id,
+                                 amount=5_000_000_000,
+                                 addrs=[short_id(_to_affine(_g_mul(KEY)))])
+        utxo = UTXO(bytes([tag]) * 32, 0, out)
+        memory.new_shared_memory(ctx.x_chain_id).apply(
+            {ctx.chain_id: Requests(put_requests=[
+                Element(utxo.input_id(), utxo.encode(), out.addrs)])})
+        return utxo, out
+
+    mem_a = Memory()
+    server_vm = VM(clock=clock,
+                   shared_memory=mem_a.new_shared_memory(ctx.chain_id),
+                   chain_ctx=ctx)
+    server_vm.initialize(genesis_json(), CONFIG.encode())
+
+    nonce = 0
+    for i in range(8):
+        if i % 2 == 0:
+            utxo, out = seed_utxo(mem_a, 0x90 + i)
+            atx = Tx(UnsignedImportTx(
+                network_id=ctx.network_id, blockchain_id=ctx.chain_id,
+                source_chain=ctx.x_chain_id,
+                imported_inputs=[TransferableInput(
+                    tx_id=utxo.tx_id, output_index=0,
+                    asset_id=out.asset_id, amount=out.amount,
+                    sig_indices=[0])],
+                outs=[EVMOutput(ADDR, 4_990_000_000,
+                                ctx.avax_asset_id)]))
+            atx.sign([[KEY]])
+            server_vm.issue_atomic_tx(atx)
+        server_vm.issue_tx(make_tx(nonce))
+        nonce += 1
+        server_vm.build_block().accept()
+    assert server_vm.atomic_backend.trie.committed_roots.get(8) \
+        is not None
+
+    net = AppNetwork()
+    net.join(b"\x01" * 20,
+             request_handler=server_vm.app_request_handler())
+    client_peer = net.join(b"\x02" * 20)
+
+    mem_b = Memory()
+    # every node's shared memory reflects the same X-chain exports, so
+    # B holds the same UTXOs A consumed; the synced ops replay their
+    # removal
+    for i in range(8):
+        if i % 2 == 0:
+            seed_utxo(mem_b, 0x90 + i)
+    sync_vm = VM(clock=clock,
+                 shared_memory=mem_b.new_shared_memory(ctx.chain_id),
+                 chain_ctx=ctx)
+    sync_vm.initialize(genesis_json(), CONFIG.encode())
+    summary = server_vm.state_sync_server.get_last_state_summary()
+    assert summary.atomic_root != b"\x00" * 32
+    client = sync_vm.state_sync_client(client_peer.send_request_any)
+    client.accept_summary(summary)
+
+    assert client.stats["atomic_leafs"] == 4  # one per import height
+    assert sync_vm.atomic_backend.trie.last_committed_root \
+        == summary.atomic_root
+    # replayed ops consumed the server-side UTXO keys in B's memory too
+    with pytest.raises(KeyError):
+        mem_b.new_shared_memory(ctx.chain_id).get(
+            ctx.x_chain_id, [UTXO(bytes([0x90]) * 32, 0,
+                                  TransferableOutput(
+                                      asset_id=ctx.avax_asset_id,
+                                      amount=5_000_000_000,
+                                      addrs=[])).input_id()])
+
+
+def test_atomic_sync_retry_is_idempotent():
+    """A sync attempt that fails after applying ops can be retried:
+    tolerant application treats already-removed keys as no-ops
+    (atomic_backend.go:373 cursor semantics)."""
+    from coreth_tpu.atomic import ChainContext, Memory
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+
+    ctx = ChainContext()
+    mem = Memory()
+    sm = mem.new_shared_memory(ctx.chain_id)
+    mem.new_shared_memory(ctx.x_chain_id).apply(
+        {ctx.chain_id: Requests(put_requests=[
+            Element(b"\x01" * 32, b"v", [b"t" * 20])])})
+    ops = {ctx.x_chain_id: Requests(remove_requests=[b"\x01" * 32])}
+    sm.apply_tolerant(ops)
+    sm.apply_tolerant(ops)  # replay: no KeyError
+    with pytest.raises(KeyError):
+        sm.get(ctx.x_chain_id, [b"\x01" * 32])
