@@ -67,26 +67,7 @@ class SharedMemory:
         crash-recovery cursor).  Accept-time application stays strict
         (apply/validate_removes) so double-spends cannot slip
         through."""
-        for peer_chain, req in requests.items():
-            inbound = self.memory._space(peer_chain, self.chain_id)
-            in_traits = self.memory._traits(peer_chain, self.chain_id)
-            in_rev = self.memory._key_traits(peer_chain, self.chain_id)
-            for k in req.remove_requests:
-                if inbound.pop(k, None) is None:
-                    continue
-                for t in in_rev.pop(k, []):
-                    lst = in_traits.get(t)
-                    if lst and k in lst:
-                        lst.remove(k)
-            out_space = self.memory._space(self.chain_id, peer_chain)
-            out_traits = self.memory._traits(self.chain_id, peer_chain)
-            out_rev = self.memory._key_traits(self.chain_id, peer_chain)
-            for el in req.put_requests:
-                if el.key not in out_space:
-                    out_rev[el.key] = list(el.traits)
-                    for t in el.traits:
-                        out_traits.setdefault(t, []).append(el.key)
-                out_space[el.key] = el.value
+        self._apply_ops(requests)
 
     def validate_removes(self, requests: Dict[bytes, Requests]) -> None:
         """Raise if any remove targets an absent key, before anything
@@ -111,12 +92,19 @@ class SharedMemory:
         rejected batch leaves shared memory untouched — atomicity is
         part of this method's contract."""
         self.validate_removes(requests)
+        self._apply_ops(requests)
+
+    def _apply_ops(self, requests: Dict[bytes, Requests]) -> None:
+        """The shared remove/put + trait-index bookkeeping; callers
+        decide the absent-remove policy (apply validates first,
+        apply_tolerant skips)."""
         for peer_chain, req in requests.items():
             inbound = self.memory._space(peer_chain, self.chain_id)
             in_traits = self.memory._traits(peer_chain, self.chain_id)
             in_rev = self.memory._key_traits(peer_chain, self.chain_id)
             for k in req.remove_requests:
-                del inbound[k]
+                if inbound.pop(k, None) is None:
+                    continue
                 for t in in_rev.pop(k, []):
                     lst = in_traits.get(t)
                     if lst and k in lst:
@@ -125,10 +113,11 @@ class SharedMemory:
             out_traits = self.memory._traits(self.chain_id, peer_chain)
             out_rev = self.memory._key_traits(self.chain_id, peer_chain)
             for el in req.put_requests:
+                if el.key not in out_space:
+                    out_rev[el.key] = list(el.traits)
+                    for t in el.traits:
+                        out_traits.setdefault(t, []).append(el.key)
                 out_space[el.key] = el.value
-                out_rev[el.key] = list(el.traits)
-                for t in el.traits:
-                    out_traits.setdefault(t, []).append(el.key)
 
 
 class Memory:

@@ -253,6 +253,7 @@ class EVM:
             return b"", addr, 0, vmerrs.ErrContractAddressCollision()
         snapshot = self.statedb.snapshot()
         self.statedb.create_account(addr)
+        self.statedb.mark_created_this_tx(addr)  # EIP-6780 book-keeping
         if self.rules.is_eip158:
             self.statedb.set_nonce(addr, 1)
         self.transfer(caller, addr, value)

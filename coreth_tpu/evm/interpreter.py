@@ -535,6 +535,7 @@ def op_sstore(interp, frame, stack, pc):
 
 
 def op_tload(interp, frame, stack, pc):
+    """EIP-1153 TLOAD (instructions.go opTload)."""
     key = stack.pop().to_bytes(32, "big")
     value = interp.evm.statedb.get_transient_state(frame.address, key)
     stack.append(int.from_bytes(value, "big"))
@@ -542,9 +543,38 @@ def op_tload(interp, frame, stack, pc):
 
 
 def op_tstore(interp, frame, stack, pc):
+    """EIP-1153 TSTORE (instructions.go opTstore)."""
     key = stack.pop().to_bytes(32, "big")
     value = stack.pop().to_bytes(32, "big")
     interp.evm.statedb.set_transient_state(frame.address, key, value)
+    return pc + 1
+
+
+def op_mcopy(interp, frame, stack, pc):
+    """EIP-5656 MCOPY: memory-to-memory copy."""
+    dst = stack.pop()
+    src = stack.pop()
+    length = stack.pop()
+    if length:
+        data = mem_read(frame.memory, src, length)
+        mem_write(frame.memory, dst, data)
+    return pc + 1
+
+
+def op_blobhash(interp, frame, stack, pc):
+    """EIP-4844 BLOBHASH: the i-th versioned blob hash of the tx, or
+    zero when out of range.  Avalanche carries no blob transactions,
+    so every index is out of range (geth opBlobHash with empty
+    BlobHashes)."""
+    stack.pop()
+    stack.append(0)
+    return pc + 1
+
+
+def op_blobbasefee(interp, frame, stack, pc):
+    """EIP-7516 BLOBBASEFEE: with zero excess blob gas (no blob
+    market on this chain) the fee sits at MIN_BLOB_GASPRICE = 1."""
+    stack.append(getattr(interp.evm.block_ctx, "blob_base_fee", 1))
     return pc + 1
 
 
@@ -740,6 +770,13 @@ def op_selfdestruct(interp, frame, stack, pc):
     beneficiary = (stack.pop() & ADDR_MASK).to_bytes(20, "big")
     db = interp.evm.statedb
     balance = db.get_balance(frame.address)
+    if interp.evm.rules.is_cancun \
+            and frame.address not in db.created_this_tx:
+        # EIP-6780: a contract not created in this tx only moves its
+        # balance; the account survives (geth opSelfdestruct6780)
+        db.sub_balance(frame.address, balance)
+        db.add_balance(beneficiary, balance)
+        raise Halt()
     db.add_balance(beneficiary, balance)
     db.suicide(frame.address)
     raise Halt()

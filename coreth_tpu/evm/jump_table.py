@@ -62,6 +62,10 @@ def mem_create(stack) -> int:  # value, offset, size
     return _ceil(stack[-2], stack[-3])
 
 
+def mem_mcopy(stack) -> int:  # dst, src, length
+    return max(_ceil(stack[-1], stack[-3]), _ceil(stack[-2], stack[-3]))
+
+
 def mem_call(stack) -> int:  # gas,to,value,inOff,inSize,outOff,outSize
     return max(_ceil(stack[-4], stack[-5]), _ceil(stack[-6], stack[-7]))
 
@@ -293,12 +297,33 @@ def new_durango_table():
     return t
 
 
+def new_cancun_table():
+    """Cancun (jump_table.go newCancunInstructionSet): transient
+    storage (EIP-1153, flat 100 gas, no refunds), MCOPY (EIP-5656),
+    BLOBHASH/BLOBBASEFEE (EIP-4844/7516 — degenerate constants on a
+    chain with no blob market), and EIP-6780 SELFDESTRUCT semantics
+    (enforced in op_selfdestruct via rules.is_cancun)."""
+    t = new_durango_table()
+    t[0x49] = Operation(I.op_blobhash, FASTEST, 1, 1)
+    t[0x4A] = Operation(I.op_blobbasefee, QUICK, 0, 1)
+    t[0x5C] = Operation(I.op_tload,
+                        P.WARM_STORAGE_READ_COST_EIP2929, 1, 1)
+    t[0x5D] = Operation(I.op_tstore,
+                        P.WARM_STORAGE_READ_COST_EIP2929, 2, 0,
+                        writes=True)
+    t[0x5E] = Operation(I.op_mcopy, FASTEST, 3, 0,
+                        dynamic_gas=G.gas_copy, memory_size=mem_mcopy)
+    return t
+
+
 _CACHE = {}
 
 
 def for_rules(rules) -> List[Optional[Operation]]:
     """Select the table for a rule set (interpreter.go:74-97)."""
-    if rules.is_durango:
+    if rules.is_cancun:
+        key = "cancun"
+    elif rules.is_durango:
         key = "durango"
     elif rules.is_apricot_phase3:
         key = "ap3"
@@ -333,6 +358,7 @@ def for_rules(rules) -> List[Optional[Operation]]:
             "ap2": new_ap2_table,
             "ap3": new_ap3_table,
             "durango": new_durango_table,
+            "cancun": new_cancun_table,
         }[key]()
     return _CACHE[key]
 

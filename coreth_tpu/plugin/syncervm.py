@@ -112,8 +112,13 @@ class StateSyncClient:
         want = min(PARENTS_TO_FETCH, summary.height)
         raws = self.client.get_blocks(summary.block_hash, summary.height,
                                       want)
-        if not raws:
-            raise StateSyncError("peer served no blocks")
+        # the serving peer produced the summary, so it must hold the
+        # full ancestor window — a short response is a bad peer, not a
+        # shallow pivot (a silent short set would truncate the history
+        # this node later serves to other syncers)
+        if len(raws) != want:
+            raise StateSyncError(
+                f"peer served {len(raws)} blocks, wanted {want}")
         blocks = [Block.decode(r) for r in raws]
         self.stats["blocks"] = len(blocks)
         return blocks  # newest first

@@ -110,6 +110,7 @@ class StateDB:
         self.logs: List[Log] = []
         self._tx_hash = HASH_ZERO
         self._tx_index = 0
+        self.created_this_tx: Set[bytes] = set()
         self._log_index = 0
         self.access_list_addresses: Set[bytes] = set()
         self.access_list_slots: Set[Tuple[bytes, bytes]] = set()
@@ -450,6 +451,17 @@ class StateDB:
     def set_tx_context(self, tx_hash: bytes, tx_index: int) -> None:
         self._tx_hash = tx_hash
         self._tx_index = tx_index
+        # per-tx contract-creation marks (EIP-6780: SELFDESTRUCT only
+        # deletes contracts created in the same transaction)
+        self.created_this_tx = set()
+
+    def mark_created_this_tx(self, addr: bytes) -> None:
+        """Journaled EIP-6780 creation mark (geth createObjectChange)."""
+        self.created_this_tx.add(addr)
+
+        def undo():
+            self.created_this_tx.discard(addr)
+        self._append_journal(undo)
 
     def add_log(self, log: Log) -> None:
         log.tx_hash = self._tx_hash

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
-"""Generate the self-pinned GeneralStateTests-format corpus.
+"""Generate the self-pinned REGRESSION corpus (GeneralStateTests
+format).
 
-Each family below builds fixtures in the upstream JSON layout with the
-expected post-state root + logs hash computed by the current
-implementation, then written to <family>.json — regression vectors
-that pin semantics (incl. exact gas, folded into the coinbase balance
-and therefore the root) against future change.  Re-run after an
-INTENTIONAL semantics change: `python tests/statetests/generate.py`.
+REGRESSION-ONLY, by construction: each family below builds fixtures
+in the upstream JSON layout with the expected post-state root + logs
+hash computed by the CURRENT implementation, then written to
+<family>.json.  They pin semantics (incl. exact gas, folded into the
+coinbase balance and therefore the root) against future change — they
+CANNOT detect existing divergence from upstream EVM semantics.  The
+independently-derived expectations live in
+tests/test_independent_vectors.py (published EIP vectors, NIST
+digests, hand-worked gas sums); upstream fixture files dropped into
+this directory also run unmodified.  Re-run after an INTENTIONAL
+semantics change: `python tests/statetests/generate.py`.
 """
 
 import json

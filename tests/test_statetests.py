@@ -1,11 +1,13 @@
-"""GeneralStateTests-format corpus gate + destruct/resurrect pinning.
+"""Self-pinned REGRESSION corpus gate + destruct/resurrect pinning.
 
 Runs every fixture in tests/statetests/ through the state-test harness
 (coreth_tpu/tests_harness.py, the state_test_util.go twin).  The
-corpus is self-generated (see generate.py) — it pins semantics
-including exact gas (folded into the coinbase balance and thus the
-root) against regression; upstream fixture files dropped into the same
-directory run unmodified.
+corpus is self-generated (see generate.py) and is regression-only: it
+pins semantics including exact gas (folded into the coinbase balance
+and thus the root) against future change, but cannot catch existing
+divergence from upstream — tests/test_independent_vectors.py carries
+the externally-derived expectations for that.  Upstream fixture files
+dropped into the same directory run unmodified.
 """
 
 import os
