@@ -62,14 +62,89 @@ class _PendingBlock:
     inputs: frozenset
 
 
+APPLY_CURSOR_KEY = b"atomicTrieApplyCursor"
+TRIE_META_KEY = b"atomicTrieRoot"  # committed root(32) ++ height(8)
+
+
 class AtomicBackend:
     def __init__(self, ctx: ChainContext, shared_memory: SharedMemory,
-                 trie: Optional[AtomicTrie] = None):
+                 trie: Optional[AtomicTrie] = None, metadata=None):
+        """metadata: dict-like or KVStore holding durable markers (the
+        versiondb role for the shared-memory apply cursor)."""
         self.ctx = ctx
         self.shared_memory = shared_memory
         self.trie = trie or AtomicTrie()
+        self.metadata = metadata if metadata is not None else {}
         # blockHash -> effect of verified, undecided blocks
         self._pending: Dict[bytes, _PendingBlock] = {}
+
+    # -------------------------------------------------------- meta helpers
+    def _meta_put(self, key: bytes, value: bytes) -> None:
+        from coreth_tpu.atomic.repository import store_put
+        store_put(self.metadata, key, value)
+
+    def _meta_delete(self, key: bytes) -> None:
+        from coreth_tpu.atomic.repository import store_delete
+        store_delete(self.metadata, key)
+
+    def save_trie_meta(self) -> None:
+        """Persist the committed atomic-trie root + height, so a
+        restart (or crash-resume) reconstructs the SAME trie the
+        durable apply cursor refers to."""
+        self._meta_put(TRIE_META_KEY,
+                       self.trie.last_committed_root
+                       + self.trie.last_committed_height.to_bytes(
+                           8, "big"))
+
+    # ------------------------------------------------- shared-memory cursor
+    def mark_apply_to_shared_memory(self, max_height: int) -> None:
+        """Durably record that every trie-indexed height <= max_height
+        must be applied to shared memory (atomic_backend.go:373
+        markApplyToSharedMemoryCursor): written BEFORE any op lands,
+        so a crash at any point leaves a resumable marker."""
+        self._meta_put(APPLY_CURSOR_KEY,
+                       (0).to_bytes(8, "big")
+                       + max_height.to_bytes(8, "big"))
+
+    def pending_apply(self) -> bool:
+        return self.metadata.get(APPLY_CURSOR_KEY) is not None
+
+    def apply_to_shared_memory(self) -> int:
+        """Perform (or resume) the marked application
+        (atomic_backend.go:252 ApplyToSharedMemory): walk the atomic
+        trie's height-keyed leaves from the cursor, apply each height
+        tolerantly (re-applying a height a crashed run already did is
+        a no-op), advance the durable cursor per height, and clear the
+        marker when done.  Returns the number of heights applied."""
+        raw = self.metadata.get(APPLY_CURSOR_KEY)
+        if raw is None:
+            return 0
+        start = int.from_bytes(raw[:8], "big")
+        max_height = int.from_bytes(raw[8:], "big")
+        from coreth_tpu.atomic.trie import decode_ops
+        from coreth_tpu.mpt import EMPTY_ROOT
+        from coreth_tpu.mpt.iterator import leaves
+        if self.trie.root() == EMPTY_ROOT and max_height > 0:
+            # the marked range cannot be covered by an empty trie —
+            # clearing the marker here would silently drop the pending
+            # ops (the exact loss the cursor exists to prevent)
+            raise AtomicTxError(
+                "apply cursor pending but atomic trie is empty; "
+                "refusing to clear the recovery marker")
+        applied = 0
+        # seek straight to the cursor (leaves() start is inclusive)
+        for key, value in leaves(self.trie.trie,
+                                 start=start.to_bytes(8, "big")):
+            height = int.from_bytes(key, "big")
+            if height > max_height:
+                break
+            self.shared_memory.apply_tolerant(decode_ops(value))
+            self._meta_put(APPLY_CURSOR_KEY,
+                           (height + 1).to_bytes(8, "big")
+                           + raw[8:])
+            applied += 1
+        self._meta_delete(APPLY_CURSOR_KEY)
+        return applied
 
     # -------------------------------------------------------------- verify
     def semantic_verify(self, tx: Tx, base_fee: Optional[int],
@@ -173,7 +248,9 @@ class AtomicBackend:
         pend = self._pending.get(block_hash)
         if pend is None:
             if height is not None:
-                self.trie.accept_trie(height)
+                committed, _ = self.trie.accept_trie(height)
+                if committed:
+                    self.save_trie_meta()
             return self.trie.root()
         # validate the shared-memory effect BEFORE mutating anything so
         # a double-spend caught by the backstop leaves trie + pending
@@ -181,7 +258,9 @@ class AtomicBackend:
         self.shared_memory.validate_removes(pend.requests)
         del self._pending[block_hash]
         self.trie.update_trie(pend.height, pend.requests)
-        self.trie.accept_trie(pend.height)
+        committed, _ = self.trie.accept_trie(pend.height)
+        if committed:
+            self.save_trie_meta()
         self.shared_memory.apply(pend.requests)
         return self.trie.root()
 

@@ -45,6 +45,9 @@ class AtomicMempool:
     def has(self, tx_id: bytes) -> bool:
         return tx_id in self._txs
 
+    def get(self, tx_id: bytes) -> Optional[Tx]:
+        return self._txs.get(tx_id)
+
     # ----------------------------------------------------------------- add
     def _gas_price(self, tx: Tx) -> float:
         gas = tx.unsigned.gas_used(True, len(tx.encode()))

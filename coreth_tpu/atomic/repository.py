@@ -1,0 +1,102 @@
+"""Accepted-atomic-tx repository, indexed by tx id and by height.
+
+Twin of reference plugin/evm/atomic_tx_repository.go: every accepted
+block's atomic txs are written under both indexes so the avax.* API
+(getAtomicTx / getAtomicTxStatus) and the atomic-trie machinery can
+resolve them.  Backed by any dict-like store (bytes -> bytes), so a
+KV-backed VM persists the index across restarts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from coreth_tpu.atomic.tx import Tx
+from coreth_tpu.atomic.wire import Packer, Unpacker
+
+_TX_PREFIX = b"atx"       # txID -> height(8) ++ tx bytes
+_HEIGHT_PREFIX = b"ath"   # height(8) -> packed list of tx bytes
+
+
+def store_put(store, key: bytes, value: bytes) -> None:
+    """Write to a dict-like or KVStore store (one shim for every
+    atomic-durability consumer)."""
+    if hasattr(store, "put"):
+        store.put(key, value)
+    else:
+        store[key] = value
+
+
+def store_delete(store, key: bytes) -> None:
+    if hasattr(store, "put"):
+        store.delete(key)
+    else:
+        store.pop(key, None)
+
+
+class PrefixedStore:
+    """Namespaced dict-like view over a shared store (the prefixdb
+    role, plugin/evm/vm.go:430) — enough surface for Trie's node_db
+    (get / [] / in)."""
+
+    def __init__(self, store, prefix: bytes):
+        self.store = store
+        self.prefix = prefix
+
+    def get(self, key, default=None):
+        v = self.store.get(self.prefix + key)
+        return v if v is not None else default
+
+    def __getitem__(self, key):
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key.hex())
+        return v
+
+    def __setitem__(self, key, value):
+        store_put(self.store, self.prefix + key, value)
+
+    def __contains__(self, key):
+        return self.get(key) is not None
+
+
+class AtomicTxRepository:
+    def __init__(self, store: Optional[dict] = None):
+        self.store = store if store is not None else {}
+
+    # ---------------------------------------------------------------- write
+    def write(self, height: int, txs: List[Tx]) -> None:
+        """Index one accepted height's atomic txs
+        (atomic_tx_repository.go Write)."""
+        if not txs:
+            return
+        p = Packer()
+        p.u32(len(txs))
+        for tx in txs:
+            raw = tx.encode()
+            p.var_bytes(raw)
+            self._put(_TX_PREFIX + tx.id(),
+                      height.to_bytes(8, "big") + raw)
+        self._put(_HEIGHT_PREFIX + height.to_bytes(8, "big"), p.bytes())
+
+    def _put(self, key: bytes, value: bytes) -> None:
+        store_put(self.store, key, value)
+
+    def _get(self, key: bytes) -> Optional[bytes]:
+        return self.store.get(key)
+
+    # ----------------------------------------------------------------- read
+    def get_by_tx_id(self, tx_id: bytes) -> Optional[Tuple[Tx, int]]:
+        """(tx, accepted height) or None (GetByTxID)."""
+        raw = self._get(_TX_PREFIX + tx_id)
+        if raw is None:
+            return None
+        return Tx.decode(raw[8:]), int.from_bytes(raw[:8], "big")
+
+    def get_by_height(self, height: int) -> List[Tx]:
+        """Atomic txs accepted at [height] (GetByHeight)."""
+        raw = self._get(_HEIGHT_PREFIX + height.to_bytes(8, "big"))
+        if raw is None:
+            return []
+        u = Unpacker(raw)
+        return [Tx.decode(u.var_bytes()) for _ in range(u.u32())]

@@ -79,6 +79,25 @@ class VMServer:
             return {}
         if method == "atomicMempoolStats":
             return vm.atomic_mempool_stats()
+        if method == "avax.getAtomicTx":
+            hit = vm.get_atomic_tx(bytes.fromhex(params["txID"]))
+            if hit is None:
+                return {"status": "Unknown"}
+            tx, height = hit
+            return {"tx": tx.encode().hex(),
+                    "blockHeight": height,
+                    "status": "Accepted" if height is not None
+                    else "Processing"}
+        if method == "avax.getAtomicTxStatus":
+            return {"status": vm.get_atomic_tx_status(
+                bytes.fromhex(params["txID"]))}
+        if method == "avax.getUTXOs":
+            utxos = vm.get_utxos(
+                [bytes.fromhex(a) for a in params["addresses"]],
+                bytes.fromhex(params["sourceChain"]),
+                limit=int(params.get("limit", 100)))
+            return {"numFetched": len(utxos),
+                    "utxos": [u.hex() for u in utxos]}
         if method == "blockVerify":
             blk = vm.get_block(bytes.fromhex(params["id"]))
             blk.verify()
@@ -215,6 +234,18 @@ class VMClient:
 
     def atomic_mempool_stats(self):
         return self.call("atomicMempoolStats")
+
+    def get_atomic_tx(self, tx_id: bytes):
+        return self.call("avax.getAtomicTx", txID=tx_id.hex())
+
+    def get_atomic_tx_status(self, tx_id: bytes):
+        return self.call("avax.getAtomicTxStatus",
+                         txID=tx_id.hex())["status"]
+
+    def get_utxos(self, addresses, source_chain: bytes, limit=100):
+        return self.call("avax.getUTXOs",
+                         addresses=[a.hex() for a in addresses],
+                         sourceChain=source_chain.hex(), limit=limit)
 
     def poll_engine_message(self):
         return self.call("pollEngineMessage")["message"]

@@ -130,10 +130,14 @@ class StateSyncClient:
         backend = self.vm.atomic_backend
         if backend is None or summary.atomic_root == b"\x00" * 32:
             return
-        from coreth_tpu.atomic.trie import AtomicTrie, decode_ops
+        from coreth_tpu.atomic.trie import AtomicTrie
         from coreth_tpu.sync.messages import ATOMIC_TRIE_NODE
-        synced = AtomicTrie(commit_interval=backend.trie.commit_interval)
-        leaves = []
+        # rebuilt over the SAME (durable) node store as the backend's
+        # trie, so the synced trie and the apply cursor survive a
+        # crash between sync and full application
+        synced = AtomicTrie(node_db=backend.trie.node_db,
+                            commit_interval=backend.trie.commit_interval)
+        n = 0
         start = b""
         while True:
             keys, vals, more = self.client.get_leafs(
@@ -141,7 +145,7 @@ class StateSyncClient:
                 node_type=ATOMIC_TRIE_NODE)
             for k, v in zip(keys, vals):
                 synced.trie.update(k, v)
-                leaves.append(v)
+                n += 1
             if not more or not keys:
                 break
             start = _next_key(keys[-1])
@@ -149,16 +153,18 @@ class StateSyncClient:
         if root != summary.atomic_root:
             raise StateSyncError(
                 f"atomic trie root mismatch: {root.hex()}")
-        # apply ONLY after the full trie verified, and tolerantly —
-        # a retried sync must not trip over removes an earlier attempt
-        # already performed (atomic_backend.go:373 cursor semantics)
-        for v in leaves:
-            backend.shared_memory.apply_tolerant(decode_ops(v))
         synced.last_committed_root = root
         synced.last_committed_height = summary.height
         synced.committed_roots[summary.height] = root
         backend.trie = synced
-        self.stats["atomic_leafs"] = len(leaves)
+        backend.save_trie_meta()
+        # apply ONLY after the full trie verified, through the durable
+        # cursor (atomic_backend.go:252/:373): a crash mid-apply leaves
+        # a marker the VM resumes from at the next initialize, and
+        # tolerant per-height application makes the replay idempotent
+        backend.mark_apply_to_shared_memory(summary.height)
+        backend.apply_to_shared_memory()
+        self.stats["atomic_leafs"] = n
 
     def _sync_state_trie(self, summary: SyncSummary) -> None:
         """syncStateTrie (:298): verified-range download of the full

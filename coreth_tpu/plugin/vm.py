@@ -38,12 +38,18 @@ class VM:
     """Consensus-driven EVM execution engine (vm.go:242)."""
 
     def __init__(self, clock=_time.time, shared_memory=None,
-                 chain_ctx=None):
+                 chain_ctx=None, atomic_store=None):
         """shared_memory/chain_ctx: supplying an atomic.SharedMemory
         (and optionally a ChainContext) wires the full atomic subsystem
         — backend, mempool, ExtData packing at build, accept-time
-        shared-memory application (vm.go:986 / :979 / block.go:177)."""
+        shared-memory application (vm.go:986 / :979 / block.go:177).
+        atomic_store: durable dict/KVStore for the atomic tx
+        repository + the shared-memory apply cursor (the versiondb
+        role); pass the same store across restarts for recovery."""
         self.clock = clock
+        self.atomic_store = atomic_store if atomic_store is not None \
+            else {}
+        self.atomic_repository = None
         self.initialized = False
         self.chain: Optional[BlockChain] = None
         self.txpool: Optional[TxPool] = None
@@ -87,11 +93,36 @@ class VM:
             from coreth_tpu.consensus.engine import DummyEngine
             ctx = self.chain_ctx or ChainContext()
             self.chain_ctx = ctx
+            from coreth_tpu.atomic.backend import TRIE_META_KEY
             from coreth_tpu.atomic.trie import AtomicTrie
+            from coreth_tpu.atomic.repository import (
+                AtomicTxRepository, PrefixedStore,
+            )
+            from coreth_tpu.mpt import EMPTY_ROOT
+            # the atomic trie's nodes live in the durable store (its
+            # committed root persisted alongside), so the apply cursor
+            # always has the trie it refers to after a restart
+            meta = self.atomic_store.get(TRIE_META_KEY)
+            trie = AtomicTrie(
+                node_db=PrefixedStore(self.atomic_store, b"an"),
+                root=meta[:32] if meta else EMPTY_ROOT,
+                commit_interval=self.config.commit_interval)
+            if meta:
+                trie.last_committed_root = meta[:32]
+                trie.last_committed_height = int.from_bytes(meta[32:],
+                                                            "big")
+                trie.committed_roots[trie.last_committed_height] = \
+                    meta[:32]
             self.atomic_backend = AtomicBackend(
-                ctx, self.shared_memory,
-                trie=AtomicTrie(
-                    commit_interval=self.config.commit_interval))
+                ctx, self.shared_memory, trie=trie,
+                metadata=self.atomic_store)
+            self.atomic_repository = AtomicTxRepository(
+                self.atomic_store)
+            if self.atomic_backend.pending_apply():
+                # crashed mid-ApplyToSharedMemory: resume from the
+                # durable cursor before serving anything (vm.go init
+                # path -> atomic_backend.go:252)
+                self.atomic_backend.apply_to_shared_memory()
             self.atomic_mempool = AtomicMempool(ctx)
             cb = make_callbacks(self.atomic_backend, genesis.config,
                                 pending_atomic_txs=self._pending_atomic)
@@ -224,6 +255,8 @@ class VM:
             self.atomic_backend.accept(blk.id, height=blk.height)
             txs = decode_ext_data(blk.block.ext_data())
             if txs:
+                # index by tx id + height (atomic_tx_repository.go)
+                self.atomic_repository.write(blk.height, txs)
                 self.atomic_mempool.remove_accepted(
                     [t.id() for t in txs])
                 # local txs spending the same UTXOs can never be valid
@@ -349,3 +382,41 @@ class VM:
         if pool is None:
             return {"pending": 0, "total": 0}
         return {"pending": pool.pending_len(), "total": len(pool)}
+
+    # ------------------------------------------------------- avax queries
+    def get_atomic_tx(self, tx_id: bytes):
+        """(tx, accepted height | None) or None (service.go
+        GetAtomicTx): accepted txs resolve through the repository,
+        mempool txs with no height."""
+        self._require_init()
+        if self.atomic_repository is not None:
+            hit = self.atomic_repository.get_by_tx_id(tx_id)
+            if hit is not None:
+                return hit
+        if self.atomic_mempool is not None:
+            tx = self.atomic_mempool.get(tx_id)
+            if tx is not None:
+                return tx, None
+        return None
+
+    def get_atomic_tx_status(self, tx_id: bytes) -> str:
+        """Accepted | Processing | Unknown (service.go
+        GetAtomicTxStatus)."""
+        self._require_init()
+        if self.atomic_repository is not None \
+                and self.atomic_repository.get_by_tx_id(tx_id):
+            return "Accepted"
+        if self.atomic_mempool is not None \
+                and self.atomic_mempool.has(tx_id):
+            return "Processing"
+        return "Unknown"
+
+    def get_utxos(self, addresses, source_chain: bytes,
+                  limit: int = 100):
+        """UTXOs in this chain's inbound shared memory owned by the
+        given short-id addresses (service.go:506 GetUTXOs)."""
+        self._require_init()
+        if self.shared_memory is None:
+            return []
+        return self.shared_memory.indexed(source_chain, list(addresses),
+                                          limit=limit)

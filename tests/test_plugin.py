@@ -328,3 +328,190 @@ def test_engine_publishes_metrics():
     engine.publish_metrics(reg)
     snap = reg.snapshot()
     assert "replay/t_device" in snap and "replay/blocks_device" in snap
+
+
+def test_avax_service_queries(tmp_path):
+    """avax.getUTXOs / getAtomicTx / getAtomicTxStatus over the
+    socket boundary (reference service.go:506 surface)."""
+    from coreth_tpu.atomic import (
+        ChainContext, EVMOutput, Memory, TransferableInput,
+        TransferableOutput, Tx, UnsignedImportTx, UTXO, short_id,
+    )
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+    from coreth_tpu.crypto.secp256k1 import _g_mul, _to_affine
+
+    ctx = ChainContext()
+    memory = Memory()
+    owner = short_id(_to_affine(_g_mul(KEY)))
+    out = TransferableOutput(asset_id=ctx.avax_asset_id,
+                             amount=5_000_000_000, addrs=[owner])
+    utxo = UTXO(b"\x93" * 32, 0, out)
+    memory.new_shared_memory(ctx.x_chain_id).apply(
+        {ctx.chain_id: Requests(put_requests=[
+            Element(utxo.input_id(), utxo.encode(), out.addrs)])})
+    vm = VM(shared_memory=memory.new_shared_memory(ctx.chain_id),
+            chain_ctx=ctx)
+    sock = str(tmp_path / "vm.sock")
+    server = serve(vm, sock)
+    try:
+        client = VMClient(sock)
+        client.initialize(genesis_json())
+        # the seeded UTXO is discoverable by owner address
+        got = client.get_utxos([owner], ctx.x_chain_id)
+        assert got["numFetched"] == 1
+        assert got["utxos"][0] == utxo.encode().hex()
+
+        atx = Tx(UnsignedImportTx(
+            network_id=ctx.network_id, blockchain_id=ctx.chain_id,
+            source_chain=ctx.x_chain_id,
+            imported_inputs=[TransferableInput(
+                tx_id=utxo.tx_id, output_index=0,
+                asset_id=out.asset_id, amount=out.amount,
+                sig_indices=[0])],
+            outs=[EVMOutput(ADDR, 4_990_000_000, ctx.avax_asset_id)]))
+        atx.sign([[KEY]])
+        assert client.get_atomic_tx_status(atx.id()) == "Unknown"
+        client.issue_atomic_tx(atx.encode())
+        assert client.get_atomic_tx_status(atx.id()) == "Processing"
+        built = client.build_block()
+        client.block_accept(bytes.fromhex(built["id"]))
+        assert client.get_atomic_tx_status(atx.id()) == "Accepted"
+        info = client.get_atomic_tx(atx.id())
+        assert info["status"] == "Accepted"
+        assert info["blockHeight"] == 1
+        assert info["tx"] == atx.encode().hex()
+        # consumed UTXO disappears from getUTXOs
+        assert client.get_utxos([owner],
+                                ctx.x_chain_id)["numFetched"] == 0
+        client.close()
+    finally:
+        server.close()
+
+
+def test_shared_memory_apply_cursor_crash_resume():
+    """VM 'restart' mid-ApplyToSharedMemory resumes from the durable
+    cursor without double-applying (atomic_backend.go:252/:373)."""
+    from coreth_tpu.atomic import ChainContext, Memory
+    from coreth_tpu.atomic.backend import APPLY_CURSOR_KEY, AtomicBackend
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+    from coreth_tpu.atomic.trie import AtomicTrie, encode_ops, height_key
+
+    ctx = ChainContext()
+    memory = Memory()
+    sm = memory.new_shared_memory(ctx.chain_id)
+    store = {}  # the durable versiondb role, shared across "restarts"
+
+    # an atomic trie with removes at heights 1..4; seed those UTXOs
+    trie = AtomicTrie()
+    for h in range(1, 5):
+        key = bytes([h]) * 32
+        memory.new_shared_memory(ctx.x_chain_id).apply(
+            {ctx.chain_id: Requests(put_requests=[
+                Element(key, b"v%d" % h, [b"t" * 20])])})
+        trie.trie.update(height_key(h), encode_ops(
+            {ctx.x_chain_id: Requests(remove_requests=[key])}))
+
+    backend = AtomicBackend(ctx, sm, trie=trie, metadata=store)
+    backend.mark_apply_to_shared_memory(4)
+    # simulate the crash: apply only heights 1..2 manually, advancing
+    # the cursor the way apply_to_shared_memory does, then "die"
+    from coreth_tpu.atomic.trie import decode_ops
+    for h in (1, 2):
+        sm.apply_tolerant(decode_ops(trie.get(h)))
+        store[APPLY_CURSOR_KEY] = (h + 1).to_bytes(8, "big") \
+            + (4).to_bytes(8, "big")
+    del backend
+
+    # restart: a fresh backend over the same durable store resumes
+    backend2 = AtomicBackend(ctx, sm, trie=trie, metadata=store)
+    assert backend2.pending_apply()
+    applied = backend2.apply_to_shared_memory()
+    assert applied == 2  # only heights 3..4
+    assert not backend2.pending_apply()
+    for h in range(1, 5):
+        with pytest.raises(KeyError):
+            sm.get(ctx.x_chain_id, [bytes([h]) * 32])
+    # idempotent: nothing pending, nothing re-applied
+    assert backend2.apply_to_shared_memory() == 0
+
+
+def test_vm_restart_resumes_pending_apply():
+    """Full-VM shape of the crash-resume: a VM with a durable
+    atomic_store commits its atomic trie, 'crashes' with an apply
+    cursor pending, and a REBUILT VM over the same store + shared
+    memory resumes the application at initialize — the trie itself
+    reconstructs from the durable node store."""
+    import json as _json
+    from coreth_tpu.atomic import (
+        ChainContext, EVMOutput, Memory, TransferableInput,
+        TransferableOutput, Tx, UnsignedImportTx, UTXO, short_id,
+    )
+    from coreth_tpu.atomic.backend import APPLY_CURSOR_KEY
+    from coreth_tpu.atomic.shared_memory import Element, Requests
+    from coreth_tpu.crypto.secp256k1 import _g_mul, _to_affine
+
+    ctx = ChainContext()
+    memory = Memory()
+    store = {}
+    config = _json.dumps({"commit-interval": 2}).encode()
+    owner = short_id(_to_affine(_g_mul(KEY)))
+
+    def seed(tag):
+        out = TransferableOutput(asset_id=ctx.avax_asset_id,
+                                 amount=5_000_000_000, addrs=[owner])
+        utxo = UTXO(bytes([tag]) * 32, 0, out)
+        memory.new_shared_memory(ctx.x_chain_id).apply(
+            {ctx.chain_id: Requests(put_requests=[
+                Element(utxo.input_id(), utxo.encode(), out.addrs)])})
+        return utxo, out
+
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    vm = VM(clock=clock,
+            shared_memory=memory.new_shared_memory(ctx.chain_id),
+            chain_ctx=ctx, atomic_store=store)
+    vm.initialize(genesis_json(), config)
+    for i, tag in enumerate((0xA1, 0xA2)):
+        utxo, out = seed(tag)
+        atx = Tx(UnsignedImportTx(
+            network_id=ctx.network_id, blockchain_id=ctx.chain_id,
+            source_chain=ctx.x_chain_id,
+            imported_inputs=[TransferableInput(
+                tx_id=utxo.tx_id, output_index=0,
+                asset_id=out.asset_id, amount=out.amount,
+                sig_indices=[0])],
+            outs=[EVMOutput(ADDR, 4_990_000_000, ctx.avax_asset_id)]))
+        atx.sign([[KEY]])
+        vm.issue_atomic_tx(atx)
+        vm.build_block().accept()
+    # both heights committed (interval=2) and the trie meta persisted
+    assert any(k == b"atomicTrieRoot" for k in store)
+
+    # 'crash': re-seed the consumed UTXOs in shared memory (the state
+    # a replayed application must re-consume) and leave a pending
+    # cursor covering heights 1..2 in the durable store
+    for tag in (0xA1, 0xA2):
+        seed(tag)
+    store[APPLY_CURSOR_KEY] = (0).to_bytes(8, "big") \
+        + (2).to_bytes(8, "big")
+    del vm
+
+    vm2 = VM(clock=clock,
+             shared_memory=memory.new_shared_memory(ctx.chain_id),
+             chain_ctx=ctx, atomic_store=store)
+    vm2.initialize(genesis_json(), config)
+    # resume happened at initialize: cursor cleared, UTXOs re-consumed
+    assert not vm2.atomic_backend.pending_apply()
+    for tag in (0xA1, 0xA2):
+        out = TransferableOutput(asset_id=ctx.avax_asset_id,
+                                 amount=5_000_000_000, addrs=[owner])
+        with pytest.raises(KeyError):
+            memory.new_shared_memory(ctx.chain_id).get(
+                ctx.x_chain_id,
+                [UTXO(bytes([tag]) * 32, 0, out).input_id()])
+    # and the reconstructed trie matches the committed meta
+    assert vm2.atomic_backend.trie.last_committed_height == 2
