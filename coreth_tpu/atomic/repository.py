@@ -28,7 +28,7 @@ def store_put(store, key: bytes, value: bytes) -> None:
 
 
 def store_delete(store, key: bytes) -> None:
-    if hasattr(store, "put"):
+    if hasattr(store, "delete"):
         store.delete(key)
     else:
         store.pop(key, None)

@@ -21,10 +21,10 @@ __all__ = [
 ]
 
 
-def new_rpc_stack(chain, txpool=None):
+def new_rpc_stack(chain, txpool=None, bloom_section_size=None):
     """Assemble a served API stack (eth/backend.go APIs() role):
     returns (server, backend)."""
-    backend = Backend(chain, txpool)
+    backend = Backend(chain, txpool, bloom_section_size)
     server = RPCServer()
     register_eth_api(server, backend)
     register_debug_api(server, backend)

@@ -23,7 +23,7 @@ from coreth_tpu.types import Block, LatestSigner, Receipt, Transaction
 
 
 class Backend:
-    def __init__(self, chain, txpool=None):
+    def __init__(self, chain, txpool=None, bloom_section_size=None):
         self.chain = chain
         self.txpool = txpool
         self.config = chain.config
@@ -31,6 +31,26 @@ class Backend:
         # tx hash -> (block hash, index); filled lazily per block
         self._tx_lookup: dict = {}
         self._indexed_height = -1
+        # sectioned bloom index over accepted blocks (core/bloombits +
+        # chain_indexer.go role): backfill what is already accepted,
+        # then follow the accepted feed
+        from coreth_tpu.rpc.bloombits import BloomIndexer, SECTION_SIZE
+        self.bloom_indexer = BloomIndexer(
+            bloom_section_size or SECTION_SIZE)
+        for n in range(1, chain.last_accepted.number + 1):
+            b = chain.get_block_by_number(n)
+            if b is None:
+                # pruned/state-synced history: skip ahead so the live
+                # feed still indexes (gapped sections never finish and
+                # are never served — no false negatives)
+                self.bloom_indexer.next_block = \
+                    chain.last_accepted.number + 1
+                break
+            self.bloom_indexer.add_bloom(n, b.header.bloom)
+        if hasattr(chain, "subscribe_chain_accepted"):
+            chain.subscribe_chain_accepted(
+                lambda blk, _r: self.bloom_indexer.add_bloom(
+                    blk.number, blk.header.bloom))
 
     # ------------------------------------------------------------- blocks
     def resolve_block(self, tag) -> Block:

@@ -1,0 +1,363 @@
+"""WebSocket JSON-RPC transport + eth_subscribe.
+
+Twin of reference rpc/websocket.go (RFC 6455 server carrying the same
+JSON-RPC 2.0 dispatch as HTTP) and eth/filters/filter_system.go's
+subscription API: eth_subscribe("newHeads") pushes header summaries on
+chain-head events; eth_subscribe("logs", criteria) pushes matching
+logs as blocks are accepted; eth_unsubscribe tears down.
+
+Implemented from the RFC against the standard library only: handshake
+(Sec-WebSocket-Accept = b64(sha1(key + GUID))), masked client frames,
+unmasked server frames, ping/pong, close.  Notifications originate on
+chain threads (consensus + acceptor), so each connection serializes
+its writes behind a lock.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+# ------------------------------------------------------------ frame codec
+
+def _encode_frame(opcode: int, payload: bytes) -> bytes:
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < (1 << 16):
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    return head + payload
+
+
+def _read_exact(rfile, n: int) -> bytes:
+    data = b""
+    while len(data) < n:
+        chunk = rfile.read(n - len(data))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        data += chunk
+    return data
+
+
+def _decode_frame(rfile):
+    """(opcode, payload); unmasks client frames."""
+    b0, b1 = _read_exact(rfile, 2)
+    opcode = b0 & 0x0F
+    masked = b1 & 0x80
+    n = b1 & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", _read_exact(rfile, 2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", _read_exact(rfile, 8))[0]
+    mask = _read_exact(rfile, 4) if masked else b"\x00" * 4
+    payload = _read_exact(rfile, n)
+    if masked:
+        payload = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+    return opcode, payload
+
+
+# --------------------------------------------------------- subscriptions
+
+class SubscriptionManager:
+    """filter_system.go role: fan chain events out to live WS
+    subscriptions."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self._subs: Dict[str, dict] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+        chain = backend.chain
+        if hasattr(chain, "subscribe_chain_head"):
+            chain.subscribe_chain_head(self._on_head)
+        if hasattr(chain, "subscribe_chain_accepted"):
+            chain.subscribe_chain_accepted(self._on_accepted)
+
+    def subscribe(self, kind: str, criteria: Optional[dict],
+                  send) -> str:
+        if kind not in ("newHeads", "logs"):
+            raise ValueError(f"unsupported subscription {kind!r}")
+        # parse criteria HERE, on the client's request thread — the
+        # delivery path runs on chain threads, where a malformed hex
+        # string must never be able to surface (it would poison the
+        # chain's acceptor)
+        addresses, topics = [], []
+        if kind == "logs":
+            from coreth_tpu.rpc.hexutil import to_bytes as _hx
+            crit = criteria or {}
+            raw_addr = crit.get("address")
+            if isinstance(raw_addr, list):
+                addresses = [_hx(a) for a in raw_addr]
+            elif raw_addr:
+                addresses = [_hx(raw_addr)]
+            topics = [[_hx(t) for t in
+                       (pos if isinstance(pos, list) else [pos])]
+                      if pos else []
+                      for pos in crit.get("topics", [])]
+        with self._lock:
+            self._next += 1
+            sid = hex(self._next)
+            self._subs[sid] = {"kind": kind, "addresses": addresses,
+                               "topics": topics, "send": send}
+        return sid
+
+    def unsubscribe(self, sid: str) -> bool:
+        with self._lock:
+            return self._subs.pop(sid, None) is not None
+
+    def drop_sender(self, send) -> None:
+        with self._lock:
+            dead = [sid for sid, s in self._subs.items()
+                    if s["send"] is send]
+            for sid in dead:
+                del self._subs[sid]
+
+    # ------------------------------------------------------------- events
+    def _push(self, sid: str, sub: dict, result) -> None:
+        msg = {"jsonrpc": "2.0", "method": "eth_subscription",
+               "params": {"subscription": sid, "result": result}}
+        try:
+            sub["send"](json.dumps(msg))
+        except Exception:  # noqa: BLE001 — dead socket: drop the sub
+            self.unsubscribe(sid)
+
+    def _on_head(self, block) -> None:
+        head = {
+            "number": hex(block.number),
+            "hash": "0x" + block.hash().hex(),
+            "parentHash": "0x" + block.header.parent_hash.hex(),
+            "stateRoot": "0x" + block.root.hex(),
+            "timestamp": hex(block.time),
+            "gasUsed": hex(block.header.gas_used),
+            "gasLimit": hex(block.gas_limit),
+        }
+        with self._lock:
+            subs = list(self._subs.items())
+        for sid, sub in subs:
+            if sub["kind"] == "newHeads":
+                self._push(sid, sub, head)
+
+    def _on_accepted(self, block, receipts) -> None:
+        from coreth_tpu.rpc.filters import _match_log
+        with self._lock:
+            subs = [(sid, s) for sid, s in self._subs.items()
+                    if s["kind"] == "logs"]
+        if not subs or not receipts:
+            return
+        for sid, sub in subs:
+            addresses = sub["addresses"]
+            topics = sub["topics"]
+            for r in receipts:
+                for log in r.logs:
+                    if _match_log(log, addresses, topics):
+                        self._push(sid, sub, {
+                            "address": "0x" + log.address.hex(),
+                            "topics": ["0x" + t.hex()
+                                       for t in log.topics],
+                            "data": "0x" + log.data.hex(),
+                            "blockNumber": hex(block.number),
+                            "blockHash": "0x" + block.hash().hex(),
+                            "transactionHash": "0x" + log.tx_hash.hex()
+                            if log.tx_hash else None,
+                            "logIndex": hex(log.index or 0),
+                        })
+
+
+# ---------------------------------------------------------------- server
+
+class WSServer:
+    """Serves an RPCServer's method surface over WebSocket, plus the
+    eth_subscribe/eth_unsubscribe pair (rpc/websocket.go role)."""
+
+    def __init__(self, rpc_server, backend):
+        self.rpc = rpc_server
+        self.subs = SubscriptionManager(backend)
+        self._server = None
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        ws = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):  # noqa: A003
+                if not ws._handshake(self.rfile, self.wfile):
+                    return
+                wlock = threading.Lock()
+
+                def send(text: str) -> None:
+                    with wlock:
+                        self.wfile.write(_encode_frame(
+                            OP_TEXT, text.encode()))
+                        self.wfile.flush()
+
+                try:
+                    while True:
+                        opcode, payload = _decode_frame(self.rfile)
+                        if opcode == OP_CLOSE:
+                            with wlock:
+                                self.wfile.write(
+                                    _encode_frame(OP_CLOSE, b""))
+                            return
+                        if opcode == OP_PING:
+                            with wlock:
+                                self.wfile.write(
+                                    _encode_frame(OP_PONG, payload))
+                            continue
+                        if opcode != OP_TEXT:
+                            continue
+                        resp = ws._dispatch(payload, send)
+                        if resp is not None:
+                            send(json.dumps(resp))
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    ws.subs.drop_sender(send)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # ----------------------------------------------------------- plumbing
+    def _handshake(self, rfile, wfile) -> bool:
+        request = rfile.readline()
+        if not request.startswith(b"GET"):
+            return False
+        key = None
+        while True:
+            line = rfile.readline().strip()
+            if not line:
+                break
+            name, _, value = line.partition(b":")
+            if name.strip().lower() == b"sec-websocket-key":
+                key = value.strip()
+        if key is None:
+            return False
+        accept = base64.b64encode(
+            hashlib.sha1(key + _GUID).digest()).decode()
+        wfile.write((
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept}\r\n\r\n").encode())
+        wfile.flush()
+        return True
+
+    def _dispatch(self, payload: bytes, send):
+        try:
+            req = json.loads(payload)
+        except Exception:  # noqa: BLE001
+            return {"jsonrpc": "2.0", "id": None,
+                    "error": {"code": -32700, "message": "parse error"}}
+        method = req.get("method")
+        rid = req.get("id")
+        params = req.get("params", [])
+        if method in ("eth_subscribe", "eth_unsubscribe"):
+            if not isinstance(params, list) or not params:
+                return {"jsonrpc": "2.0", "id": rid,
+                        "error": {"code": -32602,
+                                  "message": "missing params"}}
+        if method == "eth_subscribe":
+            criteria = params[1] if len(params) > 1 else None
+            try:
+                sid = self.subs.subscribe(params[0], criteria, send)
+            except Exception as e:  # noqa: BLE001 — bad kind/criteria
+                return {"jsonrpc": "2.0", "id": rid,
+                        "error": {"code": -32602, "message": str(e)}}
+            return {"jsonrpc": "2.0", "id": rid, "result": sid}
+        if method == "eth_unsubscribe":
+            return {"jsonrpc": "2.0", "id": rid,
+                    "result": self.subs.unsubscribe(params[0])}
+        return self.rpc.handle_request(req)
+
+
+class WSClient:
+    """Minimal test client: handshake + frame codec over one socket."""
+
+    def __init__(self, host: str, port: int):
+        self.sock = socket.create_connection((host, port))
+        self._file = self.sock.makefile("rwb")
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        self._file.write((
+            f"GET / HTTP/1.1\r\nHost: {host}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        self._file.flush()
+        status = self._file.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"handshake refused: {status!r}")
+        while self._file.readline().strip():
+            pass
+        self._next = 0
+
+    def send_json(self, obj) -> None:
+        payload = json.dumps(obj).encode()
+        mask = b"\x12\x34\x56\x78"
+        masked = bytes(c ^ mask[i % 4]
+                       for i, c in enumerate(payload))
+        n = len(payload)
+        if n < 126:
+            head = bytes([0x81, 0x80 | n])
+        else:
+            head = bytes([0x81, 0x80 | 126]) + struct.pack(">H", n)
+        self._file.write(head + mask + masked)
+        self._file.flush()
+
+    def recv_json(self, timeout: float = 5.0):
+        self.sock.settimeout(timeout)
+        opcode, payload = _decode_frame(self._file)
+        if opcode == OP_CLOSE:
+            raise ConnectionError("closed")
+        return json.loads(payload)
+
+    def call(self, method: str, *params):
+        self._next += 1
+        self.send_json({"jsonrpc": "2.0", "id": self._next,
+                        "method": method, "params": list(params)})
+        while True:
+            msg = self.recv_json()
+            if msg.get("id") == self._next:
+                if "error" in msg:
+                    raise RuntimeError(msg["error"])
+                return msg["result"]
+
+    def next_notification(self, timeout: float = 5.0):
+        while True:
+            msg = self.recv_json(timeout)
+            if msg.get("method") == "eth_subscription":
+                return msg["params"]
+
+    def close(self) -> None:
+        try:
+            self._file.write(_encode_frame(OP_CLOSE, b""))
+            self._file.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        self.sock.close()

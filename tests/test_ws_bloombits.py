@@ -1,0 +1,258 @@
+"""Bloombits sectioned log index + WebSocket subscriptions.
+
+Mirrors reference core/bloombits + eth/filters fast path (log query
+cost sublinear in chain length) and rpc/websocket.go +
+filter_system.go eth_subscribe over a live socket.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount, generate_chain
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.rpc import new_rpc_stack
+from coreth_tpu.rpc.bloombits import BloomIndexer, bloom_bit_indices
+from coreth_tpu.rpc.filters import filter_logs
+from coreth_tpu.rpc.websocket import WSClient, WSServer
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+from coreth_tpu.workloads.erc20 import (
+    TRANSFER_TOPIC, token_genesis_account, transfer_calldata,
+)
+
+GWEI = 10**9
+KEY = 0xB100B
+ADDR = priv_to_address(KEY)
+ADDR2 = priv_to_address(0xB200B)
+TOKEN = bytes([0x7C]) * 20
+
+# token-transfer txs only in these blocks; plain value txs elsewhere
+LOG_BLOCKS = {3, 17, 42, 55, 63}
+N_BLOCKS = 64  # 4 sections of 16
+
+
+def _build_chain():
+    alloc = {ADDR: GenesisAccount(balance=10**24)}
+    alloc[TOKEN] = token_genesis_account({ADDR: 10**20})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonce = [0]
+
+    def gen(i, bg):
+        number = i + 1
+        if number in LOG_BLOCKS:
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonce[0],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                gas=100_000, to=TOKEN, value=0,
+                data=transfer_calldata(ADDR2, 5)), KEY, CFG.chain_id))
+        else:
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonce[0],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                gas=21_000, to=ADDR2, value=1), KEY, CFG.chain_id))
+        nonce[0] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, db, N_BLOCKS, gen, gap=2)
+    return genesis, blocks
+
+
+@pytest.fixture(scope="module")
+def stack():
+    genesis, blocks = _build_chain()
+    chain = BlockChain(genesis)
+    chain.insert_chain(blocks)
+    chain.drain_acceptor_queue()
+    server, backend = new_rpc_stack(chain, bloom_section_size=16)
+    return server, backend, chain, blocks
+
+
+def test_bloom_bit_indices_are_header_bloom_bits():
+    # consistency with the header bloom: every indexed bit of a value
+    # must be set in a bloom containing it
+    from coreth_tpu.types.receipt import bloom9
+    v = b"\x12" * 20
+    n = bloom9(v)
+    for i in bloom_bit_indices(v):
+        assert (n >> i) & 1
+
+
+def test_indexer_candidates_exact(stack):
+    """The sectioned index finds exactly the log-bearing blocks for
+    the token-address criterion (no false negatives; false positives
+    allowed but absent at this scale)."""
+    server, backend, chain, blocks = stack
+    idx = BloomIndexer(section_size=16)
+    for b in blocks:
+        idx.add_bloom(b.number, b.header.bloom)
+    assert idx.indexed_until == 64 - 1  # sections 0..3 finished
+    got = idx.candidates(1, 64, [[TOKEN]])
+    assert set(got) >= LOG_BLOCKS
+    assert len(got) <= len(LOG_BLOCKS) + 2  # bloom noise bound
+    # topic criterion composes (AND across groups)
+    got2 = idx.candidates(1, 64, [[TOKEN], [TRANSFER_TOPIC]])
+    assert set(got2) >= LOG_BLOCKS and len(got2) <= len(got)
+    # range clipping
+    assert set(idx.candidates(10, 50, [[TOKEN]])) & LOG_BLOCKS \
+        == {17, 42}
+
+
+def test_backend_indexer_follows_accepted_feed(stack):
+    server, backend, chain, blocks = stack
+    # the backend backfilled every accepted block at construction
+    assert backend.bloom_indexer.next_block == N_BLOCKS + 1
+
+
+def test_fast_path_equals_linear_scan(stack):
+    """eth_getLogs through the sectioned index returns byte-identical
+    results to the pure linear walk."""
+    server, backend, chain, blocks = stack
+    fast = filter_logs(backend, 1, N_BLOCKS, [TOKEN], [[TRANSFER_TOPIC]])
+    # force the linear path by hiding the indexer
+    saved = backend.bloom_indexer
+    backend.bloom_indexer = None
+    try:
+        slow = filter_logs(backend, 1, N_BLOCKS, [TOKEN],
+                           [[TRANSFER_TOPIC]])
+    finally:
+        backend.bloom_indexer = saved
+    assert fast == slow
+    assert len(fast) == len(LOG_BLOCKS)
+    assert {int(l["blockNumber"], 16) for l in fast} == LOG_BLOCKS
+
+
+def test_query_cost_sublinear(stack):
+    """The fast path touches only candidate blocks: count block
+    fetches through a spying chain wrapper."""
+    server, backend, chain, blocks = stack
+
+    class Spy:
+        def __init__(self, chain):
+            self._chain = chain
+            self.fetches = 0
+
+        def get_block_by_number(self, n):
+            self.fetches += 1
+            return self._chain.get_block_by_number(n)
+
+        def __getattr__(self, name):
+            return getattr(self._chain, name)
+
+    spy = Spy(chain)
+
+    class B:
+        pass
+    b = B()
+    b.chain = spy
+    b.bloom_indexer = backend.bloom_indexer
+    filter_logs(b, 1, N_BLOCKS, [TOKEN], [[TRANSFER_TOPIC]])
+    assert spy.fetches <= len(LOG_BLOCKS) + 2  # not 64
+
+
+# ------------------------------------------------------------- websocket
+
+def test_ws_rpc_call_and_subscriptions(stack):
+    """Live-socket WS: a plain RPC call, newHeads on a fresh accept,
+    and a logs subscription delivering the matching Transfer."""
+    server, backend, chain, blocks = stack
+    ws = WSServer(server, backend)
+    port = ws.serve()
+    try:
+        client = WSClient("127.0.0.1", port)
+        # plain JSON-RPC rides the socket
+        assert int(client.call("eth_blockNumber"), 16) == N_BLOCKS
+
+        heads_id = client.call("eth_subscribe", "newHeads")
+        logs_id = client.call(
+            "eth_subscribe", "logs",
+            {"address": "0x" + TOKEN.hex(),
+             "topics": ["0x" + TRANSFER_TOPIC.hex()]})
+        assert heads_id != logs_id
+
+        # extend the chain with one more token transfer
+        genesis, _ = _build_chain()
+        db = Database()
+        gblock = genesis.to_block(db)
+        # rebuild the same 64 then one extra block against fresh state
+        nonce = [0]
+
+        def gen(i, bg):
+            number = i + 1
+            if number in LOG_BLOCKS or number == 65:
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=CFG.chain_id, nonce=nonce[0],
+                    gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                    gas=100_000, to=TOKEN, value=0,
+                    data=transfer_calldata(ADDR2, 5)), KEY,
+                    CFG.chain_id))
+            else:
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=CFG.chain_id, nonce=nonce[0],
+                    gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                    gas=21_000, to=ADDR2, value=1), KEY, CFG.chain_id))
+            nonce[0] += 1
+
+        more, _ = generate_chain(CFG, gblock, db, 65, gen, gap=2)
+        chain.insert_block(more[64])
+        chain.accept(more[64].hash())
+        chain.drain_acceptor_queue()
+
+        note = client.next_notification()
+        assert note["subscription"] == heads_id
+        assert int(note["result"]["number"], 16) == 65
+
+        note2 = client.next_notification()
+        assert note2["subscription"] == logs_id
+        assert note2["result"]["address"] == "0x" + TOKEN.hex()
+        assert note2["result"]["topics"][0] \
+            == "0x" + TRANSFER_TOPIC.hex()
+
+        # unsubscribe stops deliveries
+        assert client.call("eth_unsubscribe", heads_id) is True
+        client.close()
+    finally:
+        ws.close()
+
+
+def test_subscribe_rejects_malformed_criteria(stack):
+    """Malformed hex in a logs subscription errors at subscribe time
+    (never on the chain's acceptor thread) and bad params return
+    -32602 instead of killing the connection."""
+    server, backend, chain, blocks = stack
+    ws = WSServer(server, backend)
+    port = ws.serve()
+    try:
+        client = WSClient("127.0.0.1", port)
+        with pytest.raises(RuntimeError):
+            client.call("eth_subscribe", "logs", {"address": "nothex"})
+        with pytest.raises(RuntimeError):
+            client.call("eth_subscribe")  # missing params
+        # the connection is still alive and usable
+        assert int(client.call("eth_blockNumber"), 16) \
+            == chain.current_block().number
+        client.close()
+    finally:
+        ws.close()
+
+
+def test_indexer_resyncs_after_gap():
+    """A forward gap in the feed (state-sync pivot) resynchronizes the
+    indexer; the gapped section never finishes and is never served."""
+    idx = BloomIndexer(section_size=4)
+    empty = b"\x00" * 256
+    for n in (1, 2, 3):
+        idx.add_bloom(n, empty)
+    idx.add_bloom(10, empty)      # gap: 4..9 missing
+    for n in (11, 12, 13, 14, 15):
+        idx.add_bloom(n, empty)
+    # section 2 (blocks 8..11) joined mid-way -> not served; section 3
+    # (12..15) was fed completely -> served
+    assert 2 not in idx.sections
+    assert 3 in idx.sections
+    assert idx.next_block == 16
