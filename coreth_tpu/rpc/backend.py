@@ -37,16 +37,21 @@ class Backend:
         from coreth_tpu.rpc.bloombits import BloomIndexer, SECTION_SIZE
         self.bloom_indexer = BloomIndexer(
             bloom_section_size or SECTION_SIZE)
-        for n in range(1, chain.last_accepted.number + 1):
-            b = chain.get_block_by_number(n)
-            if b is None:
-                # pruned/state-synced history: skip ahead so the live
-                # feed still indexes (gapped sections never finish and
-                # are never served — no false negatives)
-                self.bloom_indexer.next_block = \
-                    chain.last_accepted.number + 1
-                break
-            self.bloom_indexer.add_bloom(n, b.header.bloom)
+        # bounded synchronous backfill (the reference's chain_indexer
+        # catches up asynchronously; beyond the bound we only index
+        # live blocks — unserved sections fall back to the linear walk)
+        last = chain.last_accepted.number
+        if last <= 16_384:
+            for n in range(1, last + 1):
+                b = chain.get_block_by_number(n)
+                if b is None:
+                    # pruned/state-synced history: resync discards the
+                    # partial section so it can never serve with holes
+                    self.bloom_indexer.resync(last + 1)
+                    break
+                self.bloom_indexer.add_bloom(n, b.header.bloom)
+        else:
+            self.bloom_indexer.resync(last + 1)
         if hasattr(chain, "subscribe_chain_accepted"):
             chain.subscribe_chain_accepted(
                 lambda blk, _r: self.bloom_indexer.add_bloom(

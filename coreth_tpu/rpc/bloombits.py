@@ -60,8 +60,7 @@ class BloomIndexer:
         if number < self.next_block:
             return
         if number > self.next_block:
-            self._building = None
-            self.next_block = number
+            self.resync(number)
         self.next_block += 1
         section, offset = divmod(number, self.section_size)
         if self._building is None or section != self._building_section:
@@ -84,12 +83,24 @@ class BloomIndexer:
                 self.sections[section] = rows
             self._building = None
 
+    def resync(self, next_number: int) -> None:
+        """Skip the feed ahead (pruned history / state-sync pivot),
+        discarding any partially-built section so it can never be
+        served with missing blooms."""
+        self._building = None
+        self.next_block = next_number
+
     @property
     def indexed_until(self) -> int:
-        """Last block covered by a FINISHED section (exclusive-ish):
-        queries above this fall back to the linear path."""
-        done = max(self.sections) if self.sections else -1
-        return (done + 1) * self.section_size - 1 if done >= 0 else 0
+        """Last block of the CONTIGUOUS finished-section prefix:
+        queries above this fall back to the linear path.  Gapped
+        sections above the prefix are also handled linearly — a
+        max()-based bound would skip their blocks entirely (false
+        negatives)."""
+        k = 0
+        while k in self.sections:
+            k += 1
+        return k * self.section_size - 1 if k else 0
 
     # ------------------------------------------------------------- queries
     def _group_mask(self, rows: List[int], values: Iterable[bytes]

@@ -282,6 +282,50 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
             "storageProof": storage_proof,
         }
 
+    # uncles do not exist on Avalanche (single-parent snowman blocks):
+    # the spec-shaped answers are count 0 / null (internal/ethapi
+    # GetUncle* return empty on coreth for the same reason)
+    def eth_getUncleCountByBlockNumber(tag):
+        b.resolve_block(tag)
+        return qty(0)
+
+    def eth_getUncleCountByBlockHash(block_hash):
+        if b.chain.get_block(_h32(block_hash)) is None:
+            return None  # unknown block: null, not a fake zero
+        return qty(0)
+
+    def eth_getUncleByBlockNumberAndIndex(tag, index):
+        return None
+
+    def eth_getUncleByBlockHashAndIndex(block_hash, index):
+        return None
+
+    # txpool_* namespace (internal/ethapi txpool API shapes)
+    def _pool_groups(by_addr):
+        out = {}
+        for addr, txs in by_addr.items():
+            out["0x" + addr.hex()] = {str(tx.nonce): {
+                "hash": data(tx.hash()),
+                "nonce": qty(tx.nonce),
+                "to": data(tx.to) if tx.to else None,
+                "value": qty(tx.value),
+                "gas": qty(tx.gas),
+            } for tx in txs}
+        return out
+
+    def txpool_status():
+        if b.txpool is None:
+            return {"pending": qty(0), "queued": qty(0)}
+        pending, queued = b.txpool.stats()
+        return {"pending": qty(pending), "queued": qty(queued)}
+
+    def txpool_content():
+        if b.txpool is None:
+            return {"pending": {}, "queued": {}}
+        pending, queued = b.txpool.content()
+        return {"pending": _pool_groups(pending),
+                "queued": _pool_groups(queued)}
+
     for fn in (eth_chainId, eth_blockNumber, eth_getBalance,
                eth_getTransactionCount, eth_getCode, eth_getStorageAt,
                eth_getBlockByNumber, eth_getBlockByHash,
@@ -293,6 +337,11 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
                eth_uninstallFilter, net_version, web3_clientVersion,
                eth_syncing, eth_accounts,
                eth_getBlockTransactionCountByNumber,
-               eth_getTransactionByBlockNumberAndIndex, eth_getProof):
+               eth_getTransactionByBlockNumberAndIndex, eth_getProof,
+               eth_getUncleCountByBlockNumber,
+               eth_getUncleCountByBlockHash,
+               eth_getUncleByBlockNumberAndIndex,
+               eth_getUncleByBlockHashAndIndex,
+               txpool_status, txpool_content):
         server.register(fn.__name__, fn)
     return filters

@@ -84,11 +84,19 @@ class SubscriptionManager:
         self._subs: Dict[str, dict] = {}
         self._next = 0
         self._lock = threading.Lock()
+        self.closed = False
         chain = backend.chain
         if hasattr(chain, "subscribe_chain_head"):
             chain.subscribe_chain_head(self._on_head)
         if hasattr(chain, "subscribe_chain_accepted"):
             chain.subscribe_chain_accepted(self._on_accepted)
+
+    def close(self) -> None:
+        """Detach from the chain feeds (the chain keeps the callback
+        references, so they guard themselves) and drop every sub."""
+        self.closed = True
+        with self._lock:
+            self._subs.clear()
 
     def subscribe(self, kind: str, criteria: Optional[dict],
                   send) -> str:
@@ -139,6 +147,8 @@ class SubscriptionManager:
             self.unsubscribe(sid)
 
     def _on_head(self, block) -> None:
+        if self.closed or not self._subs:
+            return
         head = {
             "number": hex(block.number),
             "hash": "0x" + block.hash().hex(),
@@ -155,6 +165,8 @@ class SubscriptionManager:
                 self._push(sid, sub, head)
 
     def _on_accepted(self, block, receipts) -> None:
+        if self.closed:
+            return
         from coreth_tpu.rpc.filters import _match_log
         with self._lock:
             subs = [(sid, s) for sid, s in self._subs.items()
@@ -239,6 +251,7 @@ class WSServer:
         return self._server.server_address[1]
 
     def close(self) -> None:
+        self.subs.close()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -275,6 +288,10 @@ class WSServer:
         except Exception:  # noqa: BLE001
             return {"jsonrpc": "2.0", "id": None,
                     "error": {"code": -32700, "message": "parse error"}}
+        if not isinstance(req, dict):
+            # batches (and any other shape) go straight to the RPC
+            # dispatcher, which already handles them like HTTP does
+            return self.rpc.handle_request(req)
         method = req.get("method")
         rid = req.get("id")
         params = req.get("params", [])

@@ -260,3 +260,28 @@ def test_misc_rpc_methods(stack):
     assert tx["from"] == "0x" + ADDR.hex()
     assert call(server, "eth_getTransactionByBlockNumberAndIndex",
                 "0x1", "0x5") is None
+
+
+def test_uncles_and_txpool_namespace(stack):
+    server, backend, chain, blocks = stack
+    assert call(server, "eth_getUncleCountByBlockNumber", "0x1") == "0x0"
+    assert call(server, "eth_getUncleCountByBlockHash",
+                "0x" + blocks[0].hash().hex()) == "0x0"
+    assert call(server, "eth_getUncleByBlockNumberAndIndex",
+                "0x1", "0x0") is None
+    assert call(server, "eth_getUncleByBlockHashAndIndex",
+                "0x" + blocks[0].hash().hex(), "0x0") is None
+    status = call(server, "txpool_status")
+    assert set(status) == {"pending", "queued"}
+    content = call(server, "txpool_content")
+    assert set(content) == {"pending", "queued"}
+    # a pooled tx shows up in txpool_content
+    tx = sign_tx(DynamicFeeTx(
+        chain_id_=CFG.chain_id, nonce=2, gas_tip_cap_=GWEI,
+        gas_fee_cap_=300 * GWEI, gas=21_000, to=ADDR2, value=5,
+    ), KEY, CFG.chain_id)
+    backend.txpool.add_remotes([tx])
+    content = call(server, "txpool_content")
+    group = content["pending"].get("0x" + ADDR.hex()) \
+        or content["queued"].get("0x" + ADDR.hex())
+    assert group and "2" in group

@@ -256,3 +256,39 @@ def test_indexer_resyncs_after_gap():
     assert 2 not in idx.sections
     assert 3 in idx.sections
     assert idx.next_block == 16
+
+
+def test_gapped_sections_fall_back_linearly():
+    """indexed_until is the contiguous finished prefix: logs in a
+    gapped section are still found through the linear tail (no false
+    negatives after a feed gap)."""
+    genesis, blocks = _build_chain()
+    chain = BlockChain(genesis)
+    chain.insert_chain(blocks)
+    chain.drain_acceptor_queue()
+    server, backend = new_rpc_stack(chain, bloom_section_size=16)
+    idx = backend.bloom_indexer
+    # simulate a gap: drop section 1 (blocks 16..31) and section 2
+    del idx.sections[1]
+    assert idx.indexed_until == 15  # contiguous prefix only
+    logs = filter_logs(backend, 1, N_BLOCKS, [TOKEN],
+                       [[TRANSFER_TOPIC]])
+    assert {int(l["blockNumber"], 16) for l in logs} == LOG_BLOCKS
+
+
+def test_ws_batch_request(stack):
+    server, backend, chain, blocks = stack
+    ws = WSServer(server, backend)
+    port = ws.serve()
+    try:
+        client = WSClient("127.0.0.1", port)
+        client.send_json([
+            {"jsonrpc": "2.0", "id": 1, "method": "eth_chainId"},
+            {"jsonrpc": "2.0", "id": 2, "method": "eth_blockNumber"},
+        ])
+        resp = client.recv_json()
+        assert isinstance(resp, list) and len(resp) == 2
+        assert {r["id"] for r in resp} == {1, 2}
+        client.close()
+    finally:
+        ws.close()
