@@ -1,0 +1,26 @@
+"""Key management + contract bindings (reference accounts/ + signer/).
+
+- abi: Solidity ABI v2 codec + selectors/events + Contract bindings
+- keystore: web3 secret-storage V3 (scrypt + aes-128-ctr + keccak MAC)
+- eip712: typed structured data hashing/signing (signer/core/apitypes)
+"""
+
+from coreth_tpu.accounts.abi import (
+    ABIError, Contract, decode_values, encode_call, encode_values,
+    event_topic, selector,
+)
+from coreth_tpu.accounts.keystore import (
+    KeyStore, KeystoreError, decrypt_key, encrypt_key,
+)
+from coreth_tpu.accounts.eip712 import (
+    EIP712Error, domain_separator, hash_struct, recover_typed_data,
+    sign_typed_data, typed_data_digest,
+)
+
+__all__ = [
+    "ABIError", "Contract", "EIP712Error", "KeyStore", "KeystoreError",
+    "decode_values", "decrypt_key", "domain_separator", "encode_call",
+    "encode_values", "encrypt_key", "event_topic", "hash_struct",
+    "recover_typed_data", "selector", "sign_typed_data",
+    "typed_data_digest",
+]

@@ -1,0 +1,315 @@
+"""Contract ABI encoding/decoding.
+
+Twin of reference accounts/abi/ (abi.go, type.go, pack.go, unpack.go,
+event.go): the Solidity ABI v2 value codec — static and dynamic
+types, nested arrays/tuples, function selectors, event signatures —
+plus a small binding layer (`Contract`) playing the role of the
+abigen-generated wrappers (accounts/abi/bind) over any eth_call-shaped
+executor.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Tuple
+
+from coreth_tpu.crypto import keccak256
+
+
+class ABIError(Exception):
+    pass
+
+
+# --------------------------------------------------------------- types
+
+_ARRAY_RE = re.compile(r"^(.*)\[(\d*)\]$")
+
+
+def _is_dynamic(typ: str) -> bool:
+    m = _ARRAY_RE.match(typ)
+    if m:
+        base, size = m.group(1), m.group(2)
+        if size == "":
+            return True
+        return _is_dynamic(base)
+    if typ in ("bytes", "string"):
+        return True
+    if typ.startswith("("):
+        return any(_is_dynamic(t) for t in _split_tuple(typ))
+    return False
+
+
+def _split_tuple(typ: str) -> List[str]:
+    """'(uint256,(address,bytes))' -> ['uint256', '(address,bytes)']"""
+    inner = typ[1:-1]
+    out, depth, cur = [], 0, ""
+    for ch in inner:
+        if ch == "," and depth == 0:
+            out.append(cur)
+            cur = ""
+            continue
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        cur += ch
+    if cur:
+        out.append(cur)
+    return out
+
+
+def _head_size(typ: str) -> int:
+    """Bytes the type occupies in the head (static types only)."""
+    m = _ARRAY_RE.match(typ)
+    if m and m.group(2) != "":
+        return int(m.group(2)) * _head_size(m.group(1))
+    if typ.startswith("("):
+        return sum(_head_size(t) for t in _split_tuple(typ))
+    return 32
+
+
+# -------------------------------------------------------------- encode
+
+def _enc_word(typ: str, value: Any) -> bytes:
+    if typ == "address":
+        raw = bytes.fromhex(value[2:]) if isinstance(value, str) \
+            else bytes(value)
+        if len(raw) != 20:
+            raise ABIError(f"bad address length {len(raw)}")
+        return raw.rjust(32, b"\x00")
+    if typ == "bool":
+        return (1 if value else 0).to_bytes(32, "big")
+    if typ.startswith("uint"):
+        v = int(value)
+        bits = int(typ[4:]) if typ[4:] else 256
+        if v < 0 or v >> bits:
+            raise ABIError(f"{typ} out of range: {v}")
+        return v.to_bytes(32, "big")
+    if typ.startswith("int"):
+        v = int(value)
+        bits = int(typ[3:]) if typ[3:] else 256
+        if not -(1 << (bits - 1)) <= v < (1 << (bits - 1)):
+            raise ABIError(f"{typ} out of range: {v}")
+        return v.to_bytes(32, "big", signed=True)
+    if typ.startswith("bytes") and typ != "bytes":
+        n = int(typ[5:])
+        raw = bytes(value)
+        if len(raw) != n:
+            raise ABIError(f"bad {typ} length {len(raw)}")
+        return raw.ljust(32, b"\x00")
+    raise ABIError(f"not a word type: {typ}")
+
+
+def encode_value(typ: str, value: Any) -> bytes:
+    """One ABI value -> its (head-position) encoding, dynamic payloads
+    included (pack.go)."""
+    m = _ARRAY_RE.match(typ)
+    if m:
+        base, size = m.group(1), m.group(2)
+        items = list(value)
+        if size == "":
+            return (len(items).to_bytes(32, "big")
+                    + encode_values([base] * len(items), items))
+        if len(items) != int(size):
+            raise ABIError(f"bad array length for {typ}")
+        return encode_values([base] * len(items), items)
+    if typ == "bytes" or typ == "string":
+        raw = value.encode() if isinstance(value, str) else bytes(value)
+        padded = raw + b"\x00" * (-len(raw) % 32)
+        return len(raw).to_bytes(32, "big") + padded
+    if typ.startswith("("):
+        return encode_values(_split_tuple(typ), list(value))
+    return _enc_word(typ, value)
+
+
+def encode_values(types: List[str], values: List[Any]) -> bytes:
+    """ABI head/tail encoding of a value sequence (pack.go Pack)."""
+    if len(types) != len(values):
+        raise ABIError("arity mismatch")
+    head_len = sum(32 if _is_dynamic(t) else _head_size(t)
+                   for t in types)
+    head, tail = b"", b""
+    for t, v in zip(types, values):
+        enc = encode_value(t, v)
+        if _is_dynamic(t):
+            head += (head_len + len(tail)).to_bytes(32, "big")
+            tail += enc
+        else:
+            head += enc
+    return head + tail
+
+
+# -------------------------------------------------------------- decode
+
+def _dec_word(typ: str, word: bytes) -> Any:
+    if typ == "address":
+        return word[12:]
+    if typ == "bool":
+        return word[-1] == 1
+    if typ.startswith("uint"):
+        return int.from_bytes(word, "big")
+    if typ.startswith("int"):
+        return int.from_bytes(word, "big", signed=True)
+    if typ.startswith("bytes") and typ != "bytes":
+        return word[:int(typ[5:])]
+    raise ABIError(f"not a word type: {typ}")
+
+
+def _word(data: bytes, offset: int) -> int:
+    return int.from_bytes(data[offset:offset + 32], "big")
+
+
+def _decode_static(typ: str, data: bytes, offset: int) -> Any:
+    m = _ARRAY_RE.match(typ)
+    if m:
+        base, size = m.group(1), m.group(2)
+        hs = _head_size(base)
+        return [_decode_static(base, data, offset + i * hs)
+                for i in range(int(size))]
+    if typ.startswith("("):
+        out, pos = [], offset
+        for t in _split_tuple(typ):
+            out.append(_decode_static(t, data, pos))
+            pos += _head_size(t)
+        return tuple(out)
+    return _dec_word(typ, data[offset:offset + 32])
+
+
+def _decode_tail(typ: str, data: bytes, loc: int) -> Any:
+    """Decode a DYNAMIC value whose payload starts at absolute [loc];
+    nested offsets inside are relative to the sub-frame they head
+    (the spec's enc() recursion, unpack.go)."""
+    if typ in ("bytes", "string"):
+        n = _word(data, loc)
+        raw = data[loc + 32:loc + 32 + n]
+        if len(raw) != n:
+            raise ABIError("truncated dynamic payload")
+        return raw.decode() if typ == "string" else raw
+    m = _ARRAY_RE.match(typ)
+    if m:
+        base, size = m.group(1), m.group(2)
+        if size == "":
+            n = _word(data, loc)
+            # bound BEFORE allocating: a hostile length word must not
+            # drive a multi-exabyte list (every element needs >= 32
+            # head bytes, so the data itself caps n)
+            if n > max(0, (len(data) - loc - 32)) // 32:
+                raise ABIError(f"array length {n} exceeds payload")
+            return decode_values([base] * n, data, loc + 32)
+        return decode_values([base] * int(size), data, loc)
+    if typ.startswith("("):
+        return tuple(decode_values(_split_tuple(typ), data, loc))
+    raise ABIError(f"not a dynamic type: {typ}")
+
+
+def decode_values(types: List[str], data: bytes, base: int = 0
+                  ) -> List[Any]:
+    """Inverse of encode_values (unpack.go): decode one frame whose
+    head starts at absolute [base]; dynamic members' head words are
+    offsets relative to [base]."""
+    out, offset = [], base
+    for t in types:
+        if _is_dynamic(t):
+            out.append(_decode_tail(t, data, base + _word(data, offset)))
+            offset += 32
+        else:
+            out.append(_decode_static(t, data, offset))
+            offset += _head_size(t)
+    return out
+
+
+def decode_value(typ: str, data: bytes, offset: int = 0) -> Any:
+    """Single-value convenience over decode_values."""
+    return decode_values([typ], data, offset)[0]
+
+
+# ----------------------------------------------------- signatures/events
+
+def signature(name: str, types: List[str]) -> str:
+    return f"{name}({','.join(types)})"
+
+
+def selector(name: str, types: List[str]) -> bytes:
+    """4-byte function selector (abi.go Method.ID)."""
+    return keccak256(signature(name, types).encode())[:4]
+
+
+def event_topic(name: str, types: List[str]) -> bytes:
+    """Event signature topic (event.go Event.ID)."""
+    return keccak256(signature(name, types).encode())
+
+
+def encode_call(name: str, types: List[str], values: List[Any]) -> bytes:
+    return selector(name, types) + encode_values(types, values)
+
+
+# -------------------------------------------------------------- binding
+
+class Contract:
+    """abigen-lite (accounts/abi/bind role): wraps an ABI description
+    and an executor into callable methods.
+
+    abi_json: the standard ABI list (dicts with type/name/inputs/
+    outputs).  call_fn(to, data) -> return bytes executes a read;
+    send_fn(to, data) -> tx hash submits a transaction."""
+
+    def __init__(self, address: bytes, abi_json: List[dict],
+                 call_fn: Optional[Callable] = None,
+                 send_fn: Optional[Callable] = None):
+        self.address = address
+        self.call_fn = call_fn
+        self.send_fn = send_fn
+        self.methods = {}
+        self.events = {}
+        for entry in abi_json:
+            if entry.get("type") == "function":
+                ins = [i["type"] for i in entry.get("inputs", [])]
+                outs = [o["type"] for o in entry.get("outputs", [])]
+                self.methods[entry["name"]] = (ins, outs,
+                                               entry.get(
+                                                   "stateMutability"))
+            elif entry.get("type") == "event":
+                ins = [i["type"] for i in entry.get("inputs", [])]
+                self.events[entry["name"]] = (
+                    event_topic(entry["name"], ins), entry["inputs"])
+
+    def encode(self, name: str, *args) -> bytes:
+        ins, _, _ = self.methods[name]
+        return encode_call(name, ins, list(args))
+
+    def call(self, name: str, *args):
+        """Execute a read; decodes the outputs (single value unwrapped)."""
+        if self.call_fn is None:
+            raise ABIError("no call executor bound")
+        ins, outs, _ = self.methods[name]
+        ret = self.call_fn(self.address, self.encode(name, *args))
+        vals = decode_values(outs, ret)
+        return vals[0] if len(vals) == 1 else tuple(vals)
+
+    def transact(self, name: str, *args):
+        if self.send_fn is None:
+            raise ABIError("no send executor bound")
+        return self.send_fn(self.address, self.encode(name, *args))
+
+    def decode_log(self, name: str, log) -> dict:
+        """Decode one emitted event's topics + data (event.go)."""
+        topic0, inputs = self.events[name]
+        if not log.topics or log.topics[0] != topic0:
+            raise ABIError("log signature mismatch")
+        out = {}
+        topic_i = 1
+        data_types, data_names = [], []
+        for inp in inputs:
+            if inp.get("indexed"):
+                out[inp["name"]] = _dec_word(
+                    inp["type"], log.topics[topic_i]) \
+                    if not _is_dynamic(inp["type"]) \
+                    else log.topics[topic_i]
+                topic_i += 1
+            else:
+                data_types.append(inp["type"])
+                data_names.append(inp["name"])
+        for n, v in zip(data_names,
+                        decode_values(data_types, log.data)):
+            out[n] = v
+        return out

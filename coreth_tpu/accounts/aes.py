@@ -1,0 +1,111 @@
+"""AES-128 block cipher + CTR mode, from FIPS-197.
+
+The standard library ships no AES, and this image has no crypto
+packages — the keystore (accounts/keystore/passphrase.go uses
+aes-128-ctr) needs one, so here is the textbook implementation:
+S-box generated from the GF(2^8) inverse + affine map at import (not
+transcribed), 10-round key schedule, CTR keystream.  Performance is
+irrelevant at keystore scale (32-byte payloads)."""
+
+from __future__ import annotations
+
+from typing import List
+
+# ---------------------------------------------------------------- tables
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    return (a ^ 0x1B) & 0xFF if a & 0x100 else a
+
+
+def _gmul(a: int, b: int) -> int:
+    p = 0
+    while b:
+        if b & 1:
+            p ^= a
+        a = _xtime(a)
+        b >>= 1
+    return p
+
+
+def _build_sbox() -> List[int]:
+    # multiplicative inverse via exponentiation tables, then the
+    # affine transformation (FIPS-197 5.1.1)
+    sbox = [0] * 256
+    for x in range(256):
+        inv = 0
+        if x:
+            # brute-force inverse in GF(2^8); 256 elements, import-time
+            for y in range(1, 256):
+                if _gmul(x, y) == 1:
+                    inv = y
+                    break
+        res, c = 0, 0x63
+        for i in range(8):
+            bit = ((inv >> i) ^ (inv >> ((i + 4) % 8))
+                   ^ (inv >> ((i + 5) % 8)) ^ (inv >> ((i + 6) % 8))
+                   ^ (inv >> ((i + 7) % 8)) ^ (c >> i)) & 1
+            res |= bit << i
+        sbox[x] = res
+    return sbox
+
+
+_SBOX = _build_sbox()
+_RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36]
+
+
+# ---------------------------------------------------------------- cipher
+
+def _expand_key(key: bytes) -> List[List[int]]:
+    words = [list(key[i:i + 4]) for i in range(0, 16, 4)]
+    for i in range(4, 44):
+        tmp = list(words[i - 1])
+        if i % 4 == 0:
+            tmp = tmp[1:] + tmp[:1]
+            tmp = [_SBOX[b] for b in tmp]
+            tmp[0] ^= _RCON[i // 4 - 1]
+        words.append([a ^ b for a, b in zip(words[i - 4], tmp)])
+    return [sum(words[4 * r:4 * r + 4], []) for r in range(11)]
+
+
+def _encrypt_block(block: bytes, round_keys: List[List[int]]) -> bytes:
+    # state kept in byte order s[4c+r] (column-major, FIPS-197 3.4)
+    s = [b ^ k for b, k in zip(block, round_keys[0])]
+    for rnd in range(1, 11):
+        s = [_SBOX[b] for b in s]                       # SubBytes
+        # ShiftRows on column-major byte order: byte index 4c+r
+        t = list(s)
+        for r in range(1, 4):
+            for c in range(4):
+                t[4 * c + r] = s[4 * ((c + r) % 4) + r]
+        s = t
+        if rnd != 10:                                    # MixColumns
+            t = []
+            for c in range(4):
+                col = s[4 * c:4 * c + 4]
+                t += [
+                    _gmul(col[0], 2) ^ _gmul(col[1], 3) ^ col[2] ^ col[3],
+                    col[0] ^ _gmul(col[1], 2) ^ _gmul(col[2], 3) ^ col[3],
+                    col[0] ^ col[1] ^ _gmul(col[2], 2) ^ _gmul(col[3], 3),
+                    _gmul(col[0], 3) ^ col[1] ^ col[2] ^ _gmul(col[3], 2),
+                ]
+            s = t
+        s = [b ^ k for b, k in zip(s, round_keys[rnd])]  # AddRoundKey
+    return bytes(s)
+
+
+def aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    """CTR keystream XOR — encryption and decryption are the same
+    operation."""
+    if len(key) != 16 or len(iv) != 16:
+        raise ValueError("aes-128-ctr needs 16-byte key and iv")
+    rk = _expand_key(key)
+    out = bytearray()
+    counter = int.from_bytes(iv, "big")
+    for i in range(0, len(data), 16):
+        stream = _encrypt_block(counter.to_bytes(16, "big"), rk)
+        chunk = data[i:i + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, stream))
+        counter = (counter + 1) % (1 << 128)
+    return bytes(out)

@@ -1,0 +1,130 @@
+"""EIP-712 typed structured data hashing and signing.
+
+Twin of reference signer/core/apitypes (TypedData.HashStruct,
+typeHash, encodeData, and the eth_signTypedData digest
+keccak(0x1901 || domainSeparator || hashStruct(message)))."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+from coreth_tpu.accounts.abi import _enc_word, ABIError
+from coreth_tpu.crypto import keccak256
+
+# field order of the canonical EIP712Domain type; only fields present
+# in the domain dict are encoded (apitypes.TypedDataDomain)
+DOMAIN_FIELDS = [
+    ("name", "string"),
+    ("version", "string"),
+    ("chainId", "uint256"),
+    ("verifyingContract", "address"),
+    ("salt", "bytes32"),
+]
+
+
+class EIP712Error(Exception):
+    pass
+
+
+def _dependencies(primary: str, types: Dict[str, List[dict]],
+                  found=None) -> List[str]:
+    """Referenced struct types, primary first then sorted
+    (apitypes.Dependencies)."""
+    found = found if found is not None else []
+    # strip only array suffixes — rstrip on a character set would eat
+    # trailing digits of names like "OrderV2"
+    base = re.sub(r"(\[\d*\])+$", "", primary)
+    if base in found or base not in types:
+        return found
+    found.append(base)
+    for field in types[base]:
+        _dependencies(field["type"], types, found)
+    return found
+
+
+def encode_type(primary: str, types: Dict[str, List[dict]]) -> bytes:
+    """'Mail(Person from,Person to,string contents)Person(...)'
+    (apitypes.EncodeType)."""
+    deps = _dependencies(primary, types)
+    head, rest = deps[0], sorted(deps[1:])
+    out = ""
+    for name in [head] + rest:
+        fields = ",".join(f"{f['type']} {f['name']}"
+                          for f in types[name])
+        out += f"{name}({fields})"
+    return out.encode()
+
+
+def type_hash(primary: str, types: Dict[str, List[dict]]) -> bytes:
+    return keccak256(encode_type(primary, types))
+
+
+def _encode_field(typ: str, value: Any,
+                  types: Dict[str, List[dict]]) -> bytes:
+    if typ in types:                       # nested struct -> its hash
+        return hash_struct(typ, value, types)
+    if typ.endswith("]"):                  # array -> hash of encodings
+        base = typ[:typ.rindex("[")]
+        return keccak256(b"".join(
+            _encode_field(base, v, types) for v in value))
+    if typ in ("bytes",):
+        raw = bytes.fromhex(value[2:]) if isinstance(value, str) \
+            else bytes(value)
+        return keccak256(raw)
+    if typ == "string":
+        return keccak256(value.encode())
+    try:
+        return _enc_word(typ, value)
+    except ABIError as e:
+        raise EIP712Error(str(e)) from None
+
+
+def hash_struct(primary: str, data: dict,
+                types: Dict[str, List[dict]]) -> bytes:
+    """keccak(typeHash || enc(field_1) || ... ) (HashStruct)."""
+    enc = type_hash(primary, types)
+    for field in types[primary]:
+        if field["name"] not in data:
+            raise EIP712Error(f"missing field {field['name']}")
+        enc += _encode_field(field["type"], data[field["name"]], types)
+    return keccak256(enc)
+
+
+def domain_separator(domain: dict) -> bytes:
+    """hashStruct of the EIP712Domain, built from the present fields."""
+    fields = [{"name": n, "type": t} for n, t in DOMAIN_FIELDS
+              if n in domain]
+    return hash_struct("EIP712Domain", domain,
+                       {"EIP712Domain": fields})
+
+
+def typed_data_digest(domain: dict, primary: str, message: dict,
+                      types: Dict[str, List[dict]]) -> bytes:
+    """The final eth_signTypedData digest:
+    keccak(0x19 0x01 || domainSeparator || hashStruct(message))."""
+    return keccak256(b"\x19\x01" + domain_separator(domain)
+                     + hash_struct(primary, message, types))
+
+
+def sign_typed_data(priv: int, domain: dict, primary: str,
+                    message: dict, types: Dict[str, List[dict]]
+                    ) -> bytes:
+    """65-byte [R||S||V27] signature over the typed-data digest."""
+    from coreth_tpu.crypto.secp256k1 import sign
+    r, s, recid = sign(typed_data_digest(domain, primary, message,
+                                         types), priv)
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big") \
+        + bytes([27 + recid])
+
+
+def recover_typed_data(sig: bytes, domain: dict, primary: str,
+                       message: dict, types: Dict[str, List[dict]]
+                       ) -> bytes:
+    """Signer address from a 65-byte signature."""
+    from coreth_tpu.crypto.secp256k1 import recover_address
+    digest = typed_data_digest(domain, primary, message, types)
+    v = sig[64]
+    recid = v - 27 if v >= 27 else v
+    return recover_address(digest, int.from_bytes(sig[:32], "big"),
+                           int.from_bytes(sig[32:64], "big"), recid)

@@ -1,0 +1,208 @@
+"""Encrypted key storage (web3 secret-storage V3).
+
+Twin of reference accounts/keystore/ (passphrase.go EncryptKey /
+DecryptKey, key_store_passphrase, keystore.go KeyStore): scrypt KDF
+(hashlib.scrypt), aes-128-ctr payload encryption, keccak MAC over
+kdf-tail + ciphertext, the standard V3 JSON layout, and a directory
+manager that creates/lists/unlocks accounts and signs hashes/txs with
+unlocked keys.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import time
+from typing import Dict, List, Optional
+
+from coreth_tpu.accounts.aes import aes128_ctr
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+
+# light scrypt parameters (keystore.LightScryptN/P — the standard ones
+# cost 256 MiB, which tests should not pay; both decrypt fine)
+SCRYPT_N = 4096
+SCRYPT_R = 8
+SCRYPT_P = 6
+DKLEN = 32
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def encrypt_key(priv: int, password: str,
+                scrypt_n: int = SCRYPT_N) -> dict:
+    """Key -> V3 JSON dict (passphrase.go EncryptKey)."""
+    salt = secrets.token_bytes(32)
+    dk = hashlib.scrypt(password.encode(), salt=salt, n=scrypt_n,
+                        r=SCRYPT_R, p=SCRYPT_P, dklen=DKLEN,
+                        maxmem=128 * 1024 * 1024)
+    iv = secrets.token_bytes(16)
+    ciphertext = aes128_ctr(dk[:16], iv, priv.to_bytes(32, "big"))
+    mac = keccak256(dk[16:32] + ciphertext)
+    return {
+        "version": 3,
+        "id": "%08x-%04x-%04x-%04x-%012x" % tuple(
+            int.from_bytes(secrets.token_bytes(k), "big")
+            for k in (4, 2, 2, 2, 6)),
+        "address": priv_to_address(priv).hex(),
+        "crypto": {
+            "cipher": "aes-128-ctr",
+            "ciphertext": ciphertext.hex(),
+            "cipherparams": {"iv": iv.hex()},
+            "kdf": "scrypt",
+            "kdfparams": {"dklen": DKLEN, "n": scrypt_n, "r": SCRYPT_R,
+                          "p": SCRYPT_P, "salt": salt.hex()},
+            "mac": mac.hex(),
+        },
+    }
+
+
+def decrypt_key(blob: dict, password: str) -> int:
+    """V3 JSON dict -> private key; raises on a wrong password
+    (passphrase.go DecryptKey — the MAC check is the gate)."""
+    if blob.get("version") != 3:
+        raise KeystoreError(f"unsupported version {blob.get('version')}")
+    crypto = blob["crypto"]
+    if crypto["cipher"] != "aes-128-ctr":
+        raise KeystoreError(f"unsupported cipher {crypto['cipher']}")
+    kdfparams = crypto["kdfparams"]
+    if crypto["kdf"] == "scrypt":
+        dk = hashlib.scrypt(
+            password.encode(), salt=bytes.fromhex(kdfparams["salt"]),
+            n=kdfparams["n"], r=kdfparams["r"], p=kdfparams["p"],
+            dklen=kdfparams["dklen"], maxmem=512 * 1024 * 1024)
+    elif crypto["kdf"] == "pbkdf2":
+        if kdfparams.get("prf", "hmac-sha256") != "hmac-sha256":
+            raise KeystoreError("unsupported pbkdf2 prf")
+        dk = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(),
+            bytes.fromhex(kdfparams["salt"]), kdfparams["c"],
+            dklen=kdfparams["dklen"])
+    else:
+        raise KeystoreError(f"unsupported kdf {crypto['kdf']}")
+    ciphertext = bytes.fromhex(crypto["ciphertext"])
+    mac = keccak256(dk[16:32] + ciphertext)
+    if mac.hex() != crypto["mac"]:
+        raise KeystoreError("could not decrypt key with given password")
+    priv_bytes = aes128_ctr(dk[:16],
+                            bytes.fromhex(crypto["cipherparams"]["iv"]),
+                            ciphertext)
+    priv = int.from_bytes(priv_bytes, "big")
+    if blob.get("address") and priv_to_address(priv).hex() \
+            != blob["address"].lower().removeprefix("0x"):
+        raise KeystoreError("decrypted key does not match address")
+    return priv
+
+
+class KeyStore:
+    """Directory-backed account manager (keystore.go KeyStore)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        # address -> (priv, expires_at_monotonic | None)
+        self._unlocked: Dict[bytes, tuple] = {}
+
+    # ------------------------------------------------------------ accounts
+    def accounts(self) -> List[bytes]:
+        """Addresses of every stored key, sorted (wallet order)."""
+        out = []
+        for name in sorted(os.listdir(self.directory)):
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    blob = json.load(f)
+                out.append(bytes.fromhex(blob["address"]))
+            except (ValueError, KeyError, OSError):
+                continue
+        return sorted(set(out))
+
+    def _path_for(self, address: bytes) -> Optional[str]:
+        for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path) as f:
+                    if json.load(f).get("address") == address.hex():
+                        return path
+            except (ValueError, OSError):
+                continue
+        return None
+
+    def new_account(self, password: str) -> bytes:
+        """Generate + store a key (keystore.go NewAccount)."""
+        priv = int.from_bytes(secrets.token_bytes(32), "big")
+        return self.import_key(priv, password)
+
+    def import_key(self, priv: int, password: str) -> bytes:
+        blob = encrypt_key(priv, password)
+        addr = bytes.fromhex(blob["address"])
+        stamp = time.strftime("%Y-%m-%dT%H-%M-%S", time.gmtime())
+        name = f"UTC--{stamp}--{blob['address']}"
+        path = os.path.join(self.directory, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(blob, f)
+        os.replace(tmp, path)  # atomic like writeKeyFile
+        return addr
+
+    def export_key(self, address: bytes, password: str) -> int:
+        path = self._path_for(address)
+        if path is None:
+            raise KeystoreError(f"no key for {address.hex()}")
+        with open(path) as f:
+            return decrypt_key(json.load(f), password)
+
+    def delete(self, address: bytes, password: str) -> None:
+        """Delete after proving ownership (keystore.go Delete)."""
+        self.export_key(address, password)
+        os.unlink(self._path_for(address))
+        self._unlocked.pop(address, None)
+
+    # ------------------------------------------------------------- signing
+    def unlock(self, address: bytes, password: str,
+               duration: Optional[float] = None) -> None:
+        """Unlock indefinitely, or for `duration` seconds (the
+        TimedUnlock semantics of keystore.go:TimedUnlock)."""
+        priv = self.export_key(address, password)
+        expires = time.monotonic() + duration if duration else None
+        self._unlocked[address] = (priv, expires)
+
+    def lock(self, address: bytes) -> None:
+        self._unlocked.pop(address, None)
+
+    def _unlocked_key(self, address: bytes) -> int:
+        entry = self._unlocked.get(address)
+        if entry is not None:
+            priv, expires = entry
+            if expires is None or time.monotonic() < expires:
+                return priv
+            self._unlocked.pop(address, None)  # expired: relock
+        raise KeystoreError(f"account {address.hex()} locked")
+
+    def sign_hash(self, address: bytes, digest: bytes) -> bytes:
+        """65-byte [R||S||V] signature with an unlocked key
+        (keystore.go SignHash)."""
+        priv = self._unlocked_key(address)
+        from coreth_tpu.crypto.secp256k1 import sign
+        r, s, recid = sign(digest, priv)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") \
+            + bytes([recid])
+
+    def sign_hash_with_passphrase(self, address: bytes, password: str,
+                                  digest: bytes) -> bytes:
+        """Decrypt transiently, sign, forget — the key never enters
+        the unlocked map (keystore.go SignHashWithPassphrase)."""
+        priv = self.export_key(address, password)
+        from coreth_tpu.crypto.secp256k1 import sign
+        r, s, recid = sign(digest, priv)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") \
+            + bytes([recid])
+
+    def sign_tx(self, address: bytes, tx, chain_id: int):
+        """Sign a transaction with an unlocked key (SignTx)."""
+        priv = self._unlocked_key(address)
+        from coreth_tpu.types import sign_tx as _sign
+        return _sign(tx, priv, chain_id)

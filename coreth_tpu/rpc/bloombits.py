@@ -114,6 +114,38 @@ class BloomIndexer:
             acc |= m
         return acc
 
+    def plan(self, from_block: int, to_block: int,
+             groups: List[List[bytes]]) -> List[int]:
+        """Block numbers to visit for a query: candidates from every
+        FINISHED section, the full range of unfinished/gapped ones
+        (the linear fallback is per-section, so finished sections
+        above a gap still accelerate — eth/filters matcher planning)."""
+        groups = [g for g in groups if g]
+        out: List[int] = []
+        full = (1 << self.section_size) - 1
+        for section in range(from_block // self.section_size,
+                             to_block // self.section_size + 1):
+            lo = max(from_block, section * self.section_size)
+            hi = min(to_block, (section + 1) * self.section_size - 1)
+            rows = self.sections.get(section)
+            if rows is None:
+                out.extend(range(lo, hi + 1))
+                continue
+            mask = full
+            for g in groups:
+                mask &= self._group_mask(rows, g)
+                if not mask:
+                    break
+            base = section * self.section_size
+            m = mask
+            while m:
+                low = m & -m
+                number = base + low.bit_length() - 1
+                if lo <= number <= hi:
+                    out.append(number)
+                m ^= low
+        return out
+
     def candidates(self, from_block: int, to_block: int,
                    groups: List[List[bytes]]) -> List[int]:
         """Block numbers in [from, to] whose blooms may match ALL

@@ -286,7 +286,10 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
     # the spec-shaped answers are count 0 / null (internal/ethapi
     # GetUncle* return empty on coreth for the same reason)
     def eth_getUncleCountByBlockNumber(tag):
-        b.resolve_block(tag)
+        try:
+            b.resolve_block(tag)
+        except RPCError:
+            return None  # unknown block: null, like the hash variant
         return qty(0)
 
     def eth_getUncleCountByBlockHash(block_hash):

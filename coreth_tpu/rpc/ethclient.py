@@ -1,0 +1,185 @@
+"""Go-style client library over the JSON-RPC surface.
+
+Twin of reference ethclient/ethclient.go: typed wrappers for the
+eth_* methods a program needs against a served node (HTTP transport
+from the standard library), returning Python-native values (ints,
+bytes) instead of hex strings, plus a receipt-waiter.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, List, Optional
+
+from coreth_tpu.rpc.server import RPCError
+
+
+def _hx(value) -> str:
+    if isinstance(value, (bytes, bytearray)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, int):
+        return hex(value)
+    return value
+
+
+def _to_int(value) -> Optional[int]:
+    return None if value is None else int(value, 16)
+
+
+def _to_bytes(value) -> Optional[bytes]:
+    return None if value is None else bytes.fromhex(value[2:])
+
+
+class EthClient:
+    """ethclient.Client over HTTP (Dial -> EthClient(url))."""
+
+    def __init__(self, url: str):
+        self.url = url
+        self._next = 0
+
+    def call_rpc(self, method: str, *params) -> Any:
+        self._next += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._next,
+                           "method": method,
+                           "params": list(params)}).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        if out.get("error"):
+            raise RPCError(out["error"].get("message", "rpc error"),
+                           out["error"].get("code", -32603))
+        return out.get("result")
+
+    # ------------------------------------------------------------- chain
+    def chain_id(self) -> int:
+        return _to_int(self.call_rpc("eth_chainId"))
+
+    def block_number(self) -> int:
+        return _to_int(self.call_rpc("eth_blockNumber"))
+
+    def block_by_number(self, number="latest", full=False) -> dict:
+        return self.call_rpc("eth_getBlockByNumber", _hx(number), full)
+
+    def block_by_hash(self, block_hash: bytes, full=False) -> dict:
+        return self.call_rpc("eth_getBlockByHash", _hx(block_hash),
+                             full)
+
+    # ------------------------------------------------------------- state
+    def balance_at(self, addr: bytes, tag="latest") -> int:
+        return _to_int(self.call_rpc("eth_getBalance", _hx(addr),
+                                     _hx(tag)))
+
+    def nonce_at(self, addr: bytes, tag="latest") -> int:
+        return _to_int(self.call_rpc("eth_getTransactionCount",
+                                     _hx(addr), _hx(tag)))
+
+    def code_at(self, addr: bytes, tag="latest") -> bytes:
+        return _to_bytes(self.call_rpc("eth_getCode", _hx(addr),
+                                       _hx(tag)))
+
+    def storage_at(self, addr: bytes, slot: bytes,
+                   tag="latest") -> bytes:
+        return _to_bytes(self.call_rpc("eth_getStorageAt", _hx(addr),
+                                       _hx(slot), _hx(tag)))
+
+    # ------------------------------------------------------ transactions
+    def send_raw_transaction(self, raw: bytes) -> bytes:
+        return _to_bytes(self.call_rpc("eth_sendRawTransaction",
+                                       _hx(raw)))
+
+    def send_transaction(self, tx) -> bytes:
+        """Encode + submit a signed Transaction object."""
+        return self.send_raw_transaction(tx.encode())
+
+    def transaction_by_hash(self, tx_hash: bytes) -> Optional[dict]:
+        return self.call_rpc("eth_getTransactionByHash", _hx(tx_hash))
+
+    def transaction_receipt(self, tx_hash: bytes) -> Optional[dict]:
+        return self.call_rpc("eth_getTransactionReceipt", _hx(tx_hash))
+
+    def wait_for_receipt(self, tx_hash: bytes, poll: int = 50,
+                         timeout_s: float = 10.0) -> dict:
+        """bind.WaitMined role (no mining here: the receipt appears
+        once consensus accepts the block)."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rec = self.transaction_receipt(tx_hash)
+            if rec is not None:
+                return rec
+            time.sleep(poll / 1000)
+        raise TimeoutError(f"no receipt for {tx_hash.hex()}")
+
+    # ------------------------------------------------------------ execute
+    def call_contract(self, to: bytes, data: bytes = b"",
+                      from_: Optional[bytes] = None,
+                      tag="latest") -> bytes:
+        msg = {"to": _hx(to), "data": _hx(data)}
+        if from_ is not None:
+            msg["from"] = _hx(from_)
+        return _to_bytes(self.call_rpc("eth_call", msg, _hx(tag)))
+
+    def estimate_gas(self, to: Optional[bytes], data: bytes = b"",
+                     from_: Optional[bytes] = None,
+                     value: int = 0) -> int:
+        msg = {"data": _hx(data)}
+        if to is not None:
+            msg["to"] = _hx(to)
+        if from_ is not None:
+            msg["from"] = _hx(from_)
+        if value:
+            msg["value"] = _hx(value)
+        return _to_int(self.call_rpc("eth_estimateGas", msg))
+
+    def gas_price(self) -> int:
+        return _to_int(self.call_rpc("eth_gasPrice"))
+
+    def max_priority_fee(self) -> int:
+        return _to_int(self.call_rpc("eth_maxPriorityFeePerGas"))
+
+    # --------------------------------------------------------------- logs
+    def get_logs(self, from_block=0, to_block="latest",
+                 address: Optional[bytes] = None,
+                 topics: Optional[List] = None) -> List[dict]:
+        crit: dict = {"fromBlock": _hx(from_block),
+                      "toBlock": _hx(to_block)}
+        if address is not None:
+            crit["address"] = _hx(address)
+        if topics:
+            crit["topics"] = [_hx(t) if not isinstance(t, list)
+                              else [_hx(x) for x in t] for t in topics]
+        return self.call_rpc("eth_getLogs", crit)
+
+    # ------------------------------------------------------------ binding
+    def contract(self, address: bytes, abi_json: List[dict],
+                 signer=None):
+        """An accounts.Contract wired to this client: reads go through
+        eth_call; transact(signer=(priv, chain_id)) fills nonce/fees,
+        signs, submits (the abigen bind.TransactOpts role)."""
+        from coreth_tpu.accounts import Contract
+
+        def call_fn(to, data):
+            return self.call_contract(to, data)
+
+        send_fn = None
+        if signer is not None:
+            priv, chain_id = signer
+
+            def send_fn(to, data):  # noqa: F811
+                from coreth_tpu.crypto.secp256k1 import priv_to_address
+                from coreth_tpu.types import DynamicFeeTx, sign_tx
+                sender = priv_to_address(priv)
+                tx = sign_tx(DynamicFeeTx(
+                    chain_id_=chain_id,
+                    nonce=self.nonce_at(sender),
+                    gas_tip_cap_=self.max_priority_fee(),
+                    gas_fee_cap_=2 * self.gas_price(),
+                    gas=self.estimate_gas(to, data, from_=sender),
+                    to=to, value=0, data=data), priv, chain_id)
+                return self.send_raw_transaction(tx.encode())
+
+        return Contract(address, abi_json, call_fn=call_fn,
+                        send_fn=send_fn)

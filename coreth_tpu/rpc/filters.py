@@ -47,13 +47,9 @@ def filter_logs(backend, from_block: int, to_block: int,
     indexer = getattr(backend, "bloom_indexer", None)
     groups = [list(addresses)] + [list(t) for t in topics]
     if indexer is not None and any(g for g in groups):
-        boundary = min(to_block, indexer.indexed_until)
-        numbers = []
-        if from_block <= boundary:
-            numbers.extend(indexer.candidates(from_block, boundary,
-                                              groups))
-        numbers.extend(range(max(from_block, boundary + 1),
-                             to_block + 1))
+        # per-section planning: finished sections answer from the
+        # index even above a gap; unfinished sections walk linearly
+        numbers = indexer.plan(from_block, to_block, groups)
     else:
         numbers = range(from_block, to_block + 1)
     out = []

@@ -1,0 +1,99 @@
+"""personal_* namespace + eth_signTypedData_v4.
+
+Twin of reference internal/ethapi's PersonalAccountAPI over the
+keystore (newAccount/listAccounts/unlockAccount/lockAccount/sign) and
+the signer's typed-data entry point.  personal_sign applies the
+EIP-191 "\\x19Ethereum Signed Message" envelope exactly as geth does.
+"""
+
+from __future__ import annotations
+
+from coreth_tpu.accounts.keystore import KeyStore, KeystoreError
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.rpc.server import RPCError
+
+
+def _addr(value: str) -> bytes:
+    raw = bytes.fromhex(value[2:] if value.startswith("0x") else value)
+    if len(raw) != 20:
+        raise RPCError("invalid address", -32602)
+    return raw
+
+
+def _bytes(value: str) -> bytes:
+    return bytes.fromhex(value[2:] if value.startswith("0x") else value)
+
+
+def eip191_hash(message: bytes) -> bytes:
+    """accounts.TextHash: keccak('\\x19Ethereum Signed Message:\\n'
+    + len + message)."""
+    return keccak256(b"\x19Ethereum Signed Message:\n"
+                     + str(len(message)).encode() + message)
+
+
+def register_personal_api(server, keystore: KeyStore) -> None:
+    def personal_newAccount(password: str):
+        return "0x" + keystore.new_account(password).hex()
+
+    def personal_listAccounts():
+        return ["0x" + a.hex() for a in keystore.accounts()]
+
+    def personal_unlockAccount(address: str, password: str,
+                               duration: int = 0):
+        try:
+            # geth defaults to 300s when duration is absent/0;
+            # explicit large durations behave as given
+            keystore.unlock(_addr(address), password,
+                            duration=float(duration) if duration
+                            else 300.0)
+        except KeystoreError as e:
+            raise RPCError(str(e), -32000)
+        return True
+
+    def personal_lockAccount(address: str):
+        keystore.lock(_addr(address))
+        return True
+
+    def personal_importRawKey(priv_hex: str, password: str):
+        priv = int(priv_hex[2:] if priv_hex.startswith("0x")
+                   else priv_hex, 16)
+        return "0x" + keystore.import_key(priv, password).hex()
+
+    def personal_sign(message: str, address: str, password: str = None):
+        addr = _addr(address)
+        digest = eip191_hash(_bytes(message))
+        try:
+            if password is not None:
+                # transient: the key is decrypted for this one
+                # signature and never enters the unlocked map
+                # (SignHashWithPassphrase semantics)
+                sig = keystore.sign_hash_with_passphrase(
+                    addr, password, digest)
+            else:
+                sig = keystore.sign_hash(addr, digest)
+        except KeystoreError as e:
+            raise RPCError(str(e), -32000)
+        # EIP-191 signatures travel with v in {27, 28}
+        return "0x" + sig[:64].hex() + format(sig[64] + 27, "02x")
+
+    def eth_signTypedData_v4(address: str, typed_data):
+        import json as _json
+        from coreth_tpu.accounts.eip712 import typed_data_digest
+        if isinstance(typed_data, str):
+            typed_data = _json.loads(typed_data)
+        types = dict(typed_data["types"])
+        types.pop("EIP712Domain", None)
+        digest = typed_data_digest(
+            typed_data["domain"], typed_data["primaryType"],
+            typed_data["message"], types)
+        try:
+            sig = keystore.sign_hash(_addr(address), digest)
+        except KeystoreError as e:
+            raise RPCError(str(e), -32000)
+        return "0x" + sig[:64].hex() + format(sig[64] + 27, "02x")
+
+    for fn in (personal_newAccount, personal_listAccounts,
+               personal_unlockAccount, personal_lockAccount,
+               personal_importRawKey, personal_sign,
+               eth_signTypedData_v4):
+        server.register(fn.__name__, fn)
