@@ -156,6 +156,9 @@ def _dec_word(typ: str, word: bytes) -> Any:
 
 
 def _word(data: bytes, offset: int) -> int:
+    if offset + 32 > len(data):
+        raise ABIError(
+            f"truncated data: need word at {offset}, have {len(data)}")
     return int.from_bytes(data[offset:offset + 32], "big")
 
 
@@ -172,6 +175,9 @@ def _decode_static(typ: str, data: bytes, offset: int) -> Any:
             out.append(_decode_static(t, data, pos))
             pos += _head_size(t)
         return tuple(out)
+    if offset + 32 > len(data):
+        raise ABIError(
+            f"truncated data: need word at {offset}, have {len(data)}")
     return _dec_word(typ, data[offset:offset + 32])
 
 

@@ -74,10 +74,18 @@ def _encode_field(typ: str, value: Any,
         return keccak256(raw)
     if typ == "string":
         return keccak256(value.encode())
+    # JSON payloads carry word values as hex/decimal strings —
+    # normalize before the ABI word encoder (apitypes' value parsing)
+    if isinstance(value, str):
+        if typ.startswith("bytes"):
+            value = bytes.fromhex(value[2:] if value.startswith("0x")
+                                  else value)
+        elif typ.startswith(("uint", "int")):
+            value = int(value, 0)
     try:
         return _enc_word(typ, value)
-    except ABIError as e:
-        raise EIP712Error(str(e)) from None
+    except (ABIError, ValueError, TypeError) as e:
+        raise EIP712Error(f"bad value for {typ}: {e}") from None
 
 
 def hash_struct(primary: str, data: dict,

@@ -59,7 +59,8 @@ class BlockChain:
                  engine: Optional[DummyEngine] = None,
                  chain_kv=None, commit_interval: int = 4096,
                  archive: bool = False, snapshots: bool = True,
-                 prefetch: bool = False):
+                 prefetch: bool = False, freezer_dir=None,
+                 freeze_threshold: int = 90_000):
         """chain_kv: optional rawdb.KVStore making the chain durable —
         accepted blocks/receipts/canonical index persist immediately,
         trie nodes every `commit_interval` accepts (state_manager.go
@@ -119,6 +120,14 @@ class BlockChain:
         if prefetch and chain_kv is not None:
             from coreth_tpu.state.trie_prefetcher import TriePrefetcher
             self._prefetcher = TriePrefetcher(self.db.node_db)
+        # ancient store (core/rawdb/freezer.go role): accepted blocks
+        # freeze_threshold behind the head migrate from the KV log to
+        # immutable flat files on the acceptor thread
+        self.freezer = None
+        self.freeze_threshold = freeze_threshold
+        if freezer_dir is not None and chain_kv is not None:
+            from coreth_tpu.rawdb.freezer import Freezer
+            self.freezer = Freezer(freezer_dir)
         if chain_kv is not None:
             # _load_last_state seeds the snapshot at the on-disk base
             # (genesis only for a fresh store), so it is not generated
@@ -213,6 +222,8 @@ class BlockChain:
                 self.trie_writer.force_flush(self.last_accepted.number,
                                              self.last_accepted.root)
         finally:
+            if self.freezer is not None:
+                self.freezer.close()
             if self.chain_kv is not None:
                 self.chain_kv.close()
         if err is not None:
@@ -239,7 +250,17 @@ class BlockChain:
             return entry.block
         if self.chain_kv is not None:
             from coreth_tpu.rawdb import schema
-            return schema.read_block_by_hash(self.chain_kv, block_hash)
+            blk = schema.read_block_by_hash(self.chain_kv, block_hash)
+            if blk is not None:
+                return blk
+            if self.freezer is not None:
+                # frozen: the hash->number index survives migration
+                num = schema.read_block_number(self.chain_kv,
+                                               block_hash)
+                if num is not None:
+                    raw = self.freezer.body(num)
+                    if raw is not None:
+                        return Block.decode(raw)
         return None
 
     def get_block_by_number(self, number: int) -> Optional[Block]:
@@ -250,7 +271,13 @@ class BlockChain:
             from coreth_tpu.rawdb import schema
             h = h or schema.read_canonical_hash(self.chain_kv, number)
             if h is not None:
-                return schema.read_block(self.chain_kv, number, h)
+                blk = schema.read_block(self.chain_kv, number, h)
+                if blk is not None:
+                    return blk
+        if self.freezer is not None:
+            raw = self.freezer.body(number)
+            if raw is not None:
+                return Block.decode(raw)
         return None
 
     def get_receipts(self, block_hash: bytes) -> Optional[List[Receipt]]:
@@ -258,12 +285,17 @@ class BlockChain:
         if entry is not None and entry.receipts:
             return entry.receipts
         if self.chain_kv is not None:
+            from coreth_tpu import rlp
             from coreth_tpu.rawdb import schema
             from coreth_tpu.types.receipt import decode_consensus_receipt
             num = schema.read_block_number(self.chain_kv, block_hash)
             if num is not None:
                 raw = schema.read_raw_receipts(self.chain_kv, num,
                                                block_hash)
+                if raw is None and self.freezer is not None:
+                    payload = self.freezer.receipts(num)
+                    raw = list(rlp.decode(payload)) \
+                        if payload is not None else None
                 if raw is not None:
                     return [decode_consensus_receipt(r) for r in raw]
         return entry.receipts if entry else None
@@ -556,9 +588,32 @@ class BlockChain:
                                       entry.receipts)
             schema.write_last_accepted(self.chain_kv, block.hash())
             self.trie_writer.accept_trie(block.number, block.root)
+            if self.freezer is not None:
+                self._freeze_tail(block.number)
             self.chain_kv.flush()
         for cb in self._accepted_subs:
             cb(block, entry.receipts)
+
+    def _freeze_tail(self, head_number: int) -> None:
+        """Migrate canonical blocks older than freeze_threshold into
+        the ancient store and drop their mutable copies
+        (freezer.go freeze loop)."""
+        from coreth_tpu.rawdb import schema
+        target = head_number - self.freeze_threshold
+        while self.freezer.ancients() < target:
+            n = self.freezer.ancients() + 1
+            h = schema.read_canonical_hash(self.chain_kv, n)
+            if h is None:
+                break
+            body = schema.raw_body_payload(self.chain_kv, n, h)
+            receipts = schema.raw_receipts_payload(self.chain_kv, n, h)
+            if body is None:
+                break
+            self.freezer.append(n, body, receipts or b"\xc0")
+            schema.delete_block_payloads(self.chain_kv, n, h)
+            # evict the resident entry too: frozen history is cold
+            self._blocks.pop(h, None)
+        self.freezer.flush()
 
     # ------------------------------------------------------------ sync pivot
     def reset_to_synced(self, tip: Block, ancestors: List[Block] = ()

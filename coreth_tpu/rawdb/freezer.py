@@ -1,0 +1,135 @@
+"""Ancient store: immutable flat files for frozen chain segments.
+
+Twin of reference core/rawdb/freezer.go (+ freezer_table.go): accepted
+blocks far enough behind the head move out of the mutable KV log into
+append-only per-table files (bodies, receipts, hashes) addressed by an
+index of fixed-width (offset, length) entries — the data never churns
+the live store again, and the KV log's compaction reclaims it.
+
+Tables here: "bodies" (block RLP), "receipts" (the consensus receipt
+list RLP).  Canonical hashes stay in the KV store (8-byte values are
+not worth a table).  Entries are strictly sequential from block 1
+(genesis never freezes), matching the freezer's append-only contract
+(freezer.go AppendAncient).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional
+
+_IDX = struct.Struct("<QQ")  # (offset, length) per entry
+
+TABLES = ("bodies", "receipts")
+
+
+class FreezerError(Exception):
+    pass
+
+
+class _Table:
+    def __init__(self, directory: str, name: str):
+        self.data_path = os.path.join(directory, f"{name}.dat")
+        self.index_path = os.path.join(directory, f"{name}.idx")
+        self._data = open(self.data_path, "ab")
+        self._index = open(self.index_path, "ab")
+        self.items = os.path.getsize(self.index_path) // _IDX.size
+
+    def append(self, payload: bytes) -> None:
+        offset = self._data.tell()
+        self._data.write(payload)
+        self._index.write(_IDX.pack(offset, len(payload)))
+        self.items += 1
+
+    def get(self, i: int) -> Optional[bytes]:
+        if i < 0 or i >= self.items:
+            return None
+        # a concurrent reader may land between append and the batch
+        # fsync; drain the write buffers so the read handles see
+        # complete entries (no fsync — durability stays batched)
+        self._data.flush()
+        self._index.flush()
+        with open(self.index_path, "rb") as f:
+            f.seek(i * _IDX.size)
+            offset, length = _IDX.unpack(f.read(_IDX.size))
+        with open(self.data_path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def truncate_items(self, n: int) -> None:
+        """Roll back to the first n entries (crash repair)."""
+        self._data.flush()
+        self._index.flush()
+        if n >= self.items:
+            return
+        if n > 0:
+            with open(self.index_path, "rb") as f:
+                f.seek((n - 1) * _IDX.size)
+                offset, length = _IDX.unpack(f.read(_IDX.size))
+            data_end = offset + length
+        else:
+            data_end = 0
+        self._index.close()
+        self._data.close()
+        with open(self.index_path, "r+b") as f:
+            f.truncate(n * _IDX.size)
+        with open(self.data_path, "r+b") as f:
+            f.truncate(data_end)
+        self._data = open(self.data_path, "ab")
+        self._index = open(self.index_path, "ab")
+        self.items = n
+
+    def flush(self) -> None:
+        self._data.flush()
+        os.fsync(self._data.fileno())
+        self._index.flush()
+        os.fsync(self._index.fileno())
+
+    def close(self) -> None:
+        self.flush()
+        self._data.close()
+        self._index.close()
+
+
+class Freezer:
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.tables = {name: _Table(directory, name) for name in TABLES}
+        # crash between table appends: truncate everything to the
+        # shortest table (freezer.go repair semantics) — the dropped
+        # tail blocks are still in the mutable KV store, whose
+        # deletion happens only after a successful freeze
+        shortest = min(t.items for t in self.tables.values())
+        for t in self.tables.values():
+            t.truncate_items(shortest)
+
+    def ancients(self) -> int:
+        """Number of frozen blocks; block numbers 1..ancients() are
+        ancient (freezer.go Ancients)."""
+        return self.tables["bodies"].items
+
+    def append(self, number: int, body: bytes, receipts: bytes) -> None:
+        """Freeze one block; numbers must arrive sequentially
+        (freezer.go AppendAncient)."""
+        if number != self.ancients() + 1:
+            raise FreezerError(
+                f"non-sequential freeze: {number}, have "
+                f"{self.ancients()}")
+        self.tables["bodies"].append(body)
+        self.tables["receipts"].append(receipts)
+
+    def body(self, number: int) -> Optional[bytes]:
+        return self.tables["bodies"].get(number - 1)
+
+    def receipts(self, number: int) -> Optional[bytes]:
+        return self.tables["receipts"].get(number - 1)
+
+    def flush(self) -> None:
+        for t in self.tables.values():
+            t.flush()
+
+    def close(self) -> None:
+        for t in self.tables.values():
+            t.close()

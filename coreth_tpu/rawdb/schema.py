@@ -91,6 +91,24 @@ def read_raw_receipts(kv: KVStore, number: int,
     return list(rlp.decode(raw))
 
 
+def raw_receipts_payload(kv: KVStore, number: int,
+                         block_hash: bytes) -> Optional[bytes]:
+    return kv.get(RECEIPTS_PREFIX + _num8(number) + block_hash)
+
+
+def raw_body_payload(kv: KVStore, number: int,
+                     block_hash: bytes) -> Optional[bytes]:
+    return kv.get(BODY_PREFIX + _num8(number) + block_hash)
+
+
+def delete_block_payloads(kv: KVStore, number: int,
+                          block_hash: bytes) -> None:
+    """Drop the mutable copies after a block froze into the ancient
+    store (freezer migration; the hash->number index stays)."""
+    kv.delete(BODY_PREFIX + _num8(number) + block_hash)
+    kv.delete(RECEIPTS_PREFIX + _num8(number) + block_hash)
+
+
 # ----------------------------------------------------------------- code
 
 def write_code(kv: KVStore, code_hash: bytes, code: bytes) -> None:

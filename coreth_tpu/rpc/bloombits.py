@@ -114,6 +114,26 @@ class BloomIndexer:
             acc |= m
         return acc
 
+    def _section_matches(self, section: int, lo: int, hi: int,
+                         groups: List[List[bytes]]) -> List[int]:
+        """Matching block numbers within one FINISHED section's
+        [lo, hi] clamp (the shared core of plan/candidates)."""
+        rows = self.sections[section]
+        mask = (1 << self.section_size) - 1
+        for g in groups:
+            mask &= self._group_mask(rows, g)
+            if not mask:
+                return []
+        base = section * self.section_size
+        out: List[int] = []
+        while mask:
+            low = mask & -mask
+            number = base + low.bit_length() - 1
+            if lo <= number <= hi:
+                out.append(number)
+            mask ^= low
+        return out
+
     def plan(self, from_block: int, to_block: int,
              groups: List[List[bytes]]) -> List[int]:
         """Block numbers to visit for a query: candidates from every
@@ -122,55 +142,26 @@ class BloomIndexer:
         above a gap still accelerate — eth/filters matcher planning)."""
         groups = [g for g in groups if g]
         out: List[int] = []
-        full = (1 << self.section_size) - 1
         for section in range(from_block // self.section_size,
                              to_block // self.section_size + 1):
             lo = max(from_block, section * self.section_size)
             hi = min(to_block, (section + 1) * self.section_size - 1)
-            rows = self.sections.get(section)
-            if rows is None:
+            if section in self.sections:
+                out.extend(self._section_matches(section, lo, hi,
+                                                 groups))
+            else:
                 out.extend(range(lo, hi + 1))
-                continue
-            mask = full
-            for g in groups:
-                mask &= self._group_mask(rows, g)
-                if not mask:
-                    break
-            base = section * self.section_size
-            m = mask
-            while m:
-                low = m & -m
-                number = base + low.bit_length() - 1
-                if lo <= number <= hi:
-                    out.append(number)
-                m ^= low
         return out
 
     def candidates(self, from_block: int, to_block: int,
                    groups: List[List[bytes]]) -> List[int]:
-        """Block numbers in [from, to] whose blooms may match ALL
-        criteria groups (each group an OR-list of values; empty groups
-        are wildcards).  Only covers finished sections — callers scan
-        the tail linearly."""
+        """Like plan(), but only finished sections answer — callers
+        scan unfinished ranges themselves."""
         groups = [g for g in groups if g]
         out: List[int] = []
-        full = (1 << self.section_size) - 1
         for section in range(from_block // self.section_size,
                              to_block // self.section_size + 1):
-            rows = self.sections.get(section)
-            if rows is None:
-                continue
-            mask = full
-            for g in groups:
-                mask &= self._group_mask(rows, g)
-                if not mask:
-                    break
-            base = section * self.section_size
-            m = mask
-            while m:
-                low = m & -m
-                number = base + low.bit_length() - 1
-                if from_block <= number <= to_block:
-                    out.append(number)
-                m ^= low
+            if section in self.sections:
+                out.extend(self._section_matches(
+                    section, from_block, to_block, groups))
         return out

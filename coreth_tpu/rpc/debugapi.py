@@ -1,0 +1,196 @@
+"""debug_* runtime APIs + continuous profiler.
+
+Twin of reference internal/debug/api.go (:120-257 — cpuProfile,
+writeMemProfile, stacks, gcStats, setGCPercent, freeOSMemory) and the
+continuous profiler plugin/evm/config.go:94 enables via avalanchego's
+profiler: the Python runtime equivalents — cProfile for CPU, gc +
+sys for memory/GC, per-thread stack dumps — exposed under the same
+debug_* names, plus a background profiler writing periodic profile
+files.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import gc
+import io
+import os
+import pstats
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from coreth_tpu.rpc.server import RPCError
+
+
+class _CPUProfiler:
+    """debug_startCPUProfile / stopCPUProfile pair (api.go:179)."""
+
+    def __init__(self):
+        self._profile: Optional[cProfile.Profile] = None
+        self._path: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def start(self, path: str) -> None:
+        with self._lock:
+            if self._profile is not None:
+                raise RPCError("CPU profiling already in progress",
+                               -32000)
+            self._profile = cProfile.Profile()
+            self._path = path
+            self._profile.enable()
+
+    def stop(self) -> str:
+        with self._lock:
+            if self._profile is None:
+                raise RPCError("CPU profiling not in progress", -32000)
+            self._profile.disable()
+            self._profile.dump_stats(self._path)
+            path, self._profile, self._path = self._path, None, None
+            return path
+
+
+def stacks() -> str:
+    """All-thread stack dump (api.go:231 Stacks — the goroutine
+    profile analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = io.StringIO()
+    for ident, frame in sys._current_frames().items():
+        out.write(f"thread {ident} [{names.get(ident, '?')}]:\n")
+        traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue()
+
+
+def register_debug_runtime_api(server) -> _CPUProfiler:
+    cpu = _CPUProfiler()
+
+    def debug_startCPUProfile(file: str):
+        cpu.start(file)
+        return True
+
+    def debug_stopCPUProfile():
+        return cpu.stop()
+
+    def debug_cpuProfile(file: str, seconds: int):
+        """Profile for a fixed duration (api.go:120 CpuProfile)."""
+        cpu.start(file)
+        time.sleep(min(int(seconds), 60))
+        return cpu.stop()
+
+    def debug_stacks():
+        return stacks()
+
+    def debug_gcStats():
+        counts = gc.get_count()
+        return {"collections": [s["collections"]
+                                for s in gc.get_stats()],
+                "collected": [s["collected"] for s in gc.get_stats()],
+                "pending": counts,
+                "enabled": gc.isenabled()}
+
+    def debug_memStats():
+        import resource
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        return {"maxRssKiB": usage.ru_maxrss,
+                "userTime": usage.ru_utime,
+                "systemTime": usage.ru_stime,
+                "gcObjects": len(gc.get_objects())}
+
+    def debug_freeOSMemory():
+        gc.collect()
+        return True
+
+    def debug_setGCPercent(v: int):
+        # Python has thresholds, not a percent — map the sign the way
+        # SetGCPercent does: negative disables collection
+        prev = gc.isenabled()
+        if int(v) < 0:
+            gc.disable()
+        else:
+            gc.enable()
+        return 100 if prev else -1
+
+    for fn in (debug_startCPUProfile, debug_stopCPUProfile,
+               debug_cpuProfile, debug_stacks, debug_gcStats,
+               debug_memStats, debug_freeOSMemory, debug_setGCPercent):
+        server.register(fn.__name__, fn)
+    return cpu
+
+
+class ContinuousProfiler:
+    """Periodic profile dumps (plugin/evm config
+    continuous-profiler-dir/-frequency/-max-files; avalanchego
+    profiler.NewContinuous role): every `frequency` seconds write
+    cpu.profile.N, keeping the newest `max_files`.
+
+    Implemented as a SAMPLING profiler over sys._current_frames() —
+    cProfile only instruments the thread that enables it (which here
+    would spend the window sleeping), while frame sampling sees every
+    thread: acceptor, RPC handlers, recovery workers."""
+
+    def __init__(self, directory: str, frequency: float = 900.0,
+                 max_files: int = 5, sample_interval: float = 0.01):
+        self.directory = directory
+        self.frequency = frequency
+        self.max_files = max_files
+        self.sample_interval = sample_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.dumps = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="continuous-profiler")
+        self._thread.start()
+
+    def _run(self) -> None:
+        n = 0
+        me = threading.get_ident()
+        while not self._stop.is_set():
+            counts: dict = {}
+            samples = 0
+            deadline = time.monotonic() + self.frequency
+            while time.monotonic() < deadline \
+                    and not self._stop.is_set():
+                for ident, frame in sys._current_frames().items():
+                    if ident == me:
+                        continue
+                    key = (frame.f_code.co_filename,
+                           frame.f_lineno, frame.f_code.co_name)
+                    counts[key] = counts.get(key, 0) + 1
+                samples += 1
+                self._stop.wait(self.sample_interval)
+            path = os.path.join(self.directory, f"cpu.profile.{n}")
+            with open(path, "w") as f:
+                f.write(f"samples: {samples}\n")
+                for (fname, line, func), c in sorted(
+                        counts.items(), key=lambda kv: -kv[1])[:100]:
+                    f.write(f"{c:8d}  {func}  {fname}:{line}\n")
+            self.dumps += 1
+            n += 1
+            self._rotate()
+
+    def _rotate(self) -> None:
+        files = sorted(
+            (f for f in os.listdir(self.directory)
+             if f.startswith("cpu.profile.")),
+            key=lambda f: int(f.rsplit(".", 1)[1]))
+        for stale in files[:-self.max_files]:
+            os.unlink(os.path.join(self.directory, stale))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def profile_summary(path: str, top: int = 10) -> str:
+    """Human-readable top-N of a dumped profile (pprof-lite)."""
+    out = io.StringIO()
+    stats = pstats.Stats(path, stream=out)
+    stats.sort_stats("cumulative").print_stats(top)
+    return out.getvalue()

@@ -78,14 +78,19 @@ def register_personal_api(server, keystore: KeyStore) -> None:
 
     def eth_signTypedData_v4(address: str, typed_data):
         import json as _json
-        from coreth_tpu.accounts.eip712 import typed_data_digest
-        if isinstance(typed_data, str):
-            typed_data = _json.loads(typed_data)
-        types = dict(typed_data["types"])
-        types.pop("EIP712Domain", None)
-        digest = typed_data_digest(
-            typed_data["domain"], typed_data["primaryType"],
-            typed_data["message"], types)
+        from coreth_tpu.accounts.eip712 import (
+            EIP712Error, typed_data_digest,
+        )
+        try:
+            if isinstance(typed_data, str):
+                typed_data = _json.loads(typed_data)
+            types = dict(typed_data["types"])
+            types.pop("EIP712Domain", None)
+            digest = typed_data_digest(
+                typed_data["domain"], typed_data["primaryType"],
+                typed_data["message"], types)
+        except (EIP712Error, KeyError, ValueError, TypeError) as e:
+            raise RPCError(f"invalid typed data: {e}", -32602)
         try:
             sig = keystore.sign_hash(_addr(address), digest)
         except KeystoreError as e:

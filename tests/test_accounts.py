@@ -440,3 +440,28 @@ def test_unlock_expiry_and_transient_sign(tmp_path):
     assert len(sig) == 65
     with pytest.raises(KeystoreError, match="locked"):
         ks.sign_hash(addr, b"\x03" * 32)
+
+
+def test_decode_rejects_empty_and_truncated():
+    from coreth_tpu.accounts.abi import ABIError
+    with pytest.raises(ABIError, match="truncated"):
+        decode_values(["uint256"], b"")
+    with pytest.raises(ABIError, match="truncated"):
+        decode_values(["uint256", "address"], b"\x00" * 32)
+    with pytest.raises(ABIError):
+        decode_values(["bytes"], (32).to_bytes(32, "big")
+                      + (100).to_bytes(32, "big") + b"\x01" * 10)
+
+
+def test_eip712_json_hex_values():
+    """bytes32/uint values arriving as JSON hex strings normalize
+    before encoding (apitypes value parsing)."""
+    types = {"Order": [{"name": "hash", "type": "bytes32"},
+                       {"name": "amount", "type": "uint256"}]}
+    d1 = typed_data_digest({"name": "x"}, "Order",
+                           {"hash": "0x" + "ab" * 32,
+                            "amount": "0x64"}, types)
+    d2 = typed_data_digest({"name": "x"}, "Order",
+                           {"hash": b"\xab" * 32, "amount": 100},
+                           types)
+    assert d1 == d2

@@ -1,0 +1,64 @@
+"""debug_* runtime APIs + continuous profiler (internal/debug twin)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.rpc.debugapi import (
+    ContinuousProfiler, profile_summary, register_debug_runtime_api,
+)
+from coreth_tpu.rpc.server import RPCError, RPCServer
+
+
+def _server():
+    s = RPCServer()
+    register_debug_runtime_api(s)
+    return s
+
+
+def test_cpu_profile_start_stop(tmp_path):
+    s = _server()
+    path = str(tmp_path / "cpu.prof")
+    assert s.handle_call("debug_startCPUProfile", [path]) is True
+    with pytest.raises(RPCError, match="in progress"):
+        s.handle_call("debug_startCPUProfile", [path])
+    sum(i * i for i in range(2000))  # some work to record
+    assert s.handle_call("debug_stopCPUProfile", []) == path
+    assert os.path.getsize(path) > 0
+    with pytest.raises(RPCError, match="not in progress"):
+        s.handle_call("debug_stopCPUProfile", [])
+    assert "cumulative" in profile_summary(path, top=3)
+
+
+def test_stacks_and_runtime_stats():
+    s = _server()
+    dump = s.handle_call("debug_stacks", [])
+    assert "test_stacks_and_runtime_stats" in dump
+    assert "MainThread" in dump
+    gcs = s.handle_call("debug_gcStats", [])
+    assert gcs["enabled"] is True
+    mem = s.handle_call("debug_memStats", [])
+    assert mem["maxRssKiB"] > 0 and mem["gcObjects"] > 0
+    assert s.handle_call("debug_freeOSMemory", []) is True
+    s.handle_call("debug_setGCPercent", [-1])
+    import gc
+    assert not gc.isenabled()
+    s.handle_call("debug_setGCPercent", [100])
+    assert gc.isenabled()
+
+
+def test_continuous_profiler_rotates(tmp_path):
+    p = ContinuousProfiler(str(tmp_path), frequency=0.05, max_files=2)
+    p.start()
+    deadline = time.monotonic() + 5
+    while p.dumps < 4 and time.monotonic() < deadline:
+        sum(i for i in range(500))
+        time.sleep(0.02)
+    p.stop()
+    assert p.dumps >= 4
+    files = sorted(os.listdir(tmp_path))
+    assert 1 <= len(files) <= 2  # rotation keeps only the newest

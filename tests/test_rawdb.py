@@ -308,3 +308,68 @@ def test_insert_block_runs_prefetcher(tmp_path):
     chain.drain_acceptor_queue()
     assert chain.last_accepted.hash() == blocks[-1].hash()
     chain.close()
+
+
+def test_freezer_migrates_old_blocks(tmp_path):
+    """Blocks freeze_threshold behind the head migrate to the ancient
+    store; reads fall through and the mutable copies are deleted
+    (core/rawdb/freezer.go role)."""
+    from coreth_tpu.rawdb.freezer import Freezer, FreezerError
+
+    genesis = _genesis()
+    blocks = _build_blocks(genesis, 8)
+    path = str(tmp_path / "chain.log")
+    fdir = str(tmp_path / "ancient")
+    chain = BlockChain(genesis, chain_kv=FileDB(path), commit_interval=1,
+                       freezer_dir=fdir, freeze_threshold=3)
+    chain.insert_chain(blocks)
+    chain.drain_acceptor_queue()
+    # head 8, threshold 3 -> blocks 1..5 are ancient
+    assert chain.freezer.ancients() == 5
+    # mutable copies deleted, reads still resolve through the freezer
+    h1 = blocks[0].hash()
+    assert schema.read_block(chain.chain_kv, 1, h1) is None
+    got = chain.get_block_by_number(1)
+    assert got is not None and got.hash() == h1
+    recs = chain.get_receipts(h1)
+    assert recs is not None and len(recs) == len(blocks[0].transactions)
+    # recent blocks stay mutable
+    assert schema.read_block(chain.chain_kv, 7,
+                             blocks[6].hash()) is not None
+    chain.close()
+
+    # reopen: ancient counts + reads survive
+    chain2 = BlockChain(_genesis(), chain_kv=FileDB(path),
+                        commit_interval=1, freezer_dir=fdir,
+                        freeze_threshold=3)
+    assert chain2.freezer.ancients() == 5
+    assert chain2.get_block_by_number(2).hash() == blocks[1].hash()
+    assert chain2.last_accepted.hash() == blocks[-1].hash()
+    chain2.close()
+
+    # the freezer's append-only contract is enforced
+    f = Freezer(str(tmp_path / "fresh"))
+    f.append(1, b"a", b"r")
+    with pytest.raises(FreezerError, match="non-sequential"):
+        f.append(3, b"b", b"r")
+    f.close()
+
+
+def test_freezer_repairs_out_of_sync_tables(tmp_path):
+    """A crash between table appends truncates to the shortest table
+    on reopen instead of bricking (freezer.go repair)."""
+    from coreth_tpu.rawdb.freezer import Freezer
+    d = str(tmp_path / "anc")
+    f = Freezer(d)
+    f.append(1, b"body1", b"rec1")
+    f.append(2, b"body2", b"rec2")
+    # simulate the torn append: bodies has an extra entry
+    f.tables["bodies"].append(b"body3")
+    f.close()
+    f2 = Freezer(d)
+    assert f2.ancients() == 2          # truncated to the shortest
+    assert f2.body(2) == b"body2"
+    assert f2.receipts(2) == b"rec2"
+    f2.append(3, b"body3", b"rec3")    # appends resume cleanly
+    assert f2.body(3) == b"body3"
+    f2.close()
