@@ -271,23 +271,29 @@ class Contract:
             if entry.get("type") == "function":
                 ins = [i["type"] for i in entry.get("inputs", [])]
                 outs = [o["type"] for o in entry.get("outputs", [])]
-                self.methods[entry["name"]] = (ins, outs,
-                                               entry.get(
-                                                   "stateMutability"))
+                # overloads get numeric suffixes like geth's abi.go
+                # ("name", "name0", "name1", ...) — each keeps its own
+                # selector; the name itself stays callable as keyed
+                key, n = entry["name"], 0
+                while key in self.methods:
+                    key = f"{entry['name']}{n}"
+                    n += 1
+                self.methods[key] = (entry["name"], ins, outs,
+                                     entry.get("stateMutability"))
             elif entry.get("type") == "event":
                 ins = [i["type"] for i in entry.get("inputs", [])]
                 self.events[entry["name"]] = (
                     event_topic(entry["name"], ins), entry["inputs"])
 
     def encode(self, name: str, *args) -> bytes:
-        ins, _, _ = self.methods[name]
-        return encode_call(name, ins, list(args))
+        abi_name, ins, _, _ = self.methods[name]
+        return encode_call(abi_name, ins, list(args))
 
     def call(self, name: str, *args):
         """Execute a read; decodes the outputs (single value unwrapped)."""
         if self.call_fn is None:
             raise ABIError("no call executor bound")
-        ins, outs, _ = self.methods[name]
+        _, _, outs, _ = self.methods[name]
         ret = self.call_fn(self.address, self.encode(name, *args))
         vals = decode_values(outs, ret)
         return vals[0] if len(vals) == 1 else tuple(vals)

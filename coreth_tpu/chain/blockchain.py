@@ -294,8 +294,9 @@ class BlockChain:
                                                block_hash)
                 if raw is None and self.freezer is not None:
                     payload = self.freezer.receipts(num)
+                    # empty payload marks receipts-unknown, not []
                     raw = list(rlp.decode(payload)) \
-                        if payload is not None else None
+                        if payload else None
                 if raw is not None:
                     return [decode_consensus_receipt(r) for r in raw]
         return entry.receipts if entry else None
@@ -600,6 +601,7 @@ class BlockChain:
         (freezer.go freeze loop)."""
         from coreth_tpu.rawdb import schema
         target = head_number - self.freeze_threshold
+        froze = False
         while self.freezer.ancients() < target:
             n = self.freezer.ancients() + 1
             h = schema.read_canonical_hash(self.chain_kv, n)
@@ -609,11 +611,15 @@ class BlockChain:
             receipts = schema.raw_receipts_payload(self.chain_kv, n, h)
             if body is None:
                 break
-            self.freezer.append(n, body, receipts or b"\xc0")
+            # empty payload = receipts unknown (a state-synced block
+            # stored without them) — NOT an empty receipt list
+            self.freezer.append(n, body, receipts or b"")
             schema.delete_block_payloads(self.chain_kv, n, h)
             # evict the resident entry too: frozen history is cold
             self._blocks.pop(h, None)
-        self.freezer.flush()
+            froze = True
+        if froze:
+            self.freezer.flush()
 
     # ------------------------------------------------------------ sync pivot
     def reset_to_synced(self, tip: Block, ancestors: List[Block] = ()

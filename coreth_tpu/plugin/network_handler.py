@@ -41,14 +41,39 @@ class SignatureRequestHandler:
         return SignatureResponse(sig)
 
 
+class CrossChainHandler:
+    """Serves cross-chain eth_call requests against the chain's
+    accepted tip (plugin/evm/message/cross_chain_handler.go): errors
+    travel in-band so a bad call never poisons the transport."""
+
+    def __init__(self, backend):
+        self.backend = backend  # rpc.Backend (eth_call executor)
+
+    def on_eth_call(self, req) -> "EthCallResponse":
+        from coreth_tpu.sync.messages import EthCallResponse
+        try:
+            block = self.backend.chain.last_accepted
+            result = self.backend.call(
+                {"to": "0x" + req.to.hex(),
+                 "data": "0x" + req.data.hex()}, block)
+            if result.failed:
+                return EthCallResponse(error="execution reverted")
+            return EthCallResponse(result=result.return_data)
+        except Exception as e:  # noqa: BLE001 — in-band error
+            return EthCallResponse(error=f"{type(e).__name__}: {e}")
+
+
 class NetworkHandler:
     """networkHandler (plugin/evm/network_handler.go): the single
     request_handler joined to the AppNetwork."""
 
-    def __init__(self, sync_handler=None, warp_backend=None):
+    def __init__(self, sync_handler=None, warp_backend=None,
+                 eth_backend=None):
         self.sync_handler = sync_handler
         self.signature_handler = (SignatureRequestHandler(warp_backend)
                                   if warp_backend is not None else None)
+        self.cross_chain_handler = (CrossChainHandler(eth_backend)
+                                    if eth_backend is not None else None)
 
     def handle(self, raw: bytes) -> bytes:
         kind = raw[0]
@@ -57,6 +82,15 @@ class NetworkHandler:
                 return SignatureResponse(b"").encode()
             return self.signature_handler.on_signature_request(
                 SignatureRequest.decode(raw)).encode()
+        if kind == 8:
+            from coreth_tpu.sync.messages import (
+                EthCallRequest, EthCallResponse,
+            )
+            if self.cross_chain_handler is None:
+                return EthCallResponse(
+                    error="eth_call not served here").encode()
+            return self.cross_chain_handler.on_eth_call(
+                EthCallRequest.decode(raw)).encode()
         if self.sync_handler is None:
             raise ValueError(f"no handler for message kind {kind}")
         return self.sync_handler.handle(raw)

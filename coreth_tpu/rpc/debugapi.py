@@ -75,10 +75,16 @@ def register_debug_runtime_api(server) -> _CPUProfiler:
         return cpu.stop()
 
     def debug_cpuProfile(file: str, seconds: int):
-        """Profile for a fixed duration (api.go:120 CpuProfile)."""
+        """Profile the RPC handler thread for a fixed duration
+        (api.go:120 CpuProfile).  cProfile is thread-local in
+        CPython, so this captures work executed by THIS handler (the
+        start/stop pair brackets the caller's own activity); for a
+        process-wide view use the sampling ContinuousProfiler."""
         cpu.start(file)
-        time.sleep(min(int(seconds), 60))
-        return cpu.stop()
+        try:
+            time.sleep(max(0, min(int(seconds), 60)))
+        finally:
+            return cpu.stop()
 
     def debug_stacks():
         return stacks()
@@ -148,7 +154,12 @@ class ContinuousProfiler:
         self._thread.start()
 
     def _run(self) -> None:
-        n = 0
+        # resume numbering past any pre-restart dumps, or rotation
+        # would treat stale files as newest and delete fresh ones
+        existing = [int(f.rsplit(".", 1)[1])
+                    for f in os.listdir(self.directory)
+                    if f.startswith("cpu.profile.")]
+        n = max(existing) + 1 if existing else 0
         me = threading.get_ident()
         while not self._stop.is_set():
             counts: dict = {}

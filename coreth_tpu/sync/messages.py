@@ -201,9 +201,50 @@ class SignatureResponse:
         return cls(u.var_bytes())
 
 
+@dataclass
+class EthCallRequest:
+    """Cross-chain eth_call (message/eth_call_request.go): another
+    chain's VM evaluates a read against this chain's tip state."""
+    to: bytes = b"\x00" * 20
+    data: bytes = b""
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(8)
+        p.fixed(self.to, 20)
+        p.var_bytes(self.data)
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "EthCallRequest":
+        u = Unpacker(data)
+        assert u.u8() == 8
+        return cls(u.fixed(20), u.var_bytes())
+
+
+@dataclass
+class EthCallResponse:
+    result: bytes = b""
+    error: str = ""
+
+    def encode(self) -> bytes:
+        p = Packer()
+        p.u8(9)
+        p.var_bytes(self.result)
+        p.var_bytes(self.error.encode())
+        return p.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "EthCallResponse":
+        u = Unpacker(data)
+        assert u.u8() == 9
+        return cls(u.var_bytes(), u.var_bytes().decode())
+
+
 def decode_message(data: bytes):
     kind = data[0]
     return {0: LeafsRequest, 1: LeafsResponse, 2: CodeRequest,
             3: CodeResponse, 4: BlockRequest, 5: BlockResponse,
-            6: SignatureRequest,
-            7: SignatureResponse}[kind].decode(data)
+            6: SignatureRequest, 7: SignatureResponse,
+            8: EthCallRequest,
+            9: EthCallResponse}[kind].decode(data)

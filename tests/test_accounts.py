@@ -465,3 +465,70 @@ def test_eip712_json_hex_values():
                            {"hash": b"\xab" * 32, "amount": 100},
                            types)
     assert d1 == d2
+
+
+def test_abigen_generates_working_bindings(tmp_path):
+    """tools/abigen.py emits a module whose class drives the Contract
+    binding end-to-end (cmd/abigen role)."""
+    import importlib.util
+    import subprocess
+    abi = [
+        {"type": "function", "name": "balanceOf",
+         "inputs": [{"name": "owner", "type": "address"}],
+         "outputs": [{"name": "", "type": "uint256"}],
+         "stateMutability": "view"},
+        {"type": "function", "name": "transfer",
+         "inputs": [{"name": "to", "type": "address"},
+                    {"name": "value", "type": "uint256"}],
+         "outputs": [{"name": "", "type": "bool"}],
+         "stateMutability": "nonpayable"},
+        {"type": "event", "name": "Transfer",
+         "inputs": [
+             {"name": "from", "type": "address", "indexed": True},
+             {"name": "to", "type": "address", "indexed": True},
+             {"name": "value", "type": "uint256", "indexed": False}]},
+    ]
+    abi_path = tmp_path / "erc20.json"
+    abi_path.write_text(json.dumps(abi))
+    out_path = tmp_path / "erc20_bindings.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "abigen.py"),
+         "--abi", str(abi_path), "--type", "ERC20",
+         "--out", str(out_path)],
+        check=True, env={**os.environ, "PYTHONPATH": repo})
+    spec = importlib.util.spec_from_file_location("erc20_bindings",
+                                                  out_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def call_fn(to, data):
+        assert data[:4] == selector("balanceOf", ["address"])
+        return (55).to_bytes(32, "big")
+
+    sent = []
+    token = mod.ERC20(b"\x71" * 20, call_fn=call_fn,
+                      send_fn=lambda to, data: sent.append(data))
+    assert token.balanceOf(b"\x01" * 20) == 55
+    token.transfer(b"\x02" * 20, 9)
+    assert sent and sent[0][:4] == selector("transfer",
+                                            ["address", "uint256"])
+
+
+def test_contract_overloaded_functions():
+    """Overloads resolve to distinct keys with distinct selectors
+    (geth abi.go name, name0 convention)."""
+    abi = [
+        {"type": "function", "name": "f",
+         "inputs": [{"name": "a", "type": "uint256"}],
+         "outputs": []},
+        {"type": "function", "name": "f",
+         "inputs": [{"name": "a", "type": "uint256"},
+                    {"name": "b", "type": "bytes"}],
+         "outputs": []},
+    ]
+    c = Contract(b"\x01" * 20, abi)
+    assert set(c.methods) == {"f", "f0"}
+    assert c.encode("f", 1)[:4] == selector("f", ["uint256"])
+    assert c.encode("f0", 1, b"x")[:4] \
+        == selector("f", ["uint256", "bytes"])

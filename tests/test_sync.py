@@ -155,3 +155,65 @@ def test_block_request_hash_chain():
     bad_client = SyncClient(tamper, retries=1)
     with pytest.raises(SyncClientError):
         bad_client.get_blocks(blocks[-1].hash(), blocks[-1].number, 2)
+
+
+def test_cross_chain_eth_call_over_network():
+    """Cross-chain eth_call (message/cross_chain_handler.go): peer A
+    evaluates a contract read against its accepted tip on behalf of
+    peer B, errors travel in-band."""
+    from coreth_tpu.chain import BlockChain, Genesis, GenesisAccount, \
+        generate_chain
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.peer.network import AppNetwork
+    from coreth_tpu.plugin.network_handler import NetworkHandler
+    from coreth_tpu.rpc import Backend
+    from coreth_tpu.state import Database
+    from coreth_tpu.sync.messages import (
+        EthCallRequest, EthCallResponse, decode_message,
+    )
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    from coreth_tpu.workloads.erc20 import (
+        balance_slot, token_genesis_account, transfer_calldata,
+    )
+    from coreth_tpu.accounts import encode_call
+
+    GWEI = 10**9
+    key = 0xCC411
+    addr = priv_to_address(key)
+    token = bytes([0x7F]) * 20
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc={
+        addr: GenesisAccount(balance=10**24),
+        token: token_genesis_account({addr: 10**20}),
+    })
+    db = Database()
+    gblock = genesis.to_block(db)
+
+    def gen(i, bg):
+        bg.add_tx(sign_tx(DynamicFeeTx(
+            chain_id_=CFG.chain_id, nonce=0, gas_tip_cap_=GWEI,
+            gas_fee_cap_=300 * GWEI, gas=100_000, to=token, value=0,
+            data=transfer_calldata(b"\x77" * 20, 123)), key,
+            CFG.chain_id))
+
+    blocks, _ = generate_chain(CFG, gblock, db, 1, gen, gap=2)
+    chain = BlockChain(genesis)
+    chain.insert_chain(blocks)
+    backend = Backend(chain)
+
+    net = AppNetwork()
+    net.join(b"\x0A" * 20, request_handler=NetworkHandler(
+        eth_backend=backend).handle)
+    client = net.join(b"\x0B" * 20)
+
+    calldata = encode_call("balanceOf", ["address"], [b"\x77" * 20])
+    raw = client.send_request_any(
+        EthCallRequest(to=token, data=calldata).encode())
+    resp = decode_message(raw)
+    assert isinstance(resp, EthCallResponse)
+    assert resp.error == ""
+    assert int.from_bytes(resp.result, "big") == 123
+    # in-band error for a call the EVM rejects
+    bad = client.send_request_any(
+        EthCallRequest(to=token, data=b"\xde\xad\xbe\xef").encode())
+    assert decode_message(bad).error != ""
