@@ -4,21 +4,40 @@ Each Peer registers a request handler (bytes -> bytes) and a gossip
 handler (bytes -> None).  send_request routes to a named peer (or any
 peer but the sender — SendAppRequestAny), gossip fans out to everyone
 else.  Peer tracking records response counts/failures per peer so
-callers can prefer responsive peers (peer_tracker.go role, simplified
-to the scoring seam).
+callers can prefer responsive peers.  Peer selection is
+BANDWIDTH-AWARE (peer_tracker.go:431): every response updates an
+exponentially-weighted bytes/sec estimate per peer, requests usually
+go to the fastest known responder, and a fraction explore randomly so
+newly-joined or recovered peers get measured (the tracker's
+randomness/exploitation split).
 """
 
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
+
+# fraction of requests that explore an unmeasured/slower peer
+# (peer_tracker.go randomPeerProbability role)
+EXPLORE_PROBABILITY = 0.2
+BANDWIDTH_HALFLIFE = 0.75  # EMA keep-fraction per observation
 
 
 @dataclass
 class PeerStats:
     requests: int = 0
     failures: int = 0
+    bandwidth: float = 0.0  # EMA bytes/sec over served responses
+
+    def observe(self, nbytes: int, seconds: float) -> None:
+        sample = nbytes / max(seconds, 1e-9)
+        if self.bandwidth == 0.0:
+            self.bandwidth = sample
+        else:
+            self.bandwidth = (BANDWIDTH_HALFLIFE * self.bandwidth
+                              + (1 - BANDWIDTH_HALFLIFE) * sample)
 
 
 class Peer:
@@ -65,23 +84,43 @@ class AppNetwork:
         if peer is None or peer.request_handler is None:
             stats.failures += 1
             raise ConnectionError(f"no handler at {to_id.hex()}")
+        t0 = time.monotonic()
         try:
-            return peer.request_handler(payload)
+            response = peer.request_handler(payload)
         except Exception:
             stats.failures += 1
             raise
+        stats.observe(len(response), time.monotonic() - t0)
+        return response
+
+    def _rank(self, candidates: List[Peer]) -> List[Peer]:
+        """Bandwidth-aware ordering with exploration
+        (peer_tracker.go GetAnyPeer): mostly exploit the fastest
+        measured peer; sometimes lead with an unmeasured/random one so
+        fresh peers get a bandwidth sample."""
+        def score(p: Peer):
+            s = self.stats[p.node_id]
+            return (s.failures, -s.bandwidth, s.requests)
+
+        ordered = sorted(candidates, key=score)
+        unmeasured = [p for p in candidates
+                      if self.stats[p.node_id].bandwidth == 0.0
+                      and self.stats[p.node_id].failures == 0]
+        if self._rng.random() < EXPLORE_PROBABILITY:
+            probe = (self._rng.choice(unmeasured) if unmeasured
+                     else self._rng.choice(candidates))
+            ordered.remove(probe)
+            ordered.insert(0, probe)
+        return ordered
 
     def route_request_any(self, from_id: bytes, payload: bytes) -> bytes:
-        """Prefer peers with the best response record (tracker role)."""
+        """Prefer the fastest responsive peer (tracker role)."""
         candidates = [p for nid, p in self.peers.items()
                       if nid != from_id and p.request_handler is not None]
         if not candidates:
             raise ConnectionError("no peers")
-        candidates.sort(key=lambda p: (
-            self.stats[p.node_id].failures,
-            -self.stats[p.node_id].requests))
         errs: List[Exception] = []
-        for peer in candidates:
+        for peer in self._rank(candidates):
             try:
                 return self.route_request(from_id, peer.node_id, payload)
             except Exception as e:  # noqa: BLE001 — try the next peer

@@ -80,11 +80,16 @@ def register_debug_runtime_api(server) -> _CPUProfiler:
         CPython, so this captures work executed by THIS handler (the
         start/stop pair brackets the caller's own activity); for a
         process-wide view use the sampling ContinuousProfiler."""
+        try:
+            duration = max(0, min(int(seconds), 60))
+        except (TypeError, ValueError):
+            raise RPCError("invalid duration", -32602)
         cpu.start(file)
         try:
-            time.sleep(max(0, min(int(seconds), 60)))
+            time.sleep(duration)
         finally:
-            return cpu.stop()
+            path = cpu.stop()  # always released; exceptions propagate
+        return path
 
     def debug_stacks():
         return stacks()
@@ -158,7 +163,8 @@ class ContinuousProfiler:
         # would treat stale files as newest and delete fresh ones
         existing = [int(f.rsplit(".", 1)[1])
                     for f in os.listdir(self.directory)
-                    if f.startswith("cpu.profile.")]
+                    if f.startswith("cpu.profile.")
+                    and f.rsplit(".", 1)[1].isdigit()]
         n = max(existing) + 1 if existing else 0
         me = threading.get_ident()
         while not self._stop.is_set():
@@ -188,7 +194,8 @@ class ContinuousProfiler:
     def _rotate(self) -> None:
         files = sorted(
             (f for f in os.listdir(self.directory)
-             if f.startswith("cpu.profile.")),
+             if f.startswith("cpu.profile.")
+             and f.rsplit(".", 1)[1].isdigit()),
             key=lambda f: int(f.rsplit(".", 1)[1]))
         for stale in files[:-self.max_files]:
             os.unlink(os.path.join(self.directory, stale))

@@ -292,3 +292,40 @@ def test_shutdown_tracker(tmp_path):
     assert prev == [1000]               # the crashed run is reported
     st3.mark_clean_shutdown()
     kv.close()
+
+
+def test_peer_tracker_bandwidth_preference():
+    """route_request_any prefers the fastest measured peer and still
+    explores unmeasured ones (peer_tracker.go bandwidth tracking)."""
+    import time as _time
+    from coreth_tpu.peer.network import AppNetwork, EXPLORE_PROBABILITY
+
+    net = AppNetwork(seed=7)
+    served = {"fast": 0, "slow": 0}
+
+    def fast(payload):
+        served["fast"] += 1
+        return b"x" * 4096
+
+    def slow(payload):
+        served["slow"] += 1
+        _time.sleep(0.002)
+        return b"x" * 64
+
+    net.join(b"\x01" * 20, request_handler=fast)
+    net.join(b"\x02" * 20, request_handler=slow)
+    client = net.join(b"\x03" * 20)
+    for _ in range(50):
+        client.send_request_any(b"q")
+    # the fast peer dominates; the slow one still gets exploration
+    assert served["fast"] > served["slow"]
+    assert served["slow"] >= 1
+    assert net.stats[b"\x01" * 20].bandwidth \
+        > net.stats[b"\x02" * 20].bandwidth
+    # a failing peer drops to the back regardless of bandwidth
+    def dying(payload):
+        raise RuntimeError("down")
+    net.join(b"\x04" * 20, request_handler=dying)
+    for _ in range(20):
+        client.send_request_any(b"q")
+    assert net.stats[b"\x04" * 20].failures <= 20 * EXPLORE_PROBABILITY * 3
