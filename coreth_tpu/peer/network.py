@@ -30,11 +30,13 @@ class PeerStats:
     requests: int = 0
     failures: int = 0
     bandwidth: float = 0.0  # EMA bytes/sec over served responses
+    measured: bool = False  # distinct from bandwidth==0 (empty reply)
 
     def observe(self, nbytes: int, seconds: float) -> None:
         sample = nbytes / max(seconds, 1e-9)
-        if self.bandwidth == 0.0:
+        if not self.measured:
             self.bandwidth = sample
+            self.measured = True
         else:
             self.bandwidth = (BANDWIDTH_HALFLIFE * self.bandwidth
                               + (1 - BANDWIDTH_HALFLIFE) * sample)
@@ -87,10 +89,11 @@ class AppNetwork:
         t0 = time.monotonic()
         try:
             response = peer.request_handler(payload)
+            size = len(response)  # non-bytes return = handler fault
         except Exception:
             stats.failures += 1
             raise
-        stats.observe(len(response), time.monotonic() - t0)
+        stats.observe(size, time.monotonic() - t0)
         return response
 
     def _rank(self, candidates: List[Peer]) -> List[Peer]:
@@ -104,7 +107,7 @@ class AppNetwork:
 
         ordered = sorted(candidates, key=score)
         unmeasured = [p for p in candidates
-                      if self.stats[p.node_id].bandwidth == 0.0
+                      if not self.stats[p.node_id].measured
                       and self.stats[p.node_id].failures == 0]
         if self._rng.random() < EXPLORE_PROBABILITY:
             probe = (self._rng.choice(unmeasured) if unmeasured
