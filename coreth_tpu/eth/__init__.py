@@ -1,0 +1,6 @@
+"""The Ethereum engine facade (reference eth/ package)."""
+
+from coreth_tpu.eth.backend import Ethereum
+from coreth_tpu.eth.ethconfig import DEFAULTS, EthConfig
+
+__all__ = ["DEFAULTS", "EthConfig", "Ethereum"]

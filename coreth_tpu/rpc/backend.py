@@ -23,10 +23,22 @@ from coreth_tpu.types import Block, LatestSigner, Receipt, Transaction
 
 
 class Backend:
-    def __init__(self, chain, txpool=None, bloom_section_size=None):
+    def __init__(self, chain, txpool=None, bloom_section_size=None,
+                 rpc_gas_cap: int = 50_000_000,
+                 network_id: Optional[int] = None,
+                 allow_unfinalized_queries: bool = True,
+                 gpo_blocks: Optional[int] = None,
+                 gpo_percentile: Optional[int] = None):
         self.chain = chain
         self.txpool = txpool
         self.config = chain.config
+        self.rpc_gas_cap = rpc_gas_cap
+        self.network_id = network_id or chain.config.chain_id
+        # AllowUnfinalizedQueries gating (eth/api_backend.go): when
+        # off, "latest" resolves to the last ACCEPTED block
+        self.allow_unfinalized_queries = allow_unfinalized_queries
+        self.gpo_blocks = gpo_blocks
+        self.gpo_percentile = gpo_percentile
         self.signer = LatestSigner(chain.config.chain_id)
         # tx hash -> (block hash, index); filled lazily per block
         self._tx_lookup: dict = {}
@@ -60,8 +72,9 @@ class Backend:
     # ------------------------------------------------------------- blocks
     def resolve_block(self, tag) -> Block:
         if tag is None or tag in ("latest", "pending", "accepted"):
-            return self.chain.last_accepted if tag == "accepted" \
-                else self.chain.current_block()
+            if tag == "accepted" or not self.allow_unfinalized_queries:
+                return self.chain.last_accepted
+            return self.chain.current_block()
         if tag == "earliest":
             return self.chain.genesis_block
         if isinstance(tag, str):
@@ -110,7 +123,9 @@ class Backend:
         return block, receipts[idx], idx
 
     # ------------------------------------------------------------ execute
-    def call(self, args: dict, block: Block, gas_cap: int = 50_000_000):
+    def call(self, args: dict, block: Block,
+             gas_cap: Optional[int] = None):
+        gas_cap = gas_cap or self.rpc_gas_cap
         """eth_call semantics (internal/ethapi api.go DoCall): run the
         message on the block's state with account checks skipped and
         base-fee enforcement off; returns the ExecutionResult."""
@@ -148,9 +163,10 @@ class Backend:
         )
 
     def estimate_gas(self, args: dict, block: Block,
-                     gas_cap: int = 50_000_000) -> int:
+                     gas_cap: Optional[int] = None) -> int:
         """Binary search the minimum sufficient gas (api.go
         DoEstimateGas shape)."""
+        gas_cap = gas_cap or self.rpc_gas_cap
         lo = 21_000 - 1
         hi = min(to_int(args.get("gas"), gas_cap), gas_cap)
 

@@ -114,7 +114,11 @@ def receipt_json(block: Block, receipt: Receipt, tx: Transaction,
 
 def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
     b = backend
-    oracle = Oracle(b)
+    from coreth_tpu.rpc.gasprice import (
+        DEFAULT_BLOCKS as _GB, DEFAULT_PERCENTILE as _GP,
+    )
+    oracle = Oracle(b, getattr(b, "gpo_blocks", None) or _GB,
+                    getattr(b, "gpo_percentile", None) or _GP)
     filters = FilterSystem(b)
 
     def eth_chainId():
@@ -218,7 +222,7 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
         return filters.uninstall(fid)
 
     def net_version():
-        return str(b.config.chain_id)
+        return str(getattr(b, "network_id", None) or b.config.chain_id)
 
     def web3_clientVersion():
         return "coreth-tpu/0.3.0"

@@ -1,0 +1,111 @@
+"""The Ethereum facade: one constructor for the whole engine
+(eth/backend.go New/APIs shape)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.eth import EthConfig, Ethereum
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.types import DynamicFeeTx
+
+GWEI = 10**9
+KEY = 0xE7B
+ADDR = priv_to_address(KEY)
+
+
+def test_ethereum_facade_end_to_end(tmp_path):
+    """Construct the full stack, mine a keystore-signed tx through the
+    pool, accept it, and read everything back through the attached
+    client — HTTP and WS both live."""
+    cfg = EthConfig(keystore_dir=str(tmp_path / "keys"),
+                    bloom_section_size=16)
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDR: GenesisAccount(balance=10**24)})
+    t = [1_000]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    eth = Ethereum(genesis, cfg, clock=clock)
+    try:
+        assert eth.chain.snaps is not None      # snapshot_cache > 0
+        addr = eth.keystore.import_key(KEY, "pw")
+        assert addr == ADDR
+        eth.keystore.unlock(ADDR, "pw")
+        tx = eth.keystore.sign_tx(ADDR, DynamicFeeTx(
+            chain_id_=CFG.chain_id, nonce=0, gas_tip_cap_=GWEI,
+            gas_fee_cap_=300 * GWEI, gas=21_000, to=b"\x55" * 20,
+            value=777), CFG.chain_id)
+        assert eth.txpool.add_remotes([tx]) == [None]
+        block = eth.miner.generate_block()
+        eth.chain.insert_block(block)
+        eth.chain.accept(block.hash())
+        eth.chain.drain_acceptor_queue()
+
+        port = eth.serve_http()
+        client = eth.attach()
+        assert client.block_number() == 1
+        assert client.balance_at(b"\x55" * 20) == 777
+        rec = client.transaction_receipt(tx.hash())
+        assert int(rec["status"], 16) == 1
+        # personal namespace is registered (keystore configured)
+        accounts = client.call_rpc("personal_listAccounts")
+        assert accounts == ["0x" + ADDR.hex()]
+        # debug runtime namespace is registered
+        assert "MainThread" in client.call_rpc("debug_stacks")
+
+        ws_port = eth.serve_ws()
+        from coreth_tpu.rpc.websocket import WSClient
+        ws = WSClient("127.0.0.1", ws_port)
+        assert int(ws.call("eth_blockNumber"), 16) == 1
+        ws.close()
+    finally:
+        eth.stop()
+
+
+def test_ethereum_archive_and_kv(tmp_path):
+    """pruning=False (archive) + durable store + freezer knobs flow
+    through to the chain; reopen resumes."""
+    from coreth_tpu.rawdb import FileDB
+    cfg = EthConfig(pruning=False, snapshot_cache=0,
+                    freezer_dir=str(tmp_path / "ancient"),
+                    freeze_threshold=2)
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDR: GenesisAccount(balance=10**24)})
+    eth = Ethereum(genesis, cfg,
+                   chain_kv=FileDB(str(tmp_path / "chain.log")))
+    assert eth.chain.snaps is None
+    assert eth.chain.trie_writer.archive is True
+    assert eth.chain.freezer is not None
+    eth.stop()
+
+
+def test_config_knobs_wired():
+    """rpc_gas_cap / gpo / network_id / unfinalized gating reach the
+    served surface (no silent no-op knobs)."""
+    from coreth_tpu.eth.ethconfig import GPODefaults
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={ADDR: GenesisAccount(balance=10**24)})
+    cfg = EthConfig(network_id=99, rpc_gas_cap=123_456,
+                    gpo=GPODefaults(blocks=7, percentile=90),
+                    allow_unfinalized_queries=False)
+    eth = Ethereum(genesis, cfg)
+    try:
+        assert eth.api_backend.rpc_gas_cap == 123_456
+        assert eth.rpc_server.handle_call("net_version", []) == "99"
+        assert eth.filters is not None
+        # oracle picked up the gpo knobs
+        # (register_eth_api built it from backend attrs)
+        assert eth.api_backend.gpo_blocks == 7
+        # unfinalized gating: "latest" == last accepted
+        assert eth.api_backend.resolve_block("latest").hash() \
+            == eth.chain.last_accepted.hash()
+    finally:
+        eth.stop()
