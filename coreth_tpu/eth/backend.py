@@ -23,13 +23,15 @@ from coreth_tpu.txpool.pool import TxPoolConfig
 class Ethereum:
     def __init__(self, genesis: Genesis,
                  config: Optional[EthConfig] = None,
-                 chain_kv=None, clock=None):
-        """eth.New (backend.go:117)."""
+                 chain_kv=None, clock=None, engine=None):
+        """eth.New (backend.go:117).  engine: an optional consensus
+        engine with callbacks (the plugin VM passes its atomic-wired
+        DummyEngine, the way vm.go hands callbacks into eth.New)."""
         import time as _time
         self.config = config or DEFAULTS
         cfg = self.config
         self.chain = BlockChain(
-            genesis, chain_kv=chain_kv,
+            genesis, chain_kv=chain_kv, engine=engine,
             commit_interval=cfg.commit_interval,
             archive=not cfg.pruning,
             snapshots=cfg.snapshot_cache > 0,

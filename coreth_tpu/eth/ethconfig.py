@@ -27,7 +27,6 @@ class GPODefaults:
     """eth/gasprice Default oracle knobs."""
     blocks: int = 40
     percentile: int = 60
-    max_look_back_seconds: int = 80
 
 
 @dataclass

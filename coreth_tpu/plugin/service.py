@@ -50,6 +50,7 @@ class VMServer:
         # one VM, many connections: the real rpcchainvm relies on the
         # VM's internal locks; this VM has none, so serialize here
         self._lock = threading.Lock()
+        self._cpu_profiler = None
 
     # ------------------------------------------------------------ dispatch
     def handle(self, method: str, params: dict):
@@ -121,10 +122,44 @@ class VMServer:
                     vm.to_engine.popleft() if vm.to_engine else None}
         if method == "health":
             return vm.health()
+        # admin.* (plugin/evm/admin.go surface): profiling control,
+        # log level, live VM config
+        if method == "admin.startCPUProfiler":
+            self._admin_profiler().start(params.get(
+                "file", "/tmp/coreth_tpu_cpu.prof"))
+            return {}
+        if method == "admin.stopCPUProfiler":
+            return {"file": self._admin_profiler().stop()}
+        if method == "admin.memoryProfile":
+            import gc
+            import resource
+            usage = resource.getrusage(resource.RUSAGE_SELF)
+            return {"maxRssKiB": usage.ru_maxrss,
+                    "gcObjects": len(gc.get_objects())}
+        if method == "admin.setLogLevel":
+            import logging
+            level = params.get("level", "info").upper()
+            if level not in ("DEBUG", "INFO", "WARNING", "ERROR",
+                             "CRITICAL"):
+                raise VMError(f"unknown log level {level!r}")
+            logging.getLogger("coreth_tpu").setLevel(level)
+            return {}
+        if method == "admin.getVMConfig":
+            cfg = vm.config
+            return {k: getattr(cfg, k) for k in vars(cfg)
+                    if not k.startswith("_")
+                    and isinstance(getattr(cfg, k),
+                                   (int, float, str, bool, type(None)))}
         if method == "shutdown":
             vm.shutdown()
             return {}
         raise VMError(f"unknown method {method!r}")
+
+    def _admin_profiler(self):
+        if self._cpu_profiler is None:
+            from coreth_tpu.rpc.debugapi import CPUProfiler
+            self._cpu_profiler = CPUProfiler()
+        return self._cpu_profiler
 
     # ----------------------------------------------------------- transport
     def serve(self, path: str) -> None:

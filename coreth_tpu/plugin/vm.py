@@ -24,7 +24,6 @@ from coreth_tpu.plugin.block import PluginBlock, Status
 from coreth_tpu.plugin.config import parse_config
 from coreth_tpu.plugin.genesis_json import parse_genesis_json
 from coreth_tpu.txpool import TxPool
-from coreth_tpu.txpool.pool import TxPoolConfig
 from coreth_tpu.types import Block, Transaction
 
 PENDING_TXS = "PendingTxs"  # the message on the toEngine channel
@@ -127,21 +126,26 @@ class VM:
             cb = make_callbacks(self.atomic_backend, genesis.config,
                                 pending_atomic_txs=self._pending_atomic)
             engine = DummyEngine(cb=cb)  # config lands in BlockChain
-        self.chain = BlockChain(genesis, engine=engine,
-                                commit_interval=self.config.commit_interval)
-        self.txpool = TxPool(genesis.config, self.chain, TxPoolConfig(
-            price_limit=self.config.tx_pool_price_limit,
-            account_slots=self.config.tx_pool_account_slots,
-            global_slots=self.config.tx_pool_global_slots,
-            account_queue=self.config.tx_pool_account_queue,
-            global_queue=self.config.tx_pool_global_queue))
-        self.miner = Miner(genesis.config, self.chain, self.txpool,
-                           engine=self.chain.engine, clock=self.clock)
-        # chainHeadEvent -> txpool reset (the reference's pool reset
-        # loop subscribes to head events, txpool.go:379): covers the
-        # optimistic insert tip, SetPreference, and cross-branch accept
-        self.chain.subscribe_chain_head(
-            lambda _b: self.txpool.reset())
+        # the engine stack comes from ONE constructor (vm.go:694
+        # initializeChain -> eth.New): chain + txpool with head-event
+        # reset + miner + the assembled RPC surface
+        from coreth_tpu.eth import EthConfig, Ethereum
+        from coreth_tpu.eth.ethconfig import TxPoolDefaults
+        self.eth = Ethereum(
+            genesis,
+            EthConfig(
+                network_id=genesis.config.chain_id,
+                commit_interval=self.config.commit_interval,
+                tx_pool=TxPoolDefaults(
+                    price_limit=self.config.tx_pool_price_limit,
+                    account_slots=self.config.tx_pool_account_slots,
+                    global_slots=self.config.tx_pool_global_slots,
+                    account_queue=self.config.tx_pool_account_queue,
+                    global_queue=self.config.tx_pool_global_queue)),
+            engine=engine, clock=self.clock)
+        self.chain = self.eth.chain
+        self.txpool = self.eth.txpool
+        self.miner = self.eth.miner
         if self.warp_backend is not None:
             # only accepted blocks may receive block-hash signatures
             def _accepted(h: bytes) -> bool:

@@ -81,6 +81,13 @@ class Backend:
             number = int(tag, 16) if tag.startswith("0x") else int(tag)
         else:
             number = int(tag)
+        if not self.allow_unfinalized_queries \
+                and number > self.chain.last_accepted.number:
+            # numbered queries above the accepted tip are unfinalized
+            # too (api_backend.go ErrUnfinalizedData)
+            raise RPCError(
+                f"cannot query unfinalized data: block {number} is "
+                f"above the last accepted block", -32000)
         block = self.chain.get_block_by_number(number)
         if block is None:
             raise RPCError(f"block {number} not found")
@@ -125,10 +132,10 @@ class Backend:
     # ------------------------------------------------------------ execute
     def call(self, args: dict, block: Block,
              gas_cap: Optional[int] = None):
-        gas_cap = gas_cap or self.rpc_gas_cap
         """eth_call semantics (internal/ethapi api.go DoCall): run the
         message on the block's state with account checks skipped and
         base-fee enforcement off; returns the ExecutionResult."""
+        gas_cap = gas_cap or self.rpc_gas_cap
         statedb = self.state_at(block)
         msg = self._args_to_message(args, block, gas_cap)
         ctx = new_block_context(block.header, self.ancestry_hash(block))

@@ -25,7 +25,7 @@ from typing import Optional
 from coreth_tpu.rpc.server import RPCError
 
 
-class _CPUProfiler:
+class CPUProfiler:
     """debug_startCPUProfile / stopCPUProfile pair (api.go:179)."""
 
     def __init__(self):
@@ -64,8 +64,8 @@ def stacks() -> str:
     return out.getvalue()
 
 
-def register_debug_runtime_api(server) -> _CPUProfiler:
-    cpu = _CPUProfiler()
+def register_debug_runtime_api(server) -> CPUProfiler:
+    cpu = CPUProfiler()
 
     def debug_startCPUProfile(file: str):
         cpu.start(file)

@@ -515,3 +515,30 @@ def test_vm_restart_resumes_pending_apply():
                 [UTXO(bytes([tag]) * 32, 0, out).input_id()])
     # and the reconstructed trie matches the committed meta
     assert vm2.atomic_backend.trie.last_committed_height == 2
+
+
+def test_admin_api_over_socket(tmp_path):
+    """admin.* surface (plugin/evm/admin.go role): profiling control,
+    log level, live config readback."""
+    sock = str(tmp_path / "vm.sock")
+    server = serve(VM(), sock)
+    try:
+        client = VMClient(sock)
+        client.initialize(genesis_json())
+        prof = str(tmp_path / "cpu.prof")
+        client.call("admin.startCPUProfiler", file=prof)
+        client.call("lastAccepted")  # some work to record
+        out = client.call("admin.stopCPUProfiler")
+        assert out["file"] == prof and os.path.getsize(prof) > 0
+        mem = client.call("admin.memoryProfile")
+        assert mem["maxRssKiB"] > 0
+        client.call("admin.setLogLevel", level="debug")
+        import logging
+        assert logging.getLogger("coreth_tpu").level == logging.DEBUG
+        with pytest.raises(VMError):
+            client.call("admin.setLogLevel", level="loud")
+        cfg = client.call("admin.getVMConfig")
+        assert cfg["commit_interval"] == 4096
+        client.close()
+    finally:
+        server.close()
