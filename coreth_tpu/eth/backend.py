@@ -74,7 +74,10 @@ class Ethereum:
         self.filters = register_eth_api(self.rpc_server,
                                         self.api_backend)
         register_debug_api(self.rpc_server, self.api_backend)
-        register_debug_runtime_api(self.rpc_server)
+        # retained: the single CPU-profiler instance every surface
+        # (debug_* over HTTP/WS, admin.* over the plugin socket)
+        # shares, so mutual exclusion actually excludes
+        self.cpu_profiler = register_debug_runtime_api(self.rpc_server)
         if self.keystore is not None:
             from coreth_tpu.rpc.personal import register_personal_api
             register_personal_api(self.rpc_server, self.keystore)

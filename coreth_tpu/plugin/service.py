@@ -131,11 +131,8 @@ class VMServer:
         if method == "admin.stopCPUProfiler":
             return {"file": self._admin_profiler().stop()}
         if method == "admin.memoryProfile":
-            import gc
-            import resource
-            usage = resource.getrusage(resource.RUSAGE_SELF)
-            return {"maxRssKiB": usage.ru_maxrss,
-                    "gcObjects": len(gc.get_objects())}
+            from coreth_tpu.rpc.debugapi import memory_stats
+            return memory_stats()
         if method == "admin.setLogLevel":
             import logging
             level = params.get("level", "info").upper()
@@ -145,6 +142,7 @@ class VMServer:
             logging.getLogger("coreth_tpu").setLevel(level)
             return {}
         if method == "admin.getVMConfig":
+            vm._require_init()
             cfg = vm.config
             return {k: getattr(cfg, k) for k in vars(cfg)
                     if not k.startswith("_")
@@ -156,6 +154,12 @@ class VMServer:
         raise VMError(f"unknown method {method!r}")
 
     def _admin_profiler(self):
+        # one profiler per process: share the instance the Ethereum
+        # facade registered for debug_* so the already-in-progress
+        # guard spans every surface
+        eth = getattr(self.vm, "eth", None)
+        if eth is not None:
+            return eth.cpu_profiler
         if self._cpu_profiler is None:
             from coreth_tpu.rpc.debugapi import CPUProfiler
             self._cpu_profiler = CPUProfiler()

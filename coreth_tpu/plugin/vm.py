@@ -50,6 +50,7 @@ class VM:
             else {}
         self.atomic_repository = None
         self.initialized = False
+        self.eth = None
         self.chain: Optional[BlockChain] = None
         self.txpool: Optional[TxPool] = None
         self.miner: Optional[Miner] = None
@@ -187,6 +188,10 @@ class VM:
         return StateSyncClient(self, transport)
 
     def shutdown(self) -> None:
+        """vm.go Shutdown -> eth Stop: transports down, acceptor
+        drained, chain flushed + closed."""
+        if self.initialized and self.eth is not None:
+            self.eth.stop()
         self.initialized = False
 
     def health(self) -> dict:

@@ -93,6 +93,13 @@ class Backend:
             raise RPCError(f"block {number} not found")
         return block
 
+    def is_finalized(self, block: Block) -> bool:
+        """True when serving this block does not leak unfinalized data
+        under the gating flag (api_backend.go ErrUnfinalizedData for
+        by-hash lookups)."""
+        return self.allow_unfinalized_queries \
+            or block.number <= self.chain.last_accepted.number
+
     def state_at(self, block: Block):
         if not self.chain.has_state(block.root):
             raise RPCError(f"state at block {block.number} unavailable")

@@ -52,6 +52,17 @@ class CPUProfiler:
             return path
 
 
+def memory_stats() -> dict:
+    """Process memory/GC snapshot (debug WriteMemProfile role) — one
+    definition shared by debug_memStats and admin.memoryProfile."""
+    import resource
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    return {"maxRssKiB": usage.ru_maxrss,
+            "userTime": usage.ru_utime,
+            "systemTime": usage.ru_stime,
+            "gcObjects": len(gc.get_objects())}
+
+
 def stacks() -> str:
     """All-thread stack dump (api.go:231 Stacks — the goroutine
     profile analog)."""
@@ -103,12 +114,7 @@ def register_debug_runtime_api(server) -> CPUProfiler:
                 "enabled": gc.isenabled()}
 
     def debug_memStats():
-        import resource
-        usage = resource.getrusage(resource.RUSAGE_SELF)
-        return {"maxRssKiB": usage.ru_maxrss,
-                "userTime": usage.ru_utime,
-                "systemTime": usage.ru_stime,
-                "gcObjects": len(gc.get_objects())}
+        return memory_stats()
 
     def debug_freeOSMemory():
         gc.collect()

@@ -153,14 +153,17 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
 
     def eth_getBlockByHash(h, full=False):
         block = b.chain.get_block(_h32(h))
-        return None if block is None \
-            else block_json(block, bool(full), b.signer)
+        if block is None or not b.is_finalized(block):
+            return None  # by-hash gating (ErrUnfinalizedData role)
+        return block_json(block, bool(full), b.signer)
 
     def eth_getTransactionByHash(h):
         found = b.tx_by_hash(_h32(h))
         if found is None:
             return None
         block, tx, idx = found
+        if not b.is_finalized(block):
+            return None
         return tx_json(tx, block, idx, b.signer)
 
     def eth_getTransactionReceipt(h):
@@ -168,6 +171,8 @@ def register_eth_api(server: RPCServer, backend: Backend) -> FilterSystem:
         if found is None:
             return None
         block, receipt, idx = found
+        if not b.is_finalized(block):
+            return None
         receipts = b.chain.get_receipts(block.hash()) or []
         log_offset = sum(len(r.logs) for r in receipts[:idx])
         return receipt_json(block, receipt, block.transactions[idx],

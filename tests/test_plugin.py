@@ -532,11 +532,16 @@ def test_admin_api_over_socket(tmp_path):
         assert out["file"] == prof and os.path.getsize(prof) > 0
         mem = client.call("admin.memoryProfile")
         assert mem["maxRssKiB"] > 0
-        client.call("admin.setLogLevel", level="debug")
         import logging
-        assert logging.getLogger("coreth_tpu").level == logging.DEBUG
-        with pytest.raises(VMError):
-            client.call("admin.setLogLevel", level="loud")
+        logger = logging.getLogger("coreth_tpu")
+        prev_level = logger.level
+        try:
+            client.call("admin.setLogLevel", level="debug")
+            assert logger.level == logging.DEBUG
+            with pytest.raises(VMError):
+                client.call("admin.setLogLevel", level="loud")
+        finally:
+            logger.setLevel(prev_level)
         cfg = client.call("admin.getVMConfig")
         assert cfg["commit_interval"] == 4096
         client.close()

@@ -50,3 +50,35 @@ def test_reject_noncanonical():
         rlp.decode(b"\x83do")  # truncated
     with pytest.raises(ValueError):
         rlp.decode(b"\x83dogX")  # trailing bytes
+
+
+def test_rlp_published_spec_vectors():
+    """The RLP examples published with the spec (Ethereum wiki /
+    yellow paper appendix B) — independently derived expectations."""
+    from coreth_tpu import rlp
+
+    # "dog" -> [0x83, 'd', 'o', 'g']
+    assert rlp.encode(b"dog").hex() == "83646f67"
+    # ["cat", "dog"] -> 0xc8 0x83cat 0x83dog
+    assert rlp.encode([b"cat", b"dog"]).hex() == "c88363617483646f67"
+    # empty string / empty list
+    assert rlp.encode(b"").hex() == "80"
+    assert rlp.encode([]).hex() == "c0"
+    # integers: 0 -> 0x80, 15 -> 0x0f, 1024 -> 0x820400
+    # (encode_uint yields the minimal payload; encode() wraps it)
+    assert rlp.encode(rlp.encode_uint(0)).hex() == "80"
+    assert rlp.encode(rlp.encode_uint(15)).hex() == "0f"
+    assert rlp.encode(rlp.encode_uint(1024)).hex() == "820400"
+    # the set-theoretic representation of three:
+    # [ [], [[]], [ [], [[]] ] ] -> 0xc7c0c1c0c3c0c1c0
+    assert rlp.encode([[], [[]], [[], [[]]]]).hex() == "c7c0c1c0c3c0c1c0"
+    # 55-byte boundary: "Lorem ipsum dolor sit amet, consectetur
+    # adipisicing elit" (56 chars) -> 0xb838 prefix
+    s = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+    assert len(s) == 56
+    enc = rlp.encode(s)
+    assert enc[:2].hex() == "b838" and enc[2:] == s
+    # decode roundtrips
+    assert rlp.decode(rlp.encode([b"cat", b"dog"])) == [b"cat", b"dog"]
+    assert rlp.decode(bytes.fromhex("c7c0c1c0c3c0c1c0")) \
+        == [[], [[]], [[], [[]]]]
