@@ -145,7 +145,10 @@ def _dec_word(typ: str, word: bytes) -> Any:
     if typ == "address":
         return word[12:]
     if typ == "bool":
-        return word[-1] == 1
+        v = int.from_bytes(word, "big")
+        if v not in (0, 1):
+            raise ABIError(f"improperly encoded boolean value {v}")
+        return v == 1
     if typ.startswith("uint"):
         return int.from_bytes(word, "big")
     if typ.startswith("int"):

@@ -11,6 +11,7 @@ unlocked keys.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import json
 import os
 import secrets
@@ -86,7 +87,11 @@ def decrypt_key(blob: dict, password: str) -> int:
         raise KeystoreError(f"unsupported kdf {crypto['kdf']}")
     ciphertext = bytes.fromhex(crypto["ciphertext"])
     mac = keccak256(dk[16:32] + ciphertext)
-    if mac.hex() != crypto["mac"]:
+    try:
+        want_mac = bytes.fromhex(crypto["mac"].removeprefix("0x"))
+    except ValueError:
+        raise KeystoreError("malformed mac field")
+    if not hmac.compare_digest(mac, want_mac):
         raise KeystoreError("could not decrypt key with given password")
     priv_bytes = aes128_ctr(dk[:16],
                             bytes.fromhex(crypto["cipherparams"]["iv"]),

@@ -19,6 +19,7 @@ from coreth_tpu.processor.state_processor import (
 )
 from coreth_tpu.processor.state_transition import GasPool
 from coreth_tpu.evm import EVM, TxContext
+from coreth_tpu.evm.precompiles import BLACKHOLE_ADDR
 from coreth_tpu.state import Database, StateDB
 from coreth_tpu.types import Block, Header, Receipt, Transaction, LatestSigner
 
@@ -86,7 +87,7 @@ def _make_header(config: ChainConfig, parent: Block, statedb: StateDB,
     time = parent.time + gap
     header = Header(
         parent_hash=parent.hash(),
-        coinbase=b"\x00" * 20,
+        coinbase=BLACKHOLE_ADDR,
         difficulty=1,
         number=parent.number + 1,
         time=time,

@@ -14,6 +14,7 @@ from typing import List, Optional
 from coreth_tpu.consensus import calc_base_fee
 from coreth_tpu.consensus.engine import DummyEngine
 from coreth_tpu.evm import EVM, TxContext
+from coreth_tpu.evm.precompiles import BLACKHOLE_ADDR
 from coreth_tpu.params import ChainConfig
 from coreth_tpu.params import protocol as P
 from coreth_tpu.processor.message import tx_to_message
@@ -36,7 +37,7 @@ class Worker:
         self.engine = engine or DummyEngine()
         self.engine.set_config(config)
         self.clock = clock
-        self.coinbase = b"\x00" * 20
+        self.coinbase = BLACKHOLE_ADDR
         self.signer = LatestSigner(config.chain_id)
 
     def set_coinbase(self, addr: bytes) -> None:

@@ -12,19 +12,20 @@ from __future__ import annotations
 
 from typing import Optional
 
+from coreth_tpu.evm.precompiles import BLACKHOLE_ADDR
 from coreth_tpu.params import protocol as P
 from coreth_tpu.types import derive_sha
-from coreth_tpu.types.block import calc_ext_data_hash
+from coreth_tpu.types.block import EMPTY_UNCLE_HASH, calc_ext_data_hash
 
 # Blocks may be at most this far ahead of the wall clock
 # (plugin/evm/block_verification.go maxFutureBlockTime)
 MAX_FUTURE_BLOCK_TIME = 10
 
-# This framework pins the burn coinbase to the zero address (the
-# reference pins constants.BlackholeAddr 0x0100...00; the role —
-# a fixed fee sink unless fee recipients are explicitly allowed —
-# is identical)
-EXPECTED_COINBASE = b"\x00" * 20
+# The burn coinbase is pinned to the blackhole address, matching the
+# reference's constants.BlackholeAddr (block_verification.go:171-174)
+# so blocks produced here are wire-compatible with reference-network
+# coinbase validation
+EXPECTED_COINBASE = BLACKHOLE_ADDR
 
 
 class BlockVerificationError(Exception):
@@ -61,6 +62,10 @@ class SyntacticBlockValidator:
             _fail("invalid block number")
         if header.difficulty != 1:
             _fail(f"invalid difficulty {header.difficulty}")
+        if header.nonce != b"\x00" * 8:
+            _fail(f"invalid nonce {header.nonce.hex()}")
+        if header.mix_digest != b"\x00" * 32:
+            _fail(f"invalid mix digest {header.mix_digest.hex()}")
 
         # static gas limit per fork (:107-120)
         if rules.is_cortina:
@@ -88,11 +93,14 @@ class SyntacticBlockValidator:
         elif size > P.MAXIMUM_EXTRA_DATA_SIZE:
             _fail(f"extra too large: {size}")
 
-        # body hashes (:161-169)
+        # body hashes (:161-169); uncles are unsupported so the header
+        # hash must be the canonical empty-list hash
         if derive_sha(block.transactions) != header.tx_hash:
             _fail("tx hash mismatch")
         if block.uncles:
             _fail("uncles unsupported")
+        if header.uncle_hash != EMPTY_UNCLE_HASH:
+            _fail(f"invalid uncle hash {header.uncle_hash.hex()}")
 
         # coinbase pinned to the burn address (:171-174)
         if not self.allow_fee_recipients \

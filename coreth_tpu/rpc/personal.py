@@ -39,13 +39,17 @@ def register_personal_api(server, keystore: KeyStore) -> None:
         return ["0x" + a.hex() for a in keystore.accounts()]
 
     def personal_unlockAccount(address: str, password: str,
-                               duration: int = 0):
+                               duration: int = None):
         try:
-            # geth defaults to 300s when duration is absent/0;
-            # explicit large durations behave as given
-            keystore.unlock(_addr(address), password,
-                            duration=float(duration) if duration
-                            else 300.0)
+            # geth: absent duration -> 300s default; explicit 0 ->
+            # unlocked until the program exits (indefinite)
+            if duration is None:
+                secs = 300.0
+            elif duration == 0:
+                secs = None
+            else:
+                secs = float(duration)
+            keystore.unlock(_addr(address), password, duration=secs)
         except KeystoreError as e:
             raise RPCError(str(e), -32000)
         return True

@@ -64,8 +64,9 @@ def test_generate_and_insert_value_chain():
     state = chain.state_at(blocks[-1].root)
     assert state.get_balance(ADDR2) == 50 * 10_000
     assert state.get_nonce(ADDR1) == 50
-    # coinbase burn: fees went to the zero coinbase address
-    assert state.get_balance(b"\x00" * 20) > 0
+    # coinbase burn: fees went to the blackhole coinbase address
+    from coreth_tpu.evm.precompiles import BLACKHOLE_ADDR
+    assert state.get_balance(BLACKHOLE_ADDR) > 0
     assert chain.timers.blocks == 5
     assert chain.timers.execution > 0
 
