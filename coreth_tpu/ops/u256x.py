@@ -1,0 +1,360 @@
+"""Extended 256-bit arithmetic for the device EVM step machine.
+
+Builds on ops/u256 (16x16-bit limbs in int32, little-endian).  These are
+the EVM ALU ops the batched interpreter needs beyond add/sub/compare:
+full multiply, division/modulo (restoring bit-serial — branch-free and
+bit-exact), signed variants, modular ops over arbitrary moduli, EXP,
+shifts, BYTE and SIGNEXTEND (reference semantics:
+core/vm/instructions.go opMul/opDiv/opSdiv/opAddmod/opExp/opSHL...).
+
+Everything stays in int32 (no x64 dependence): 16x16-bit limb products
+are kept inside int32 by splitting one operand into 8-bit halves, so a
+16-term convolution sum is bounded by 16 * 2^24 = 2^28.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from coreth_tpu.ops import u256
+
+L = u256.LIMBS
+MASK = u256.LIMB_MASK
+
+
+def _zeros_like_head(a, extra_shape=()):
+    return jnp.zeros(a.shape[:-1] + extra_shape, dtype=jnp.int32)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a * b mod 2^256.
+
+    b's limbs split into (low, high) bytes keeps every partial sum under
+    2^29 in int32; the high-byte partials contribute 8 bits up, so
+    P1_k feeds (P1_k & 0xFF) << 8 into limb k and P1_k >> 8 into k+1.
+    """
+    bl = b & 0xFF
+    bh = (b >> 8) & 0xFF
+    outs = []
+    carry = _zeros_like_head(a)
+    p1_hi = _zeros_like_head(a)
+    for k in range(L):
+        p0 = _zeros_like_head(a)
+        p1 = _zeros_like_head(a)
+        for i in range(k + 1):
+            ai = a[..., i]
+            p0 = p0 + ai * bl[..., k - i]
+            p1 = p1 + ai * bh[..., k - i]
+        v = p0 + ((p1 & 0xFF) << 8) + p1_hi + carry
+        outs.append(v & MASK)
+        carry = v >> 16
+        p1_hi = p1 >> 8
+    return jnp.stack(outs, axis=-1)
+
+
+def mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full 512-bit product as (..., 32) limbs (for MULMOD)."""
+    bl = b & 0xFF
+    bh = (b >> 8) & 0xFF
+    outs = []
+    carry = _zeros_like_head(a)
+    p1_hi = _zeros_like_head(a)
+    for k in range(2 * L - 1):
+        p0 = _zeros_like_head(a)
+        p1 = _zeros_like_head(a)
+        for i in range(max(0, k - L + 1), min(k + 1, L)):
+            ai = a[..., i]
+            p0 = p0 + ai * bl[..., k - i]
+            p1 = p1 + ai * bh[..., k - i]
+        v = p0 + ((p1 & 0xFF) << 8) + p1_hi + carry
+        outs.append(v & MASK)
+        carry = v >> 16
+        p1_hi = p1 >> 8
+    outs.append(carry + p1_hi)  # true top limb, already < 2^16
+    return jnp.stack(outs, axis=-1)
+
+
+def _ge_ext(r: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic r >= b over the (equal-width) last axis."""
+    n = r.shape[-1]
+    decided = jnp.zeros(r.shape[:-1], dtype=bool)
+    result = jnp.ones(r.shape[:-1], dtype=bool)
+    for i in range(n - 1, -1, -1):
+        gt = r[..., i] > b[..., i]
+        lt = r[..., i] < b[..., i]
+        result = jnp.where(~decided & gt, True, result)
+        result = jnp.where(~decided & lt, False, result)
+        decided = decided | gt | lt
+    return result
+
+
+def _sub_ext(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b (a >= b) over the last axis, borrow chain unrolled."""
+    n = a.shape[-1]
+    diff = a - b
+    limbs = []
+    borrow = _zeros_like_head(a)
+    for i in range(n):
+        limb = diff[..., i] - borrow
+        borrow = (limb < 0).astype(jnp.int32)
+        limbs.append(limb + (borrow << 16))
+    return jnp.stack(limbs, axis=-1)
+
+
+def _shift1_add_bit(r: jnp.ndarray, bit: jnp.ndarray) -> jnp.ndarray:
+    """r*2 + bit with one carry pass (entry limbs are < 2^16, so one
+    pass fully renormalizes)."""
+    r = r * 2
+    r = r.at[..., 0].add(bit)
+    c = r >> 16
+    r = (r & MASK) + jnp.concatenate(
+        [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+    return r
+
+
+def _mod_bits(x: jnp.ndarray, nbits: int, n: jnp.ndarray,
+              with_quotient: bool = False):
+    """x mod n by restoring division over x's top `nbits` bits.
+
+    x: (..., ceil(nbits/16)) limbs; n: (..., 16).  n == 0 -> 0.
+    Returns (q[..16 limbs] if with_quotient else None, r (..., 16)).
+    Quotient only valid when it fits 256 bits (DIV guarantees this).
+    """
+    n17 = jnp.concatenate([n, _zeros_like_head(n, (1,))], axis=-1)
+    r = _zeros_like_head(n, (17,))
+    q = jnp.zeros_like(n) if with_quotient else None
+
+    def body(i, carry):
+        q, r = carry
+        bitpos = nbits - 1 - i
+        limb = bitpos // 16
+        sh = bitpos % 16
+        bit = (jax.lax.dynamic_index_in_dim(
+            x, limb, axis=-1, keepdims=False) >> sh) & 1
+        r = _shift1_add_bit(r, bit)
+        ge = _ge_ext(r, n17)
+        r = jnp.where(ge[..., None], _sub_ext(r, n17), r)
+        if q is not None:
+            hot = (jnp.arange(L, dtype=jnp.int32) == limb).astype(jnp.int32)
+            q = q + (ge.astype(jnp.int32) << sh)[..., None] * hot
+        return q, r
+
+    q, r = jax.lax.fori_loop(0, nbits, body, (q, r))
+    nz = ~u256.is_zero(n)
+    r16 = jnp.where(nz[..., None], r[..., :L], 0)
+    if with_quotient:
+        q = jnp.where(nz[..., None], q, 0)
+        return q, r16
+    return None, r16
+
+
+def divmod_(a: jnp.ndarray, b: jnp.ndarray):
+    """(a // b, a % b); b == 0 -> (0, 0) (EVM DIV/MOD semantics)."""
+    q, r = _mod_bits(a, 256, b, with_quotient=True)
+    return q, r
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    """Two's-complement negation mod 2^256."""
+    return u256.sub(jnp.zeros_like(a), a)
+
+
+def _sign(a: jnp.ndarray) -> jnp.ndarray:
+    """True where a's 255th bit is set (negative as signed)."""
+    return (a[..., L - 1] >> 15) & 1
+
+
+def _abs(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(_sign(a)[..., None] == 1, neg(a), a)
+
+
+def sdiv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Signed division truncating toward zero (instructions.go opSdiv)."""
+    q, _ = divmod_(_abs(a), _abs(b))
+    negate = _sign(a) ^ _sign(b)
+    return jnp.where(negate[..., None] == 1, neg(q), q)
+
+
+def smod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Signed modulo: result takes the dividend's sign (opSmod)."""
+    _, r = divmod_(_abs(a), _abs(b))
+    return jnp.where(_sign(a)[..., None] == 1, neg(r), r)
+
+
+def addmod(a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) % n over the full 257-bit sum (opAddmod)."""
+    # widen to 17 limbs BEFORE carrying so the limb-15 carry-out lands
+    s = jnp.concatenate([a + b, _zeros_like_head(a, (1,))], axis=-1)
+    for _ in range(2):  # limbs <= 0x1FFFE, then <= 0x10000: two passes
+        c = s >> 16
+        s = (s & MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+    _, r = _mod_bits(s, 17 * 16, n)
+    return r
+
+
+def mulmod(a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """(a * b) % n over the 512-bit product (opMulmod)."""
+    wide = mul_wide(a, b)
+    _, r = _mod_bits(wide, 512, n)
+    return r
+
+
+def bit_length(a: jnp.ndarray) -> jnp.ndarray:
+    """Bit length per element (0 for zero), via 16-bit limb scan."""
+    # bitlen of each limb by binary search (exact, no floats)
+    v = a
+    bl = jnp.zeros_like(v)
+    for shift in (8, 4, 2, 1):
+        big = v >= (1 << shift)
+        bl = bl + jnp.where(big, shift, 0)
+        v = jnp.where(big, v >> shift, v)
+    bl = bl + (v > 0)  # v now 0 or 1
+    idx = jnp.arange(L, dtype=jnp.int32)
+    per_limb = jnp.where(a > 0, idx * 16 + bl, 0)
+    return jnp.max(per_limb, axis=-1)
+
+
+def exp_(b: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
+    """b ** e mod 2^256 by right-to-left square-and-multiply, bounded by
+    the batch's max exponent bit length (opExp)."""
+    maxbits = jnp.max(bit_length(e))
+    res = jnp.zeros_like(b).at[..., 0].set(1)
+    cur = b
+
+    def cond(carry):
+        i, _, _ = carry
+        return i < maxbits
+
+    def body(carry):
+        i, res, cur = carry
+        limb = i // 16
+        sh = i % 16
+        bit = (jax.lax.dynamic_index_in_dim(
+            e, limb, axis=-1, keepdims=False) >> sh) & 1
+        res = jnp.where(bit[..., None] == 1, mul(res, cur), res)
+        cur = mul(cur, cur)
+        return i + 1, res, cur
+
+    _, res, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), res, cur))
+    return res
+
+
+def _shift_amount(n: jnp.ndarray):
+    """(effective shift in [0,255], overflow>=256 flag) from a u256."""
+    over = (n[..., 0] > 255)
+    for i in range(1, L):
+        over = over | (n[..., i] != 0)
+    return jnp.where(over, 0, n[..., 0]), over
+
+
+def shl(x: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    s, over = _shift_amount(n)
+    limb_sh = s // 16
+    bit_sh = s % 16
+    idx = jnp.arange(L, dtype=jnp.int32) - limb_sh[..., None]
+    g = jnp.take_along_axis(x, jnp.clip(idx, 0, L - 1), axis=-1)
+    g = jnp.where(idx >= 0, g, 0)
+    prev = jnp.concatenate(
+        [jnp.zeros_like(g[..., :1]), g[..., :-1]], axis=-1)
+    out = ((g << bit_sh[..., None]) & MASK) | (prev >> (16 - bit_sh)[..., None])
+    return jnp.where(over[..., None], 0, out)
+
+
+def shr(x: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    s, over = _shift_amount(n)
+    limb_sh = s // 16
+    bit_sh = s % 16
+    idx = jnp.arange(L, dtype=jnp.int32) + limb_sh[..., None]
+    g = jnp.take_along_axis(x, jnp.clip(idx, 0, L - 1), axis=-1)
+    g = jnp.where(idx <= L - 1, g, 0)
+    nxt = jnp.concatenate(
+        [g[..., 1:], jnp.zeros_like(g[..., :1])], axis=-1)
+    out = (g >> bit_sh[..., None]) | ((nxt << (16 - bit_sh)[..., None]) & MASK)
+    return jnp.where(over[..., None], 0, out)
+
+
+def sar(x: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    sign = _sign(x)
+    base = shr(x, n)
+    s, over = _shift_amount(n)
+    # fill bits at positions >= 256 - s with the sign bit
+    t = 256 - s  # first filled bit position; s==0 -> t=256 -> no fill
+    k16 = jnp.arange(L, dtype=jnp.int32) * 16
+    rel = t[..., None] - k16  # bits below rel keep, above fill
+    fill_mask = jnp.where(
+        rel <= 0, MASK,
+        jnp.where(rel >= 16, 0, (MASK << jnp.clip(rel, 0, 16)) & MASK))
+    filled = base | jnp.where(sign[..., None] == 1, fill_mask, 0)
+    all_ones = jnp.full_like(x, MASK)
+    over_val = jnp.where(sign[..., None] == 1, all_ones, jnp.zeros_like(x))
+    return jnp.where(over[..., None], over_val, filled)
+
+
+def byte_op(i: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """BYTE: big-endian byte i of x, 0 when i >= 32 (opByte)."""
+    over = (i[..., 0] > 31)
+    for k in range(1, L):
+        over = over | (i[..., k] != 0)
+    p = 31 - jnp.clip(i[..., 0], 0, 31)  # little-endian byte position
+    limb = jnp.take_along_axis(x, (p // 2)[..., None], axis=-1)[..., 0]
+    byte = (limb >> ((p % 2) * 8)) & 0xFF
+    byte = jnp.where(over, 0, byte)
+    out = jnp.zeros_like(x)
+    return out.at[..., 0].set(byte)
+
+
+def signextend(b: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """SIGNEXTEND: extend from byte b (0 = lowest byte) (opSignExtend)."""
+    over = (b[..., 0] > 30)
+    for k in range(1, L):
+        over = over | (b[..., k] != 0)
+    t = 8 * jnp.clip(b[..., 0], 0, 30) + 7  # sign bit position
+    limb = jnp.take_along_axis(x, (t // 16)[..., None], axis=-1)[..., 0]
+    sign = (limb >> (t % 16)) & 1
+    k16 = jnp.arange(L, dtype=jnp.int32) * 16
+    rel = (t + 1)[..., None] - k16  # bits below rel are kept
+    keep_mask = jnp.where(
+        rel >= 16, MASK,
+        jnp.where(rel <= 0, 0, MASK >> jnp.clip(16 - rel, 0, 16)))
+    ext = jnp.where(sign[..., None] == 1,
+                    x | (keep_mask ^ MASK), x & keep_mask)
+    return jnp.where(over[..., None], x, ext)
+
+
+# ----------------------------------------------------------- comparisons
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def lt(a, b):
+    return ~u256.gte(a, b)
+
+
+def gt(a, b):
+    return ~u256.gte(b, a)
+
+
+def _flip_sign(a):
+    return a.at[..., L - 1].set(a[..., L - 1] ^ 0x8000)
+
+
+def slt(a, b):
+    return lt(_flip_sign(a), _flip_sign(b))
+
+
+def sgt(a, b):
+    return gt(_flip_sign(a), _flip_sign(b))
+
+
+def bool_word(m: jnp.ndarray) -> jnp.ndarray:
+    """Bool (...,) -> u256 0/1 word."""
+    out = jnp.zeros(m.shape + (L,), dtype=jnp.int32)
+    return out.at[..., 0].set(m.astype(jnp.int32))
+
+
+def not_(a):
+    return a ^ MASK

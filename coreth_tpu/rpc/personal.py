@@ -8,6 +8,8 @@ EIP-191 "\\x19Ethereum Signed Message" envelope exactly as geth does.
 
 from __future__ import annotations
 
+from typing import Optional
+
 from coreth_tpu.accounts.keystore import KeyStore, KeystoreError
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.rpc.server import RPCError
@@ -39,14 +41,17 @@ def register_personal_api(server, keystore: KeyStore) -> None:
         return ["0x" + a.hex() for a in keystore.accounts()]
 
     def personal_unlockAccount(address: str, password: str,
-                               duration: int = None):
+                               duration: Optional[int] = None):
         try:
             # geth: absent duration -> 300s default; explicit 0 ->
-            # unlocked until the program exits (indefinite)
+            # unlocked until the program exits (indefinite); negative
+            # durations are a type error (uint64 on the geth side)
             if duration is None:
                 secs = 300.0
             elif duration == 0:
                 secs = None
+            elif duration < 0:
+                raise RPCError("duration must be non-negative", -32602)
             else:
                 secs = float(duration)
             keystore.unlock(_addr(address), password, duration=secs)
