@@ -89,7 +89,7 @@ def decrypt_key(blob: dict, password: str) -> int:
     mac = keccak256(dk[16:32] + ciphertext)
     try:
         want_mac = bytes.fromhex(crypto["mac"].removeprefix("0x"))
-    except (ValueError, AttributeError, TypeError):
+    except (ValueError, AttributeError, TypeError, KeyError):
         raise KeystoreError("malformed mac field")
     if not hmac.compare_digest(mac, want_mac):
         raise KeystoreError("could not decrypt key with given password")

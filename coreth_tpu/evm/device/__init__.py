@@ -1,0 +1,16 @@
+"""Batched EVM execution on device — the SURVEY.md §7.4 step machine.
+
+The interpreter as a jitted step machine: jump-table dispatch over
+masked op families (scalar ``lax.cond`` on batch-reduced predicates, so
+a family's cost is paid only on steps where some lane needs it),
+fixed-shape stack/memory pools, vectorized gas counters, a bounded
+``lax.while_loop``, batched over the transactions of a block.  Local
+storage caches resolve through miss-and-rerun rounds; cross-tx ordering
+is validated optimistically by the adapter (execute-validate-retry,
+SURVEY.md §7.6).
+"""
+
+from coreth_tpu.evm.device.tables import CodeInfo, scan_code
+from coreth_tpu.evm.device.machine import MachineParams, get_machine
+
+__all__ = ["CodeInfo", "scan_code", "MachineParams", "get_machine"]

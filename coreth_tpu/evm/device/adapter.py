@@ -1,0 +1,299 @@
+"""Host adapter for the device step machine.
+
+Packs a batch of same-block transactions into machine inputs, runs the
+miss-and-rerun storage rounds, and unpacks per-tx results
+(status / gas_used / refund / logs / storage read- and write-sets) for
+the replay engine or tests.
+
+The cross-tx ordering problem (txs of one block executing in parallel
+against block-start state) is solved by the caller via optimistic
+validate-retry (replay/engine.py): this module only executes a batch
+against the pre-states it is handed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from coreth_tpu.evm.device import machine as M
+from coreth_tpu.evm.device import tables as T
+from coreth_tpu.ops import u256
+
+WORD_ZERO = b"\x00" * 32
+
+
+def addr_word(addr: bytes) -> int:
+    return int.from_bytes(addr, "big")
+
+
+@dataclass
+class TxSpec:
+    """One machine transaction: a plain call into device-eligible code."""
+    code: bytes
+    calldata: bytes
+    gas: int                      # gas available for execution
+    value: int
+    caller: bytes                 # 20-byte address
+    address: bytes                # 20-byte contract address
+    origin: bytes
+    gas_price: int
+    # (key32 -> (current, original)) pre-resolved storage view
+    storage: Dict[bytes, Tuple[int, int]] = field(default_factory=dict)
+    # access-list pre-warmed slots (EIP-2930); also marked warm
+    warm_slots: Tuple[bytes, ...] = ()
+
+
+@dataclass
+class BlockEnv:
+    coinbase: bytes
+    timestamp: int
+    number: int
+    gas_limit: int
+    chain_id: int
+    base_fee: int = 0
+
+
+@dataclass
+class TxResult:
+    status: int                   # machine status code (M.STOP, ...)
+    gas_left: int
+    refund: int
+    logs: List[Tuple[List[bytes], bytes]]   # (topics, data)
+    reads: Dict[bytes, int]       # key -> observed pre-tx value
+    writes: Dict[bytes, int]      # key -> final value (uncommitted)
+    host_reason: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == M.STOP
+
+    @property
+    def needs_host(self) -> bool:
+        return self.status == M.HOST
+
+
+def _pow2(n: int, floor: int) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
+class MachineRunner:
+    """Executes batches of TxSpecs under one fork + block env.
+
+    storage_resolver(address, key32) -> int supplies committed values
+    for keys the machine discovered (miss rounds).
+    """
+
+    def __init__(self, fork: str, env: BlockEnv,
+                 storage_resolver: Callable[[bytes, bytes], int],
+                 max_rounds: int = 6):
+        self.fork = fork
+        self.env = env
+        self.resolver = storage_resolver
+        self.max_rounds = max_rounds
+
+    def _params(self, txs: List[TxSpec]) -> M.MachineParams:
+        feats = set()
+        max_code = 64
+        max_data = 64
+        max_slots = 4
+        for t in txs:
+            info = T.scan_code(t.code, self.fork)
+            feats |= set(info.features)
+            max_code = max(max_code, len(t.code))
+            max_data = max(max_data, len(t.calldata))
+            max_slots = max(max_slots, len(t.storage) + 8)
+        return M.MachineParams(
+            fork=self.fork,
+            batch=_pow2(len(txs), 8),
+            code_cap=_pow2(max_code, 256),
+            data_cap=_pow2(max_data, 128),
+            scache_cap=_pow2(max_slots, 8),
+            features=frozenset(feats),
+        )
+
+    def _pack(self, txs: List[TxSpec], p: M.MachineParams) -> dict:
+        B = p.batch
+        code = np.zeros((B, p.code_cap + 33), dtype=np.int32)
+        code_len = np.zeros((B,), dtype=np.int32)
+        jdest = np.zeros((B, p.code_cap), dtype=np.int32)
+        calldata = np.zeros((B, p.data_cap), dtype=np.int32)
+        data_len = np.zeros((B,), dtype=np.int32)
+        start_gas = np.zeros((B,), dtype=np.int32)
+        active = np.zeros((B,), dtype=bool)
+        S = p.scache_cap
+        skey = np.zeros((B, S, u256.LIMBS), dtype=np.int32)
+        sval = np.zeros((B, S, u256.LIMBS), dtype=np.int32)
+        sorig = np.zeros((B, S, u256.LIMBS), dtype=np.int32)
+        sflag = np.zeros((B, S), dtype=np.int32)
+        scnt = np.zeros((B,), dtype=np.int32)
+        words = {k: np.zeros((B, u256.LIMBS), dtype=np.int32)
+                 for k in ("callvalue", "caller_w", "address_w",
+                           "origin_w", "gasprice_w")}
+
+        def wordify(v: int):
+            return np.frombuffer(
+                v.to_bytes(32, "little"), dtype=np.uint16
+            ).astype(np.int32)
+
+        for i, t in enumerate(txs):
+            cb = np.frombuffer(t.code, dtype=np.uint8)
+            code[i, :len(cb)] = cb
+            code_len[i] = len(cb)
+            info = T.scan_code(t.code, self.fork)
+            for d in info.jumpdests:
+                if d < p.code_cap:
+                    jdest[i, d] = 1
+            db = np.frombuffer(t.calldata, dtype=np.uint8)
+            calldata[i, :len(db)] = db
+            data_len[i] = len(db)
+            start_gas[i] = t.gas
+            active[i] = True
+            words["callvalue"][i] = wordify(t.value)
+            words["caller_w"][i] = wordify(addr_word(t.caller))
+            words["address_w"][i] = wordify(addr_word(t.address))
+            words["origin_w"][i] = wordify(addr_word(t.origin))
+            words["gasprice_w"][i] = wordify(t.gas_price)
+            for j, (key, (cur, orig)) in enumerate(t.storage.items()):
+                skey[i, j] = wordify(int.from_bytes(key, "big"))
+                sval[i, j] = wordify(cur)
+                sorig[i, j] = wordify(orig)
+                sflag[i, j] = M.F_VALID | (
+                    M.F_WARM if key in t.warm_slots else 0)
+            scnt[i] = len(t.storage)
+
+        env = self.env
+        inputs = dict(
+            code=jnp.asarray(code), jdest=jnp.asarray(jdest),
+            code_len=jnp.asarray(code_len),
+            calldata=jnp.asarray(calldata),
+            data_len=jnp.asarray(data_len),
+            start_gas=jnp.asarray(start_gas),
+            active=jnp.asarray(active),
+            skey=jnp.asarray(skey), sval=jnp.asarray(sval),
+            sorig=jnp.asarray(sorig), sflag=jnp.asarray(sflag),
+            scnt=jnp.asarray(scnt),
+            callvalue=jnp.asarray(words["callvalue"]),
+            caller_w=jnp.asarray(words["caller_w"]),
+            address_w=jnp.asarray(words["address_w"]),
+            origin_w=jnp.asarray(words["origin_w"]),
+            gasprice_w=jnp.asarray(words["gasprice_w"]),
+            timestamp=jnp.int32(env.timestamp),
+            number=jnp.int32(env.number),
+            gaslimit=jnp.int32(min(env.gas_limit, (1 << 31) - 1)),
+            coinbase_w=jnp.asarray(wordify(addr_word(env.coinbase))),
+            chainid_w=jnp.asarray(wordify(env.chain_id)),
+            basefee_w=jnp.asarray(wordify(env.base_fee)),
+        )
+        return inputs
+
+    def run(self, txs: List[TxSpec]) -> List[TxResult]:
+        """Execute txs (independently, against their given pre-states),
+        resolving storage misses through rerun rounds."""
+        txs = list(txs)
+        for _ in range(self.max_rounds):
+            p = self._params(txs)
+            fn = M.get_machine(p)
+            out = fn(self._pack(txs, p))
+            missing = self._collect_misses(out, txs)
+            if not missing:
+                return self._unpack(out, txs)
+            for i, keys in missing.items():
+                t = txs[i]
+                for key in keys:
+                    v = self.resolver(t.address, key)
+                    t.storage[key] = (v, v)
+        # rounds exhausted: anything still missing goes to host
+        out_res = self._unpack(out, txs)
+        for i in self._collect_misses(out, txs):
+            out_res[i].status = M.HOST
+            out_res[i].host_reason = M.R_SCACHE
+        return out_res
+
+    # ------------------------------------------------------------ unpack
+    def _collect_misses(self, out, txs) -> Dict[int, List[bytes]]:
+        sflag = np.asarray(out["sflag"])
+        scnt = np.asarray(out["scnt"])
+        status = np.asarray(out["status"])
+        skey = None
+        missing: Dict[int, List[bytes]] = {}
+        for i, t in enumerate(txs):
+            # HOST lanes go to the host interpreter anyway; ERR lanes
+            # may have mispriced on a speculative miss value, so they
+            # must resolve + rerun too
+            n = int(scnt[i])
+            miss_rows = [j for j in range(n)
+                         if sflag[i, j] & M.F_MISS]
+            if not miss_rows:
+                continue
+            if skey is None:
+                skey = np.asarray(out["skey"])
+            keys = []
+            for j in miss_rows:
+                key = self._key_bytes(skey[i, j])
+                if key not in t.storage:
+                    keys.append(key)
+            if keys:
+                missing[i] = keys
+        return missing
+
+    @staticmethod
+    def _key_bytes(limbs: np.ndarray) -> bytes:
+        return b"".join(
+            int(limbs[l]).to_bytes(2, "little") for l in range(16)
+        )[::-1]
+
+    @staticmethod
+    def _word_int(limbs: np.ndarray) -> int:
+        v = 0
+        for l in range(16):
+            v |= int(limbs[l]) << (16 * l)
+        return v
+
+    def _unpack(self, out, txs) -> List[TxResult]:
+        status = np.asarray(out["status"])
+        gas = np.asarray(out["gas"])
+        refund = np.asarray(out["refund"])
+        reason = np.asarray(out["host_reason"])
+        skey = np.asarray(out["skey"])
+        sval = np.asarray(out["sval"])
+        sorig = np.asarray(out["sorig"])
+        sflag = np.asarray(out["sflag"])
+        scnt = np.asarray(out["scnt"])
+        log_top = np.asarray(out["log_top"])
+        log_nt = np.asarray(out["log_nt"])
+        log_data = np.asarray(out["log_data"])
+        log_dlen = np.asarray(out["log_dlen"])
+        log_cnt = np.asarray(out["log_cnt"])
+        results = []
+        for i in range(len(txs)):
+            reads: Dict[bytes, int] = {}
+            writes: Dict[bytes, int] = {}
+            for j in range(int(scnt[i])):
+                fl = int(sflag[i, j])
+                if not fl & M.F_VALID:
+                    continue
+                key = self._key_bytes(skey[i, j])
+                if fl & M.F_READ:
+                    reads[key] = self._word_int(sorig[i, j])
+                if fl & M.F_WRITTEN:
+                    writes[key] = self._word_int(sval[i, j])
+            logs = []
+            for j in range(int(log_cnt[i])):
+                topics = [self._word_int(log_top[i, j, k]).to_bytes(
+                    32, "big") for k in range(int(log_nt[i, j]))]
+                data = bytes(
+                    log_data[i, j, :int(log_dlen[i, j])].astype(
+                        np.uint8).tolist())
+                logs.append((topics, data))
+            results.append(TxResult(
+                status=int(status[i]), gas_left=int(gas[i]),
+                refund=int(refund[i]), logs=logs, reads=reads,
+                writes=writes, host_reason=int(reason[i])))
+        return results

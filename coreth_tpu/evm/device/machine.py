@@ -1,0 +1,860 @@
+"""The batched EVM step machine (SURVEY.md §7.4).
+
+One jitted step executes one opcode for every running lane of a tx
+batch.  Design rules:
+
+- **No vmap.**  The step is written batch-wise, so heavy op families
+  (division, EXP, keccak, storage-cache search, ...) are gated by a
+  scalar ``lax.cond`` on "does ANY lane need this family at this step"
+  — under vmap a switch would pay every branch every step.  Lanes
+  executing the same contract stay in lockstep (spam workloads), so the
+  common step costs only what the live opcodes need.  Heavy families
+  the batch's bytecode provably never uses are excluded from the graph
+  statically (``MachineParams.features``).
+- **Fixed shapes.**  Stack, memory, calldata, storage cache, and log
+  pools are static-capacity arrays; a lane that exceeds a pool marks
+  itself `HOST` and the adapter reroutes that tx to the bit-exact host
+  interpreter (capacity, not correctness, decides).
+- **Exact gas.**  Constant gas / stack arity come from the HOST jump
+  table (tables.py), dynamic gas implements the same reference
+  semantics (core/vm/gas_table.go, operations_acl.go): EIP-2929
+  warm/cold via cache flags, EIP-2200/3529 SSTORE ladders (AP2 without
+  refunds, AP3+ with), quadratic memory expansion, copy/log/keccak/exp
+  word costs.
+- **Storage via local caches.**  Each lane carries a (key -> value)
+  cache over its contract's storage.  A lookup miss appends a
+  MISS-flagged entry and speculates zero; the adapter fills real values
+  from the trie and reruns (miss-and-rerun rounds), which converges
+  because every round resolves at least the keys it observed.
+
+Reference: core/vm/interpreter.go:121 (Run) — the innermost loop this
+machine replaces for device-resident transactions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from coreth_tpu.evm.device import tables as T
+from coreth_tpu.ops import u256, u256x
+from coreth_tpu.ops.keccak import keccak256_blocks
+from coreth_tpu.params import protocol as P
+
+# lane status
+RUN, STOP, REVERT, ERR, HOST, SKIP = 0, 1, 2, 3, 4, 5
+
+# storage-cache flag bits
+F_VALID, F_WARM, F_WRITTEN, F_MISS, F_READ = 1, 2, 4, 8, 16
+
+# host_reason codes (diagnostics)
+(R_NONE, R_STACK, R_MEM, R_SCACHE, R_TCACHE, R_LOG, R_COPY, R_KECCAK,
+ R_STEPS, R_OPCODE) = range(10)
+
+_LIMIT_25 = 1 << 25  # mem/copy addresses beyond this are always-OOG
+LIMBS = u256.LIMBS
+
+
+@dataclass(frozen=True)
+class MachineParams:
+    fork: str
+    batch: int
+    stack_cap: int = 64
+    mem_cap: int = 4096
+    code_cap: int = 4096
+    data_cap: int = 512
+    scache_cap: int = 16
+    tcache_cap: int = 8
+    log_cap: int = 8
+    log_data_cap: int = 160
+    keccak_cap: int = 272      # buffer bytes; messages <= 271
+    copy_cap: int = 512
+    max_steps: int = 1 << 16
+    features: FrozenSet[str] = frozenset()
+
+    @property
+    def refunds(self) -> bool:
+        return self.fork != "ap2"  # AP2 = 2929 pricing, refunds off
+
+
+def word_of_scalar(x, shape=()):
+    w = jnp.zeros(shape + (LIMBS,), dtype=jnp.int32)
+    w = w.at[..., 0].set(x & 0xFFFF)
+    w = w.at[..., 1].set((x >> 16) & 0xFFFF)
+    return w
+
+
+def _peek(stack, sp, k):
+    """stack[sp-1-k] per lane; k may be (B,) or int (clipped gather)."""
+    idx = jnp.clip(sp - 1 - k, 0, stack.shape[1] - 1)
+    g = jnp.take_along_axis(
+        stack, jnp.broadcast_to(idx[:, None, None],
+                                (stack.shape[0], 1, LIMBS)), axis=1)
+    return g[:, 0, :]
+
+
+def _put(stack, pos, val, mask):
+    """stack[pos] = val where mask (row-wise dynamic scatter)."""
+    pos = jnp.where(mask, jnp.clip(pos, 0, stack.shape[1] - 1),
+                    stack.shape[1])  # OOB -> drop
+    return stack.at[jnp.arange(stack.shape[0]), pos].set(
+        val, mode="drop")
+
+
+def _fits25(w):
+    """(int32 value, fits<2^25 flag) from a u256 word; non-fitting
+    values clamp to 2^25 (always-OOG sentinel)."""
+    hi = jnp.zeros(w.shape[:-1], dtype=bool)
+    for i in range(2, LIMBS):
+        hi = hi | (w[..., i] != 0)
+    fits = ~hi & (w[..., 1] < (1 << 9))
+    v = jnp.where(fits, w[..., 0] + (w[..., 1] << 16), _LIMIT_25)
+    return v, fits
+
+
+def _bytes_to_limbs(be):
+    """(B, 32) big-endian bytes -> (B, 16) limbs."""
+    limbs = []
+    for l in range(LIMBS):
+        limbs.append(be[:, 31 - 2 * l] | (be[:, 30 - 2 * l] << 8))
+    return jnp.stack(limbs, axis=-1)
+
+
+def _limbs_to_bytes(w):
+    """(B, 16) limbs -> (B, 32) big-endian bytes."""
+    cols = []
+    for k in range(32):
+        p = 31 - k
+        cols.append((w[:, p // 2] >> ((p % 2) * 8)) & 0xFF)
+    return jnp.stack(cols, axis=-1)
+
+
+def _words8_to_limbs(wds):
+    """(B, 8) uint32 keccak digest words -> (B, 16) limbs (digest bytes
+    read as a big-endian u256)."""
+    limbs = []
+    for l in range(LIMBS):
+        k0 = 31 - 2 * l
+        k1 = 30 - 2 * l
+        b0 = (wds[:, k0 >> 2] >> ((k0 & 3) * 8)) & jnp.uint32(0xFF)
+        b1 = (wds[:, k1 >> 2] >> ((k1 & 3) * 8)) & jnp.uint32(0xFF)
+        limbs.append((b0 | (b1 << 8)).astype(jnp.int32))
+    return jnp.stack(limbs, axis=-1)
+
+
+def _ceil32(x):
+    return ((x + 31) // 32) * 32
+
+
+def _mem_cost_words(w):
+    return w * P.MEMORY_GAS + w * w // P.QUAD_COEFF_DIV
+
+
+_FIELDS = ("pc", "gas", "status", "sp", "refund", "steps", "stack",
+           "mem", "msize", "skey", "sval", "sorig", "sflag", "scnt",
+           "tkey", "tval", "tcnt", "log_top", "log_nt", "log_data",
+           "log_dlen", "log_cnt", "host_reason")
+
+
+def build_machine(params: MachineParams):
+    """Trace-ready step machine for `params`; returns run(inputs)->dict.
+
+    inputs (device arrays, B = params.batch):
+      code (B, code_cap+33) int32 (zero-padded); jdest (B, code_cap);
+      calldata (B, data_cap); data_len (B,); start_gas (B,);
+      callvalue/caller_w/address_w/origin_w/gasprice_w (B, 16);
+      active (B,) bool; skey/sval/sorig (B, S, 16); sflag (B, S);
+      scnt (B,); timestamp/number/gaslimit scalars int32;
+      coinbase_w/chainid_w/basefee_w (16,).
+    """
+    p = params
+    ot = T.op_tables(p.fork)
+    CONST = jnp.asarray(ot.const_gas)
+    NIN = jnp.asarray(ot.nin)
+    NOUT = jnp.asarray(ot.nout)
+    SUP = jnp.asarray(ot.supported)
+    B, S, TC, LC = p.batch, p.scache_cap, p.tcache_cap, p.log_cap
+    feats = p.features
+    refunds = p.refunds
+    rows = jnp.arange(B)
+
+    def run(inputs):
+        code = inputs["code"]
+        jdest = inputs["jdest"]
+        calldata = inputs["calldata"]
+        data_len = inputs["data_len"]
+        ctx_words = {
+            "callvalue": inputs["callvalue"],
+            "caller": inputs["caller_w"],
+            "address": inputs["address_w"],
+            "origin": inputs["origin_w"],
+            "gasprice": inputs["gasprice_w"],
+        }
+        basefee_w = jnp.broadcast_to(inputs["basefee_w"], (B, LIMBS))
+        coinbase_w = jnp.broadcast_to(inputs["coinbase_w"], (B, LIMBS))
+        chainid_w = jnp.broadcast_to(inputs["chainid_w"], (B, LIMBS))
+        timestamp = inputs["timestamp"]
+        number = inputs["number"]
+        gaslimit = inputs["gaslimit"]
+
+        def step(carry):
+            st = dict(zip(_FIELDS, carry))
+            pc, gas, status, sp = (st["pc"], st["gas"], st["status"],
+                                   st["sp"])
+            stack, mem, msize = st["stack"], st["mem"], st["msize"]
+            running = status == RUN
+
+            op = jnp.take_along_axis(
+                code, jnp.clip(pc, 0, code.shape[1] - 1)[:, None],
+                axis=1)[:, 0]
+            op = jnp.where(running, op, 0)
+
+            nin = NIN[op]
+            nout = NOUT[op]
+            sup = SUP[op]
+            const_gas = CONST[op]
+
+            # ---------------- stack discipline
+            under = sp < nin
+            newsp = sp - nin + nout
+            over_1024 = newsp > P.STACK_LIMIT
+            over_cap = (newsp > p.stack_cap) & ~over_1024
+            undefined = sup == 0
+            hostop = sup == 2
+
+            a = _peek(stack, sp, 0)
+            b = _peek(stack, sp, 1)
+            c = _peek(stack, sp, 2)
+            a_v, a_fit = _fits25(a)
+            b_v, b_fit = _fits25(b)
+            c_v, c_fit = _fits25(c)
+            a_zero = u256.is_zero(a)
+            b_zero = u256.is_zero(b)
+            c_zero = u256.is_zero(c)
+
+            # ---------------- op masks
+            def m(o):
+                return op == o
+
+            is_push = (op >= 0x5F) & (op <= 0x7F)
+            is_dup = (op >= 0x80) & (op <= 0x8F)
+            is_swap = (op >= 0x90) & (op <= 0x9F)
+            is_log = (op >= 0xA0) & (op <= 0xA4)
+            is_mload, is_mstore, is_mstore8 = m(0x51), m(0x52), m(0x53)
+            is_keccak = m(0x20)
+            is_ret_rev = m(0xF3) | m(0xFD)
+            is_ddcopy = m(0x37) | m(0x39)          # calldata/code copy
+            is_mcopy = m(0x5E)
+            is_sload, is_sstore = m(0x54), m(0x55)
+            is_jump, is_jumpi = m(0x56), m(0x57)
+
+            # ---------------- memory demand + expansion gas
+            # classes: (off=a len=32|1), (off=a len=b), (dst=a len=c),
+            # mcopy (max(a,b)+c)
+            len32 = is_mload | is_mstore
+            offa_lenb = is_keccak | is_ret_rev | is_log
+            copy3 = is_ddcopy | is_mcopy
+            need = jnp.zeros((B,), dtype=jnp.int32)
+            m_oog = jnp.zeros((B,), dtype=bool)
+            need = jnp.where(len32, a_v + 32, need)
+            m_oog = m_oog | (len32 & ~a_fit)
+            need = jnp.where(is_mstore8, a_v + 1, need)
+            m_oog = m_oog | (is_mstore8 & ~a_fit)
+            nonz = ~b_zero
+            need = jnp.where(offa_lenb & nonz, a_v + b_v, need)
+            m_oog = m_oog | (offa_lenb & nonz & ~(a_fit & b_fit))
+            nonzc = ~c_zero
+            need = jnp.where(is_ddcopy & nonzc, a_v + c_v, need)
+            m_oog = m_oog | (is_ddcopy & nonzc & ~(a_fit & c_fit))
+            if "copy" in feats:
+                mx = jnp.maximum(a_v, b_v)
+                need = jnp.where(is_mcopy & nonzc, mx + c_v, need)
+                m_oog = m_oog | (
+                    is_mcopy & nonzc & ~(a_fit & b_fit & c_fit))
+            m_host_mem = (need > p.mem_cap) & ~m_oog
+            need_c = jnp.clip(need, 0, p.mem_cap)
+            new_msize = jnp.maximum(msize, _ceil32(need_c))
+            exp_gas = jnp.where(
+                need > 0,
+                _mem_cost_words(new_msize // 32)
+                - _mem_cost_words(msize // 32), 0)
+
+            # ---------------- dynamic gas (non-storage)
+            dyn = exp_gas
+            if "copy" in feats or True:
+                # CALLDATACOPY/CODECOPY are always compiled (cheap and
+                # common); MCOPY rides the same word cost when present
+                words_c = (c_v + 31) // 32
+                dyn = dyn + jnp.where(copy3, words_c * P.COPY_GAS, 0)
+            if "keccak" in feats:
+                words_b = (b_v + 31) // 32
+                dyn = dyn + jnp.where(
+                    is_keccak, words_b * P.KECCAK256_WORD_GAS, 0)
+            if "log" in feats:
+                ntopics = jnp.clip(op - 0xA0, 0, 4)
+                dyn = dyn + jnp.where(
+                    is_log, P.LOG_GAS + ntopics * P.LOG_TOPIC_GAS
+                    + b_v * P.LOG_DATA_GAS, 0)
+            if "exp" in feats:
+                ebytes = (u256x.bit_length(b) + 7) // 8
+                dyn = dyn + jnp.where(
+                    m(0x0A), P.EXP_GAS + ebytes * P.EXP_BYTE_EIP158, 0)
+
+            # capacity escapes (host, not error)
+            m_host = m_host_mem | hostop | over_cap
+            reason = jnp.where(hostop, R_OPCODE, R_NONE)
+            reason = jnp.where(over_cap, R_STACK, reason)
+            reason = jnp.where(m_host_mem, R_MEM, reason)
+            if "copy" in feats or True:
+                too_copy = copy3 & (c_v > p.copy_cap)
+                m_host = m_host | too_copy
+                reason = jnp.where(too_copy, R_COPY, reason)
+            if "keccak" in feats:
+                too_kec = is_keccak & (b_v > p.keccak_cap - 1)
+                m_host = m_host | too_kec
+                reason = jnp.where(too_kec, R_KECCAK, reason)
+            if "log" in feats:
+                too_log = is_log & ((b_v > p.log_data_cap)
+                                    | (st["log_cnt"] >= LC))
+                m_host = m_host | too_log
+                reason = jnp.where(too_log, R_LOG, reason)
+
+            # ---------------- jumps
+            dest_ok = a_fit & (a_v < p.code_cap)
+            dest_bit = jnp.take_along_axis(
+                jdest, jnp.clip(a_v, 0, p.code_cap - 1)[:, None],
+                axis=1)[:, 0]
+            jump_valid = dest_ok & (dest_bit == 1)
+            jumpi_taken = is_jumpi & ~b_zero
+            take_jump = is_jump | jumpi_taken
+            bad_jump = take_jump & ~jump_valid
+
+            pre_err = under | over_1024 | undefined | bad_jump | m_oog
+            ok_pre = running & ~pre_err & ~m_host
+
+            # ---------------- cheap value families (always compiled)
+            val = jnp.zeros((B, LIMBS), dtype=jnp.int32)
+
+            def sel(mask, v):
+                return jnp.where(mask[:, None], v, val)
+
+            val = sel(m(0x01), u256.add(a, b))
+            val = sel(m(0x03), u256.sub(a, b))
+            val = sel(m(0x10), u256x.bool_word(u256x.lt(a, b)))
+            val = sel(m(0x11), u256x.bool_word(u256x.gt(a, b)))
+            val = sel(m(0x12), u256x.bool_word(u256x.slt(a, b)))
+            val = sel(m(0x13), u256x.bool_word(u256x.sgt(a, b)))
+            val = sel(m(0x14), u256x.bool_word(u256x.eq(a, b)))
+            val = sel(m(0x15), u256x.bool_word(a_zero))
+            val = sel(m(0x16), a & b)
+            val = sel(m(0x17), a | b)
+            val = sel(m(0x18), a ^ b)
+            val = sel(m(0x19), u256x.not_(a))
+
+            # PUSH0..PUSH32: big-endian bytes following pc
+            pushlen = jnp.where(is_push, op - 0x5F, 0)
+            le_pos = jnp.arange(32, dtype=jnp.int32)[None, :]
+            idxp = pc[:, None] + pushlen[:, None] - le_pos
+            pbytes = jnp.take_along_axis(
+                code, jnp.clip(idxp, 0, code.shape[1] - 1), axis=1)
+            pbytes = jnp.where(le_pos < pushlen[:, None], pbytes, 0)
+            pword = jnp.stack(
+                [pbytes[:, 2 * l] | (pbytes[:, 2 * l + 1] << 8)
+                 for l in range(LIMBS)], axis=-1)
+            val = sel(is_push, pword)
+
+            # DUP_n
+            dup_val = _peek(stack, sp, jnp.clip(op - 0x80, 0, 15))
+            val = sel(is_dup, dup_val)
+
+            # CALLDATALOAD: 32 bytes from calldata[a..], zero-padded
+            cd_idx = a_v[:, None] + 31 - le_pos
+            cd_ok = (a_fit[:, None] & (cd_idx >= a_v[:, None])
+                     & (cd_idx < data_len[:, None])
+                     & (cd_idx < p.data_cap))
+            cd_bytes = jnp.take_along_axis(
+                calldata, jnp.clip(cd_idx, 0, p.data_cap - 1), axis=1)
+            cd_bytes = jnp.where(cd_ok, cd_bytes, 0)
+            cd_word = jnp.stack(
+                [cd_bytes[:, 2 * l] | (cd_bytes[:, 2 * l + 1] << 8)
+                 for l in range(LIMBS)], axis=-1)
+            val = sel(m(0x35), cd_word)
+
+            # context / block words
+            val = sel(m(0x30), ctx_words["address"])
+            val = sel(m(0x32), ctx_words["origin"])
+            val = sel(m(0x33), ctx_words["caller"])
+            val = sel(m(0x34), ctx_words["callvalue"])
+            val = sel(m(0x36), word_of_scalar(data_len, (B,)))
+            val = sel(m(0x38), word_of_scalar(
+                jnp.broadcast_to(inputs["code_len"], (B,)), (B,)))
+            val = sel(m(0x3A), ctx_words["gasprice"])
+            val = sel(m(0x41), coinbase_w)
+            val = sel(m(0x42), word_of_scalar(
+                jnp.broadcast_to(timestamp, (B,)), (B,)))
+            val = sel(m(0x43), word_of_scalar(
+                jnp.broadcast_to(number, (B,)), (B,)))
+            val = sel(m(0x44), word_of_scalar(
+                jnp.ones((B,), dtype=jnp.int32), (B,)))  # difficulty=1
+            val = sel(m(0x45), word_of_scalar(
+                jnp.broadcast_to(gaslimit, (B,)), (B,)))
+            val = sel(m(0x46), chainid_w)
+            if p.fork != "ap2":
+                val = sel(m(0x48), basefee_w)
+            val = sel(m(0x58), word_of_scalar(pc, (B,)))
+            val = sel(m(0x59), word_of_scalar(msize, (B,)))
+            val = sel(m(0x5A), word_of_scalar(
+                jnp.maximum(gas - const_gas, 0), (B,)))
+
+            # MLOAD: big-endian byte j of the word is mem[off + j]
+            ml_be = jnp.take_along_axis(
+                mem, jnp.clip(jnp.clip(a_v, 0, p.mem_cap)[:, None]
+                              + le_pos, 0, p.mem_cap - 1), axis=1)
+            val = sel(is_mload, _bytes_to_limbs(ml_be))
+
+            # ---------------- heavy families (statically + cond gated)
+            if "mul" in feats:
+                mask = m(0x02) & ok_pre
+                val = jax.lax.cond(
+                    jnp.any(mask),
+                    lambda: sel(m(0x02), u256x.mul(a, b)),
+                    lambda: val)
+            if "div" in feats:
+                mask = (m(0x04) | m(0x05) | m(0x06) | m(0x07)) & ok_pre
+
+                def div_family():
+                    signed = m(0x05) | m(0x07)
+                    xa = jnp.where(signed[:, None], u256x._abs(a), a)
+                    xb = jnp.where(signed[:, None], u256x._abs(b), b)
+                    q, r = u256x.divmod_(xa, xb)
+                    neg_q = (u256x._sign(a) ^ u256x._sign(b)) == 1
+                    neg_r = u256x._sign(a) == 1
+                    sq = jnp.where((signed & neg_q)[:, None],
+                                   u256x.neg(q), q)
+                    sr = jnp.where((signed & neg_r)[:, None],
+                                   u256x.neg(r), r)
+                    v = val
+                    v = jnp.where(m(0x04)[:, None], q, v)
+                    v = jnp.where(m(0x05)[:, None], sq, v)
+                    v = jnp.where(m(0x06)[:, None], r, v)
+                    v = jnp.where(m(0x07)[:, None], sr, v)
+                    return v
+
+                val = jax.lax.cond(jnp.any(mask), div_family,
+                                   lambda: val)
+            if "addmod" in feats:
+                mask = m(0x08) & ok_pre
+                val = jax.lax.cond(
+                    jnp.any(mask),
+                    lambda: sel(m(0x08), u256x.addmod(a, b, c)),
+                    lambda: val)
+            if "mulmod" in feats:
+                mask = m(0x09) & ok_pre
+                val = jax.lax.cond(
+                    jnp.any(mask),
+                    lambda: sel(m(0x09), u256x.mulmod(a, b, c)),
+                    lambda: val)
+            if "exp" in feats:
+                mask = m(0x0A) & ok_pre
+                val = jax.lax.cond(
+                    jnp.any(mask),
+                    lambda: sel(m(0x0A), u256x.exp_(a, b)),
+                    lambda: val)
+            if "shift" in feats:
+                mask = (m(0x0B) | m(0x1A) | m(0x1B) | m(0x1C)
+                        | m(0x1D)) & ok_pre
+
+                def shift_family():
+                    v = val
+                    v = jnp.where(m(0x0B)[:, None],
+                                  u256x.signextend(a, b), v)
+                    v = jnp.where(m(0x1A)[:, None],
+                                  u256x.byte_op(a, b), v)
+                    # SHL/SHR/SAR: shift amount on top (a), value b
+                    v = jnp.where(m(0x1B)[:, None], u256x.shl(b, a), v)
+                    v = jnp.where(m(0x1C)[:, None], u256x.shr(b, a), v)
+                    v = jnp.where(m(0x1D)[:, None], u256x.sar(b, a), v)
+                    return v
+
+                val = jax.lax.cond(jnp.any(mask), shift_family,
+                                   lambda: val)
+            if "keccak" in feats:
+                mask = is_keccak & ok_pre
+
+                def keccak_family():
+                    KC = p.keccak_cap
+                    off = jnp.clip(a_v, 0, p.mem_cap)
+                    jj = jnp.arange(KC, dtype=jnp.int32)[None, :]
+                    src = jnp.take_along_axis(
+                        mem, jnp.clip(off[:, None] + jj, 0,
+                                      p.mem_cap - 1), axis=1)
+                    src = jnp.where(jj < b_v[:, None], src, 0)
+                    bu = src.astype(jnp.uint32)
+                    nw = KC // 4
+                    words = (bu[:, 0::4] | (bu[:, 1::4] << 8)
+                             | (bu[:, 2::4] << 16) | (bu[:, 3::4] << 24))
+                    # pad10*1: 0x01 at byte len, 0x80 at last rate byte
+                    widx = jnp.arange(nw, dtype=jnp.int32)[None, :]
+                    sfx = jnp.where(
+                        widx == (b_v // 4)[:, None],
+                        jnp.uint32(1) << ((b_v % 4) * 8)[:, None].astype(
+                            jnp.uint32), jnp.uint32(0))
+                    nb = b_v // 136 + 1
+                    last = nb * 34 - 1
+                    sfx = sfx ^ jnp.where(
+                        widx == last[:, None], jnp.uint32(0x80000000),
+                        jnp.uint32(0))
+                    words = words ^ sfx
+                    blocks = words.reshape(B, KC // 136, 34)
+                    digest = keccak256_blocks(blocks, nb)
+                    return sel(is_keccak, _words8_to_limbs(digest))
+
+                val = jax.lax.cond(jnp.any(mask), keccak_family,
+                                   lambda: val)
+
+            # ---------------- storage family (cost + writes inside)
+            skey, sval = st["skey"], st["sval"]
+            sorig, sflag, scnt = st["sorig"], st["sflag"], st["scnt"]
+            cost_st = jnp.zeros((B,), dtype=jnp.int32)
+            refund_d = jnp.zeros((B,), dtype=jnp.int32)
+            st_err = jnp.zeros((B,), dtype=bool)
+            st_host = jnp.zeros((B,), dtype=bool)
+            if "storage" in feats:
+                mask_any = (is_sload | is_sstore) & ok_pre
+
+                def storage_family():
+                    # Avalanche multicoin partition: normal storage
+                    # keys have bit 0 of byte 0 (the top byte = high
+                    # byte of limb 15) cleared (statedb.
+                    # normalize_state_key); cache keys match the trie's
+                    key = a.at[:, LIMBS - 1].set(
+                        a[:, LIMBS - 1] & 0xFEFF)
+                    new = b
+                    hit = jnp.all(skey == key[:, None, :], axis=-1) \
+                        & ((sflag & F_VALID) != 0)
+                    found = jnp.any(hit, axis=-1)
+                    hidx = jnp.argmax(hit, axis=-1)
+                    need_app = mask_any & ~found
+                    full = need_app & (scnt >= S)
+                    eidx = jnp.where(found, hidx,
+                                     jnp.clip(scnt, 0, S - 1))
+                    eflag = sflag[rows, eidx]
+                    warm = found & ((eflag & F_WARM) != 0)
+                    cur = jnp.where(found[:, None], sval[rows, eidx], 0)
+                    orig = jnp.where(found[:, None],
+                                     sorig[rows, eidx], 0)
+                    # SLOAD gas (gas_sload_eip2929)
+                    c_sload = jnp.where(
+                        warm, P.WARM_STORAGE_READ_COST_EIP2929,
+                        P.COLD_SLOAD_COST_EIP2929)
+                    # SSTORE gas ladder (make_gas_sstore_eip2929)
+                    sentry = is_sstore & (
+                        gas <= P.SSTORE_SENTRY_GAS_EIP2200)
+                    cold_sur = jnp.where(
+                        warm, 0, P.COLD_SLOAD_COST_EIP2929)
+                    eq_cn = u256x.eq(cur, new)
+                    eq_oc = u256x.eq(orig, cur)
+                    eq_on = u256x.eq(orig, new)
+                    o_zero = u256.is_zero(orig)
+                    c_zero = u256.is_zero(cur)
+                    n_zero = u256.is_zero(new)
+                    base = jnp.where(
+                        eq_cn, P.WARM_STORAGE_READ_COST_EIP2929,
+                        jnp.where(
+                            eq_oc,
+                            jnp.where(o_zero, P.SSTORE_SET_GAS_EIP2200,
+                                      P.SSTORE_RESET_GAS_EIP2200
+                                      - P.COLD_SLOAD_COST_EIP2929),
+                            P.WARM_STORAGE_READ_COST_EIP2929))
+                    c_sstore = cold_sur + base
+                    cost = jnp.where(is_sload & mask_any, c_sload, 0) \
+                        + jnp.where(is_sstore & mask_any, c_sstore, 0)
+                    rd = jnp.zeros((B,), dtype=jnp.int32)
+                    if refunds:
+                        CL = P.SSTORE_CLEARS_SCHEDULE_REFUND_EIP3529
+                        dirty = ~eq_cn & ~eq_oc
+                        rd = rd + jnp.where(
+                            ~eq_cn & eq_oc & ~o_zero & n_zero, CL, 0)
+                        rd = rd + jnp.where(
+                            dirty & ~o_zero & c_zero, -CL, 0)
+                        rd = rd + jnp.where(
+                            dirty & ~o_zero & ~c_zero & n_zero, CL, 0)
+                        rd = rd + jnp.where(
+                            dirty & eq_on & o_zero,
+                            P.SSTORE_SET_GAS_EIP2200
+                            - P.WARM_STORAGE_READ_COST_EIP2929, 0)
+                        rd = rd + jnp.where(
+                            dirty & eq_on & ~o_zero,
+                            P.SSTORE_RESET_GAS_EIP2200
+                            - P.COLD_SLOAD_COST_EIP2929
+                            - P.WARM_STORAGE_READ_COST_EIP2929, 0)
+                        rd = jnp.where(is_sstore & mask_any, rd, 0)
+                    afford = gas >= cost
+                    do = mask_any & ~sentry & ~full & afford
+                    # writes: appended entries get key/orig/miss
+                    wflag = eflag
+                    wflag = wflag | F_VALID | F_READ | F_WARM
+                    wflag = jnp.where(need_app, wflag | F_MISS, wflag)
+                    wflag = jnp.where(is_sstore, wflag | F_WRITTEN,
+                                      wflag)
+                    nkey = jnp.where((do & need_app)[:, None], key,
+                                     skey[rows, eidx])
+                    nval = jnp.where((do & is_sstore)[:, None], new,
+                                     jnp.where(
+                                         (do & need_app)[:, None], 0,
+                                         sval[rows, eidx]))
+                    nori = jnp.where((do & need_app)[:, None], 0,
+                                     sorig[rows, eidx])
+                    eidx_w = jnp.where(do, eidx, S)  # drop when not do
+                    skey2 = skey.at[rows, eidx_w].set(nkey, mode="drop")
+                    sval2 = sval.at[rows, eidx_w].set(nval, mode="drop")
+                    sorig2 = sorig.at[rows, eidx_w].set(nori,
+                                                        mode="drop")
+                    sflag2 = sflag.at[rows, eidx_w].set(
+                        jnp.where(do, wflag, 0), mode="drop")
+                    scnt2 = scnt + (do & need_app).astype(jnp.int32)
+                    v = jnp.where((is_sload & do)[:, None],
+                                  jnp.where(found[:, None], cur, 0),
+                                  val)
+                    return (v, cost, rd, sentry & mask_any,
+                            full, skey2, sval2, sorig2, sflag2, scnt2)
+
+                (val, cost_st, refund_d, st_err, st_host, skey, sval,
+                 sorig, sflag, scnt) = jax.lax.cond(
+                    jnp.any(mask_any), storage_family,
+                    lambda: (val, cost_st, refund_d, st_err, st_host,
+                             skey, sval, sorig, sflag, scnt))
+                m_host = m_host | st_host
+                reason = jnp.where(st_host, R_SCACHE, reason)
+
+            # ---------------- transient storage (cancun)
+            tkey, tval, tcnt = st["tkey"], st["tval"], st["tcnt"]
+            if "tstorage" in feats:
+                is_tload, is_tstore = m(0x5C), m(0x5D)
+                mask_any = (is_tload | is_tstore) & ok_pre
+
+                def t_family():
+                    key = a
+                    hit = jnp.all(tkey == key[:, None, :], axis=-1) \
+                        & (jnp.arange(TC)[None, :] < tcnt[:, None])
+                    found = jnp.any(hit, axis=-1)
+                    hidx = jnp.argmax(hit, axis=-1)
+                    need_app = mask_any & is_tstore & ~found
+                    full = need_app & (tcnt >= TC)
+                    do = mask_any & ~full
+                    eidx = jnp.where(found, hidx,
+                                     jnp.clip(tcnt, 0, TC - 1))
+                    cur = jnp.where(found[:, None], tval[rows, eidx], 0)
+                    eidx_w = jnp.where(do & is_tstore, eidx, TC)
+                    tkey2 = tkey.at[rows, eidx_w].set(
+                        key, mode="drop")
+                    tval2 = tval.at[rows, eidx_w].set(b, mode="drop")
+                    tcnt2 = tcnt + (do & need_app).astype(jnp.int32)
+                    v = jnp.where((is_tload & do)[:, None], cur, val)
+                    return v, full, tkey2, tval2, tcnt2
+
+                val, t_host, tkey, tval, tcnt = jax.lax.cond(
+                    jnp.any(mask_any), t_family,
+                    lambda: (val, jnp.zeros((B,), dtype=bool),
+                             tkey, tval, tcnt))
+                m_host = m_host | t_host
+                reason = jnp.where(t_host, R_TCACHE, reason)
+
+            # ---------------- final gas + status resolution
+            cost = const_gas + dyn + cost_st
+            oog = running & ~pre_err & (gas < cost)
+            err = running & (pre_err | st_err | oog)
+            host_now = running & ~err & m_host
+            ok = running & ~err & ~host_now
+
+            # ---------------- side effects (masked by ok)
+            # MSTORE / MSTORE8 (always compiled)
+            w_bytes = _limbs_to_bytes(b)
+            ms_mask = ok & (is_mstore | is_mstore8)
+            n_write = jnp.where(is_mstore8, 1, 32)
+            wj = jnp.arange(32, dtype=jnp.int32)[None, :]
+            w_idx = a_v[:, None] + wj
+            w_idx = jnp.where(
+                ms_mask[:, None] & (wj < n_write[:, None]),
+                jnp.clip(w_idx, 0, p.mem_cap - 1), p.mem_cap)
+            w_src = jnp.where(is_mstore8[:, None],
+                              jnp.broadcast_to((b[:, 0] & 0xFF)[:, None],
+                                               (B, 32)), w_bytes)
+            mem = mem.at[rows[:, None], w_idx].set(w_src, mode="drop")
+
+            # copies (calldata/code/mcopy)
+            copy_mask = ok & copy3
+            if True:
+                def copy_family():
+                    CC = p.copy_cap
+                    jj = jnp.arange(CC, dtype=jnp.int32)[None, :]
+                    src_idx = b_v[:, None] + jj
+                    # calldatacopy source: calldata (pad beyond len)
+                    cd = jnp.take_along_axis(
+                        calldata, jnp.clip(src_idx, 0, p.data_cap - 1),
+                        axis=1)
+                    cd = jnp.where(
+                        b_fit[:, None] & (src_idx < data_len[:, None])
+                        & (src_idx < p.data_cap), cd, 0)
+                    # beyond data_cap with real data_len<=cap: zeros ok
+                    co = jnp.take_along_axis(
+                        code, jnp.clip(src_idx, 0, code.shape[1] - 1),
+                        axis=1)
+                    co = jnp.where(
+                        b_fit[:, None] & (src_idx < code.shape[1]),
+                        co, 0)
+                    mm = jnp.take_along_axis(
+                        mem, jnp.clip(src_idx, 0, p.mem_cap - 1),
+                        axis=1)
+                    src = jnp.where(m(0x37)[:, None], cd,
+                                    jnp.where(m(0x39)[:, None], co, mm))
+                    d_idx = a_v[:, None] + jj
+                    d_idx = jnp.where(
+                        copy_mask[:, None] & (jj < c_v[:, None]),
+                        jnp.clip(d_idx, 0, p.mem_cap - 1), p.mem_cap)
+                    return mem.at[rows[:, None], d_idx].set(
+                        src, mode="drop")
+
+                mem = jax.lax.cond(jnp.any(copy_mask), copy_family,
+                                   lambda: mem)
+
+            # logs
+            log_top, log_nt = st["log_top"], st["log_nt"]
+            log_data, log_dlen = st["log_data"], st["log_dlen"]
+            log_cnt = st["log_cnt"]
+            if "log" in feats:
+                lmask = ok & is_log
+
+                def log_family():
+                    n = jnp.clip(op - 0xA0, 0, 4)
+                    topics = jnp.stack(
+                        [_peek(stack, sp, 2 + k) for k in range(4)],
+                        axis=1)  # (B, 4, 16)
+                    tmask = (jnp.arange(4)[None, :] < n[:, None])
+                    topics = jnp.where(tmask[..., None], topics, 0)
+                    LD = p.log_data_cap
+                    jj = jnp.arange(LD, dtype=jnp.int32)[None, :]
+                    dsrc = jnp.take_along_axis(
+                        mem, jnp.clip(a_v[:, None] + jj, 0,
+                                      p.mem_cap - 1), axis=1)
+                    dsrc = jnp.where(jj < b_v[:, None], dsrc, 0)
+                    slot = jnp.where(lmask, jnp.clip(log_cnt, 0, LC - 1),
+                                     LC)
+                    lt2 = log_top.at[rows, slot].set(topics,
+                                                     mode="drop")
+                    ln2 = log_nt.at[rows, slot].set(n, mode="drop")
+                    ld2 = log_data.at[rows, slot].set(dsrc, mode="drop")
+                    ll2 = log_dlen.at[rows, slot].set(b_v, mode="drop")
+                    lc2 = log_cnt + lmask.astype(jnp.int32)
+                    return lt2, ln2, ld2, ll2, lc2
+
+                log_top, log_nt, log_data, log_dlen, log_cnt = \
+                    jax.lax.cond(
+                        jnp.any(lmask), log_family,
+                        lambda: (log_top, log_nt, log_data, log_dlen,
+                                 log_cnt))
+
+            # ---------------- stack writes
+            has_push = (nout > 0) & ~is_swap
+            stack = _put(stack, newsp - 1, val, ok & has_push)
+
+            # SWAP: exchange top with top-1-n
+            swap_n = jnp.clip(op - 0x8F, 1, 16)
+            sw_mask = ok & is_swap
+            top_v = a
+            oth_v = _peek(stack, sp, swap_n)
+            stack = _put(stack, sp - 1, oth_v, sw_mask)
+            stack = _put(stack, sp - 1 - swap_n, top_v, sw_mask)
+
+            # ---------------- advance
+            is_stop = m(0x00) | m(0xF3)
+            is_revert = m(0xFD)
+            next_pc = jnp.where(take_jump, a_v, pc + 1 + pushlen)
+            status = jnp.where(
+                running,
+                jnp.where(err, ERR,
+                          jnp.where(host_now, HOST,
+                                    jnp.where(ok & is_stop, STOP,
+                                              jnp.where(ok & is_revert,
+                                                        REVERT, RUN)))),
+                status)
+            gas = jnp.where(ok, gas - cost, gas)
+            sp = jnp.where(ok, newsp, sp)
+            pc = jnp.where(ok & (status == RUN), next_pc, pc)
+            msize = jnp.where(ok & (need > 0), new_msize, msize)
+            refund = st["refund"] + jnp.where(ok, refund_d, 0)
+            host_reason = jnp.where(host_now, reason,
+                                    st["host_reason"])
+
+            out = dict(st)
+            out.update(pc=pc, gas=gas, status=status, sp=sp,
+                       refund=refund, steps=st["steps"] + 1,
+                       stack=stack, mem=mem, msize=msize, skey=skey,
+                       sval=sval, sorig=sorig, sflag=sflag, scnt=scnt,
+                       tkey=tkey, tval=tval, tcnt=tcnt,
+                       log_top=log_top, log_nt=log_nt,
+                       log_data=log_data, log_dlen=log_dlen,
+                       log_cnt=log_cnt, host_reason=host_reason)
+            return tuple(out[f] for f in _FIELDS)
+
+        def cond(carry):
+            st = dict(zip(_FIELDS, carry))
+            return jnp.any(st["status"] == RUN) \
+                & (st["steps"] < p.max_steps)
+
+        init = dict(
+            pc=jnp.zeros((B,), dtype=jnp.int32),
+            gas=inputs["start_gas"].astype(jnp.int32),
+            status=jnp.where(inputs["active"], RUN, SKIP).astype(
+                jnp.int32),
+            sp=jnp.zeros((B,), dtype=jnp.int32),
+            refund=jnp.zeros((B,), dtype=jnp.int32),
+            steps=jnp.int32(0),
+            stack=jnp.zeros((B, p.stack_cap, LIMBS), dtype=jnp.int32),
+            mem=jnp.zeros((B, p.mem_cap), dtype=jnp.int32),
+            msize=jnp.zeros((B,), dtype=jnp.int32),
+            skey=inputs["skey"], sval=inputs["sval"],
+            sorig=inputs["sorig"], sflag=inputs["sflag"],
+            scnt=inputs["scnt"],
+            tkey=jnp.zeros((B, TC, LIMBS), dtype=jnp.int32),
+            tval=jnp.zeros((B, TC, LIMBS), dtype=jnp.int32),
+            tcnt=jnp.zeros((B,), dtype=jnp.int32),
+            log_top=jnp.zeros((B, LC, 4, LIMBS), dtype=jnp.int32),
+            log_nt=jnp.zeros((B, LC), dtype=jnp.int32),
+            log_data=jnp.zeros((B, LC, p.log_data_cap),
+                               dtype=jnp.int32),
+            log_dlen=jnp.zeros((B, LC), dtype=jnp.int32),
+            log_cnt=jnp.zeros((B,), dtype=jnp.int32),
+            host_reason=jnp.zeros((B,), dtype=jnp.int32),
+        )
+        final = jax.lax.while_loop(
+            cond, step, tuple(init[f] for f in _FIELDS))
+        st = dict(zip(_FIELDS, final))
+        # lanes still running at the step bound escape to host
+        timed_out = st["status"] == RUN
+        st["status"] = jnp.where(timed_out, HOST, st["status"])
+        st["host_reason"] = jnp.where(timed_out, R_STEPS,
+                                      st["host_reason"])
+        # every error consumes all gas (interpreter.go: any err but
+        # ErrExecutionReverted burns the remaining gas)
+        st["gas"] = jnp.where(st["status"] == ERR, 0, st["gas"])
+        return st
+
+    return run
+
+
+_MACHINES: Dict[MachineParams, object] = {}
+
+
+def get_machine(params: MachineParams):
+    """Jitted machine memoized by params (one XLA program per shape +
+    fork + feature set)."""
+    fn = _MACHINES.get(params)
+    if fn is None:
+        fn = jax.jit(build_machine(params))
+        _MACHINES[params] = fn
+    return fn

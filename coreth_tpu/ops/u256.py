@@ -45,19 +45,22 @@ def to_ints(arr) -> list:
 def normalize(x: jnp.ndarray) -> jnp.ndarray:
     """Propagate carries so every limb lands in [0, 2^16).
 
-    Accepts limbs that exceed 16 bits (e.g. after a segment-sum); needs
-    ceil(32/16)=2+ passes in the worst case, so we run a short fixed
-    loop — XLA unrolls it.
+    Sequential 16-step carry chain (unrolled at trace time).  A fixed
+    number of PARALLEL passes is NOT enough: each parallel pass moves a
+    carry only one limb, so 0xFFFF,0xFFFF,...,+1 ripples the full
+    width (a carry chain like 2^256-1 + 1 needs 16 steps).  The
+    running-carry form handles any nonnegative limb magnitude (segment
+    sums feed limbs up to ~2^30; carry stays < 2^15 + prior, well in
+    int32).
     """
-    def one_pass(v):
+    out = []
+    carry = jnp.zeros(x.shape[:-1], dtype=x.dtype)
+    n = x.shape[-1]
+    for i in range(n):
+        v = x[..., i] + carry
+        out.append(v & LIMB_MASK)
         carry = v >> LIMB_BITS
-        v = v & LIMB_MASK
-        v = v + jnp.concatenate(
-            [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1)
-        return v
-    for _ in range(3):
-        x = one_pass(x)
-    return x
+    return jnp.stack(out, axis=-1)
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -66,15 +69,16 @@ def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a - b mod 2^256 (caller checks a >= b via gte).
+    """a - b mod 2^(16*limbs) (caller checks a >= b via gte).
 
-    The 16-limb borrow chain is unrolled at trace time (no lax.scan:
-    scans over carries interact badly with shard_map's varying-axis
-    typing, and 16 fixed steps fuse fine)."""
+    Works for any limb count (the device ALU reuses it at 17/32 limbs).
+    The borrow chain is unrolled at trace time (no lax.scan: scans over
+    carries interact badly with shard_map's varying-axis typing, and
+    the fixed steps fuse fine)."""
     diff = a - b
     limbs = []
     borrow = jnp.zeros(a.shape[:-1], dtype=jnp.int32)
-    for i in range(LIMBS):
+    for i in range(a.shape[-1]):
         limb = diff[..., i] - borrow
         borrow = (limb < 0).astype(jnp.int32)
         limbs.append(limb + (borrow << LIMB_BITS))
@@ -82,13 +86,14 @@ def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def gte(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a >= b elementwise over the last axis (both normalized).
+    """a >= b elementwise over the last axis (both normalized, any
+    limb count).
 
     Lexicographic compare from the most-significant limb, unrolled at
     trace time (see sub() for why no lax.scan)."""
     decided = jnp.zeros(a.shape[:-1], dtype=bool)
     result = jnp.ones(a.shape[:-1], dtype=bool)  # equal => True
-    for i in range(LIMBS - 1, -1, -1):
+    for i in range(a.shape[-1] - 1, -1, -1):
         a_l, b_l = a[..., i], b[..., i]
         gt = a_l > b_l
         lt = a_l < b_l

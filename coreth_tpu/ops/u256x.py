@@ -75,33 +75,6 @@ def mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(outs, axis=-1)
 
 
-def _ge_ext(r: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Lexicographic r >= b over the (equal-width) last axis."""
-    n = r.shape[-1]
-    decided = jnp.zeros(r.shape[:-1], dtype=bool)
-    result = jnp.ones(r.shape[:-1], dtype=bool)
-    for i in range(n - 1, -1, -1):
-        gt = r[..., i] > b[..., i]
-        lt = r[..., i] < b[..., i]
-        result = jnp.where(~decided & gt, True, result)
-        result = jnp.where(~decided & lt, False, result)
-        decided = decided | gt | lt
-    return result
-
-
-def _sub_ext(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a - b (a >= b) over the last axis, borrow chain unrolled."""
-    n = a.shape[-1]
-    diff = a - b
-    limbs = []
-    borrow = _zeros_like_head(a)
-    for i in range(n):
-        limb = diff[..., i] - borrow
-        borrow = (limb < 0).astype(jnp.int32)
-        limbs.append(limb + (borrow << 16))
-    return jnp.stack(limbs, axis=-1)
-
-
 def _shift1_add_bit(r: jnp.ndarray, bit: jnp.ndarray) -> jnp.ndarray:
     """r*2 + bit with one carry pass (entry limbs are < 2^16, so one
     pass fully renormalizes)."""
@@ -133,8 +106,8 @@ def _mod_bits(x: jnp.ndarray, nbits: int, n: jnp.ndarray,
         bit = (jax.lax.dynamic_index_in_dim(
             x, limb, axis=-1, keepdims=False) >> sh) & 1
         r = _shift1_add_bit(r, bit)
-        ge = _ge_ext(r, n17)
-        r = jnp.where(ge[..., None], _sub_ext(r, n17), r)
+        ge = u256.gte(r, n17)
+        r = jnp.where(ge[..., None], u256.sub(r, n17), r)
         if q is not None:
             hot = (jnp.arange(L, dtype=jnp.int32) == limb).astype(jnp.int32)
             q = q + (ge.astype(jnp.int32) << sh)[..., None] * hot
@@ -184,12 +157,10 @@ def smod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def addmod(a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
     """(a + b) % n over the full 257-bit sum (opAddmod)."""
-    # widen to 17 limbs BEFORE carrying so the limb-15 carry-out lands
-    s = jnp.concatenate([a + b, _zeros_like_head(a, (1,))], axis=-1)
-    for _ in range(2):  # limbs <= 0x1FFFE, then <= 0x10000: two passes
-        c = s >> 16
-        s = (s & MASK) + jnp.concatenate(
-            [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+    # widen to 17 limbs BEFORE carrying so the limb-15 carry-out
+    # lands; normalize's sequential carry chain handles full ripples
+    s = u256.normalize(
+        jnp.concatenate([a + b, _zeros_like_head(a, (1,))], axis=-1))
     _, r = _mod_bits(s, 17 * 16, n)
     return r
 

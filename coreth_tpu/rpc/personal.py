@@ -48,10 +48,12 @@ def register_personal_api(server, keystore: KeyStore) -> None:
             # durations are a type error (uint64 on the geth side)
             if duration is None:
                 secs = 300.0
+            elif not isinstance(duration, (int, float)) \
+                    or isinstance(duration, bool) or duration < 0:
+                raise RPCError(
+                    "duration must be a non-negative number", -32602)
             elif duration == 0:
                 secs = None
-            elif duration < 0:
-                raise RPCError("duration must be non-negative", -32602)
             else:
                 secs = float(duration)
             keystore.unlock(_addr(address), password, duration=secs)

@@ -1,0 +1,380 @@
+"""Differential tests: the device EVM step machine vs the host
+interpreter, same bytecode, same pre-state — status, exact gas, refund
+counter, storage writes, and logs must all agree.
+
+The host side (evm/interpreter.py) is itself pinned against reference
+semantics (tests/test_evm.py, tests/statetests, independent vectors),
+so agreement here transfers that confidence to the device machine
+(reference: core/vm/interpreter.go:121).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.evm.device import machine as M
+from coreth_tpu.evm.device.adapter import (
+    BlockEnv, MachineRunner, TxSpec,
+)
+from coreth_tpu.evm.device.tables import scan_code
+from coreth_tpu.evm.evm import EVM, BlockContext, Config, TxContext
+from coreth_tpu.evm import vmerrs
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.state import Database, StateDB
+from coreth_tpu.workloads.erc20 import (
+    TOKEN_RUNTIME, balance_slot, transfer_calldata,
+)
+
+SENDER = b"\x11" * 20
+CONTRACT = b"\xcc" * 20
+COINBASE = bytes.fromhex("0100000000000000000000000000000000000000")
+NUMBER, TIME = 5, 3_000
+GAS_PRICE = 30 * 10**9
+RULES = CFG.rules(NUMBER, TIME)
+ENV = BlockEnv(coinbase=COINBASE, timestamp=TIME, number=NUMBER,
+               gas_limit=8_000_000, chain_id=CFG.chain_id,
+               base_fee=25 * 10**9)
+
+
+def push(v: int) -> str:
+    raw = v.to_bytes((max(v.bit_length(), 1) + 7) // 8, "big")
+    return f"{0x5F + len(raw):02x}" + raw.hex()
+
+
+def host_run(code: bytes, calldata: bytes, gas: int,
+             storage=None, value: int = 0):
+    """Run via the host interpreter on committed pre-state; returns
+    (status, gas_left, refund, writes, logs)."""
+    db = Database()
+    statedb = StateDB(EMPTY_ROOT, db)
+    statedb.set_code(CONTRACT, code)
+    for k, v in (storage or {}).items():
+        statedb.set_state(CONTRACT, k, v.to_bytes(32, "big"))
+    statedb.add_balance(SENDER, 10**18)
+    root = statedb.commit(False)
+    statedb = StateDB(root, db)
+    block_ctx = BlockContext(coinbase=COINBASE, number=NUMBER,
+                             time=TIME, gas_limit=ENV.gas_limit,
+                             base_fee=ENV.base_fee)
+    evm = EVM(block_ctx, TxContext(origin=SENDER, gas_price=GAS_PRICE),
+              statedb, CFG, Config())
+    statedb.prepare(RULES, SENDER, COINBASE, CONTRACT,
+                    list(RULES.active_precompiles), [])
+    ret, gas_left, err = evm.call(SENDER, CONTRACT, calldata, gas,
+                                  value)
+    if err is None:
+        status = M.STOP
+    elif isinstance(err, vmerrs.ErrExecutionReverted):
+        status = M.REVERT
+    else:
+        status = M.ERR
+    logs = [([bytes(t) for t in lg.topics], bytes(lg.data))
+            for lg in statedb.get_logs()] if status == M.STOP else []
+    return status, gas_left, statedb.refund, statedb, logs
+
+
+def device_run(code: bytes, calldata: bytes, gas: int,
+               storage=None, value: int = 0):
+    from coreth_tpu.state.statedb import normalize_state_key
+    storage = {normalize_state_key(k): v
+               for k, v in (storage or {}).items()}
+
+    def resolver(addr, key):
+        return storage.get(key, 0)
+
+    runner = MachineRunner("durango", ENV, resolver)
+    tx = TxSpec(code=code, calldata=calldata, gas=gas, value=value,
+                caller=SENDER, address=CONTRACT, origin=SENDER,
+                gas_price=GAS_PRICE)
+    res = runner.run([tx])[0]
+    writes = {k: v for k, v in res.writes.items()
+              if storage.get(k, 0) != v}
+    return res.status, res.gas_left, res.refund, writes, res.logs
+
+
+def both(code_hex_or_bytes, calldata=b"", gas=500_000, storage=None,
+         value=0):
+    code = (bytes.fromhex(code_hex_or_bytes)
+            if isinstance(code_hex_or_bytes, str)
+            else code_hex_or_bytes)
+    info = scan_code(code, "durango")
+    assert info.eligible, info.reason
+    h = host_run(code, calldata, gas, storage, value)
+    d = device_run(code, calldata, gas, storage, value)
+    assert d[0] == h[0], f"status: device {d[0]} host {h[0]}"
+    assert d[1] == h[1], f"gas_left: device {d[1]} host {h[1]}"
+    assert d[2] == h[2], f"refund: device {d[2]} host {h[2]}"
+    if d[0] == M.STOP:
+        # final storage values must agree over every key either side
+        # touched (host statedb returned as h[3])
+        statedb = h[3]
+        from coreth_tpu.state.statedb import normalize_state_key
+        keys = set(d[3]) | {normalize_state_key(k)
+                            for k in (storage or {})}
+        for k in keys:
+            hv = int.from_bytes(statedb.get_state(CONTRACT, k), "big")
+            dv = d[3].get(k, (storage or {}).get(k, 0))
+            assert dv == hv, f"slot {k.hex()}: device {dv} host {hv}"
+        assert d[4] == h[4], f"logs: device {d[4]} host {h[4]}"
+    return d
+
+
+def sstore_seq(exprs) -> bytes:
+    out = ""
+    for code, slot in exprs:
+        out += code + push(slot) + "55"
+    return bytes.fromhex(out + "00")
+
+
+# ---------------------------------------------------------------- arith
+
+def test_arith_family():
+    both(sstore_seq([
+        (push(3) + push(4) + "01", 1),           # add
+        (push(3) + push(10) + "03", 2),          # sub
+        (push(7) + push(6) + "02", 3),           # mul
+        (push(3) + push(17) + "04", 4),          # div
+        (push(0) + push(17) + "04", 5),          # div/0
+        (push(5) + push(17) + "06", 6),          # mod
+    ]))
+
+
+def test_signed_ops():
+    both(sstore_seq([
+        (push(3) + push(2**256 - 6) + "05", 1),       # sdiv
+        (push(5) + push(2**256 - 17) + "07", 2),      # smod
+        (push(2**255) + push(2**256 - 1) + "05", 3),
+        (push(0) + push(2**256 - 6) + "0b", 4),       # signextend
+    ]))
+
+
+def test_modexp():
+    both(sstore_seq([
+        (push(7) + push(5) + push(100) + "08", 1),    # addmod
+        (push(7) + push(5) + push(100) + "09", 2),    # mulmod
+        (push(5) + push(3) + "0a", 3),                # exp
+        (push(0) + push(3) + "0a", 4),                # exp 0
+        (push(200) + push(2**128 - 1) + "0a", 5),     # big exp
+    ]))
+
+
+def test_bitwise_compare():
+    both(sstore_seq([
+        (push(2) + push(1) + "10", 1),     # lt
+        (push(1) + push(2) + "11", 2),     # gt
+        (push(1) + push(2**256 - 1) + "12", 3),   # slt
+        (push(2**256 - 1) + push(1) + "13", 4),   # sgt
+        (push(5) + push(5) + "14", 5),     # eq
+        (push(0) + "15", 6),               # iszero
+        (push(0b1100) + push(0b1010) + "16", 7),
+        (push(0b1100) + push(0b1010) + "17", 8),
+        (push(0b1100) + push(0b1010) + "18", 9),
+        (push(1) + "19", 10),              # not
+        (push(2**200) + push(3) + "1a", 11),      # byte
+        (push(7) + push(2) + "1b", 12),    # shl
+        (push(2**100) + push(4) + "1c", 13),      # shr
+        (push(2**256 - 64) + push(3) + "1d", 14),  # sar
+    ]))
+
+
+# ----------------------------------------------------------------- flow
+
+def test_jump_loop():
+    # sum 1..10 via a JUMPI loop, store acc at slot 1
+    # [i, acc]; loop@4: DUP2 ADD SWAP1 (acc+=i, -> [acc', i]);
+    # PUSH1 1 SWAP1 SUB (i-=1); DUP1 PUSH1 4 JUMPI; POP swap-free
+    code = bytes.fromhex(
+        "600a6000"          # i=10 acc=0              [i, acc]
+        "5b"                # loop: (pc=4)
+        "810190"            # dup2 add swap1       -> [acc', i]
+        "60019003"          # 1 swap1 sub          -> [acc', i']
+        "9081"              # swap1 dup2           -> [i', acc', i']
+        "600457"            # jumpi(4, i')         -> [i', acc']
+        "600155"            # sstore(1, acc')
+        "00")
+    both(code)
+
+
+def test_invalid_jump_errors():
+    both(push(9) + "56" + "00")       # jump to non-jumpdest
+
+
+def test_stack_underflow():
+    both("01" + "00")                 # ADD on empty stack
+
+
+def test_invalid_opcode():
+    both("21" + "00")                 # undefined opcode 0x21
+
+
+def test_revert_and_return():
+    both(push(0) + push(0) + "fd")    # revert empty
+    both(push(0) + push(0) + "f3")    # return empty
+
+
+def test_oog_exact_boundary():
+    # 2x PUSH1 (3+3) + SSTORE cold set (22100): total 22106+... probe
+    # the exact edge: both sides must flip OOG at the same gas
+    code_hex = push(5) + push(0) + "55" + "00"
+    h = host_run(bytes.fromhex(code_hex), b"", 500_000)
+    used = 500_000 - h[1]
+    for gas in (used, used - 1, used - 100, 2300 + 6, 2300 + 5):
+        hh = host_run(bytes.fromhex(code_hex), b"", gas)
+        dd = device_run(bytes.fromhex(code_hex), b"", gas)
+        assert dd[0] == hh[0], f"gas={gas}"
+        assert dd[1] == hh[1], f"gas={gas}"
+
+
+# --------------------------------------------------------------- memory
+
+def test_memory_ops():
+    both(sstore_seq([
+        (push(0xDEADBEEF) + push(0) + "52"        # mstore
+         + push(0) + "51", 1),                    # mload
+        (push(0xAB) + push(33) + "53"             # mstore8
+         + push(32) + "51", 2),                   # mload spanning
+        ("59", 3),                                # msize
+        (push(0) + "51", 4),
+    ]))
+
+
+def test_calldatacopy_codecopy():
+    data = bytes(range(64))
+    both(sstore_seq([
+        (push(32) + push(8) + push(0) + "37"      # calldatacopy
+         + push(0) + "51", 1),
+        (push(10) + push(0) + push(64) + "39"     # codecopy
+         + push(64) + "51", 2),
+        (push(4) + "35", 3),                      # calldataload
+        ("36", 4),                                # calldatasize
+        ("38", 5),                                # codesize
+    ]), calldata=data)
+
+
+def test_calldataload_beyond():
+    both(sstore_seq([(push(100) + "35", 1)]), calldata=b"\x01\x02")
+
+
+# ------------------------------------------------------------- context
+
+def test_context_ops():
+    both(sstore_seq([
+        ("33", 1), ("32", 2), ("30", 3), ("34", 4), ("3a", 5),
+        ("41", 6), ("42", 7), ("43", 8), ("44", 9), ("45", 10),
+        ("46", 11), ("48", 12), ("58", 13), ("5a", 14),
+    ]), value=0)
+
+
+# -------------------------------------------------------------- storage
+
+def test_storage_warm_cold_refund():
+    # sload cold + warm; sstore clear (refund on AP3+/durango)
+    both(sstore_seq([
+        (push(7) + "54" + push(7) + "54" + "01", 1),   # cold+warm sload
+        (push(0), 7),                                  # clear slot 7
+    ]), storage={(7).to_bytes(32, "big"): 99})
+
+
+def test_sstore_ladder_variants():
+    # set (0->x), reset (x->y), noop (x->x), clear (x->0)
+    key = (3).to_bytes(32, "big")
+    both(sstore_seq([(push(1), 5)]))                   # set
+    both(sstore_seq([(push(2), 3)]), storage={key: 9})  # reset
+    both(sstore_seq([(push(9), 3)]), storage={key: 9})  # noop-ish
+    both(sstore_seq([(push(0), 3)]), storage={key: 9})  # clear
+
+
+def test_sstore_dirty_resets():
+    # dirty sequences exercise the EIP-3529 refund branches
+    key = (1).to_bytes(32, "big")
+    both(sstore_seq([(push(5), 1), (push(0), 1)]), storage={key: 7})
+    both(sstore_seq([(push(0), 1), (push(7), 1)]), storage={key: 7})
+    both(sstore_seq([(push(5), 1), (push(7), 1)]), storage={key: 7})
+    both(sstore_seq([(push(5), 1), (push(5), 1)]))
+
+
+# ------------------------------------------------------------------ logs
+
+def test_logs():
+    both(bytes.fromhex(
+        push(0xFEED) + push(0) + "52"
+        + push(32) + push(0) + "a0"                        # log0
+        + push(1) + push(32) + push(0) + "a1"              # log1
+        + push(2) + push(1) + push(8) + push(8) + "a2"     # log2
+        + push(3) + push(2) + push(1) + push(0) + push(0) + "a3"
+        + "00"))
+
+
+# ---------------------------------------------------------------- keccak
+
+def test_keccak():
+    both(sstore_seq([
+        (push(0xABCD) + push(0) + "52"
+         + push(32) + push(0) + "20", 1),         # keccak(mem[0:32])
+        (push(0) + push(0) + "20", 2),            # keccak(empty)
+        (push(68) + push(0) + "20", 3),           # cross-word length
+    ]))
+
+
+# ---------------------------------------------------------------- erc20
+
+def test_erc20_transfer_matches_host():
+    to = b"\x22" * 20
+    storage = {balance_slot(SENDER): 10**18}
+    data = transfer_calldata(to, 1234)
+    d = both(TOKEN_RUNTIME, calldata=data, gas=200_000,
+             storage=storage)
+    assert d[0] == M.STOP
+    assert len(d[4]) == 1  # Transfer log
+
+
+def test_erc20_transfer_insufficient_reverts():
+    to = b"\x22" * 20
+    storage = {balance_slot(SENDER): 10}
+    data = transfer_calldata(to, 1234)
+    d = both(TOKEN_RUNTIME, calldata=data, gas=200_000,
+             storage=storage)
+    assert d[0] == M.REVERT
+
+
+def test_erc20_batch_lockstep():
+    """A batch of transfers executes in one machine run with
+    bit-identical per-tx results."""
+    storage = {balance_slot(SENDER): 10**18}
+
+    def resolver(addr, key):
+        return storage.get(key, 0)
+
+    runner = MachineRunner("durango", ENV, resolver)
+    txs = []
+    for i in range(12):
+        to = bytes([0x30 + i]) * 20
+        txs.append(TxSpec(
+            code=TOKEN_RUNTIME, calldata=transfer_calldata(to, 100 + i),
+            gas=200_000, value=0, caller=SENDER, address=CONTRACT,
+            origin=SENDER, gas_price=GAS_PRICE))
+    results = runner.run(txs)
+    h = host_run(TOKEN_RUNTIME, transfer_calldata(b"\x30" * 20, 100),
+                 200_000, storage)
+    for i, r in enumerate(results):
+        assert r.status == M.STOP
+        assert r.gas_left == h[1]  # same variant -> same gas
+        assert len(r.logs) == 1
+
+
+# ------------------------------------------------------- host escapes
+
+def test_host_escape_on_unsupported_op():
+    info = scan_code(bytes.fromhex("31" + "00"), "durango")  # BALANCE
+    assert not info.eligible
+
+
+def test_host_escape_runtime_caps():
+    # memory beyond cap -> HOST status, not an error
+    code = bytes.fromhex(push(1) + push(100_000) + "52" + "00")
+    d = device_run(code, b"", 500_000)
+    assert d[0] == M.HOST

@@ -155,3 +155,23 @@ def test_not_bool():
     chk(u256x.not_(AJ), [a ^ U256 for a in A])
     m = jnp.asarray([True, False, True])
     chk(u256x.bool_word(m), [1, 0, 1])
+
+
+def test_carry_ripple_regression():
+    """u256.normalize must propagate a full-width carry chain: the old
+    fixed-3-parallel-pass version left limbs at 0x10000 for values like
+    2^256-1 + 1 (round-5 review finding, reproduced on addmod)."""
+    cases = [(U256, 1), (U256, U256), ((1 << 240) - 1, 1),
+             (0xFFFF_FFFF_FFFF, 0xFFFF)]
+    aj = u256.from_ints([a for a, _ in cases])
+    bj = u256.from_ints([b for _, b in cases])
+    s = u256.add(aj, bj)
+    # representation invariant: every limb strictly < 2^16
+    import numpy as np
+    assert int(np.asarray(s).max()) <= 0xFFFF
+    chk(s, [(a + b) & U256 for a, b in cases])
+    # the addmod repro from the review
+    nj = u256.from_ints([U256, 7, 13, U256 - 1])
+    chk(u256x.addmod(aj, bj, nj),
+        [(a + b) % n for (a, b), n in zip(cases,
+                                          [U256, 7, 13, U256 - 1])])
