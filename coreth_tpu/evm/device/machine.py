@@ -285,11 +285,9 @@ def build_machine(params: MachineParams):
 
             # ---------------- dynamic gas (non-storage)
             dyn = exp_gas
-            if "copy" in feats or True:
-                # CALLDATACOPY/CODECOPY are always compiled (cheap and
-                # common); MCOPY rides the same word cost when present
-                words_c = (c_v + 31) // 32
-                dyn = dyn + jnp.where(copy3, words_c * P.COPY_GAS, 0)
+            # CALLDATACOPY/CODECOPY are always compiled (cheap, common)
+            words_c = (c_v + 31) // 32
+            dyn = dyn + jnp.where(copy3, words_c * P.COPY_GAS, 0)
             if "keccak" in feats:
                 words_b = (b_v + 31) // 32
                 dyn = dyn + jnp.where(
@@ -309,10 +307,9 @@ def build_machine(params: MachineParams):
             reason = jnp.where(hostop, R_OPCODE, R_NONE)
             reason = jnp.where(over_cap, R_STACK, reason)
             reason = jnp.where(m_host_mem, R_MEM, reason)
-            if "copy" in feats or True:
-                too_copy = copy3 & (c_v > p.copy_cap)
-                m_host = m_host | too_copy
-                reason = jnp.where(too_copy, R_COPY, reason)
+            too_copy = copy3 & (c_v > p.copy_cap)
+            m_host = m_host | too_copy
+            reason = jnp.where(too_copy, R_COPY, reason)
             if "keccak" in feats:
                 too_kec = is_keccak & (b_v > p.keccak_cap - 1)
                 m_host = m_host | too_kec
@@ -594,30 +591,37 @@ def build_machine(params: MachineParams):
                             - P.WARM_STORAGE_READ_COST_EIP2929, 0)
                         rd = jnp.where(is_sstore & mask_any, rd, 0)
                     afford = gas >= cost
-                    do = mask_any & ~sentry & ~full & afford
-                    # writes: appended entries get key/orig/miss
+                    # entry creation (incl. the F_MISS flag) must land
+                    # even when the op then OOGs: a blind SSTORE to an
+                    # unknown slot speculates cur=orig=0 and may be
+                    # MISpriced (e.g. SET 22100 vs true RESET 5000) —
+                    # the adapter reruns the lane with the true value
+                    # only if the miss was recorded (round-5 review)
+                    do_entry = mask_any & ~full
+                    do_write = do_entry & ~sentry & afford
                     wflag = eflag
                     wflag = wflag | F_VALID | F_READ | F_WARM
                     wflag = jnp.where(need_app, wflag | F_MISS, wflag)
-                    wflag = jnp.where(is_sstore, wflag | F_WRITTEN,
-                                      wflag)
-                    nkey = jnp.where((do & need_app)[:, None], key,
-                                     skey[rows, eidx])
-                    nval = jnp.where((do & is_sstore)[:, None], new,
-                                     jnp.where(
-                                         (do & need_app)[:, None], 0,
-                                         sval[rows, eidx]))
-                    nori = jnp.where((do & need_app)[:, None], 0,
+                    wflag = jnp.where(is_sstore & do_write,
+                                      wflag | F_WRITTEN, wflag)
+                    nkey = jnp.where((do_entry & need_app)[:, None],
+                                     key, skey[rows, eidx])
+                    nval = jnp.where(
+                        (do_write & is_sstore)[:, None], new,
+                        jnp.where((do_entry & need_app)[:, None], 0,
+                                  sval[rows, eidx]))
+                    nori = jnp.where((do_entry & need_app)[:, None], 0,
                                      sorig[rows, eidx])
-                    eidx_w = jnp.where(do, eidx, S)  # drop when not do
+                    eidx_w = jnp.where(do_entry, eidx, S)
                     skey2 = skey.at[rows, eidx_w].set(nkey, mode="drop")
                     sval2 = sval.at[rows, eidx_w].set(nval, mode="drop")
                     sorig2 = sorig.at[rows, eidx_w].set(nori,
                                                         mode="drop")
                     sflag2 = sflag.at[rows, eidx_w].set(
-                        jnp.where(do, wflag, 0), mode="drop")
-                    scnt2 = scnt + (do & need_app).astype(jnp.int32)
-                    v = jnp.where((is_sload & do)[:, None],
+                        jnp.where(do_entry, wflag, 0), mode="drop")
+                    scnt2 = scnt + (do_entry & need_app).astype(
+                        jnp.int32)
+                    v = jnp.where((is_sload & do_write)[:, None],
                                   jnp.where(found[:, None], cur, 0),
                                   val)
                     return (v, cost, rd, sentry & mask_any,
@@ -688,7 +692,8 @@ def build_machine(params: MachineParams):
 
             # copies (calldata/code/mcopy)
             copy_mask = ok & copy3
-            if True:
+
+            if True:  # noqa: SIM108 — keep the cond-gated family shape
                 def copy_family():
                     CC = p.copy_cap
                     jj = jnp.arange(CC, dtype=jnp.int32)[None, :]

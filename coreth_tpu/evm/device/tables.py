@@ -130,9 +130,12 @@ def scan_code(code: bytes, fork: str,
     Walks the code exactly like the jumpdest analysis (PUSH data is
     skipped, reference core/vm/analysis.go) so data bytes never
     disqualify code.  Undefined opcodes do NOT disqualify: reaching one
-    is a plain INVALID-style error the machine handles.
+    is a plain INVALID-style error the machine handles.  Memoized by
+    code hash (not the bytecode itself) so the cache stays small across
+    long replays.
     """
-    key = (code, fork)
+    from coreth_tpu.crypto import keccak256
+    key = (keccak256(code), fork)
     cached = _SCAN_CACHE.get(key)
     if cached is not None:
         return cached
@@ -140,8 +143,7 @@ def scan_code(code: bytes, fork: str,
         info = CodeInfo(False, frozenset(), (), "code too large")
         _SCAN_CACHE[key] = info
         return info
-    dev = device_opcodes(fork)
-    table = _TABLE_FOR_FORK[fork]()
+    supported = op_tables(fork).supported  # 0 = undefined per fork
     feats = set()
     i = 0
     n = len(code)
@@ -152,9 +154,9 @@ def scan_code(code: bytes, fork: str,
             i += op - 0x5F + 1
         else:
             i += 1
-        if table[op] is None:
+        if supported[op] == 0:
             continue  # undefined: INVALID at runtime, device handles
-        if op not in dev:
+        if supported[op] == 2:
             info = CodeInfo(False, frozenset(), (),
                             f"host-only opcode 0x{op:02x}")
             break

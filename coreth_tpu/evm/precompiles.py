@@ -367,3 +367,11 @@ def active_precompiles(rules) -> Dict[bytes, Precompile]:
     if rules.is_byzantium:
         return PRECOMPILES_BYZANTIUM
     return PRECOMPILES_HOMESTEAD
+
+
+def special_call_targets(rules) -> set:
+    """Call targets that execute (or reject) despite having no code in
+    state: classic precompiles + module-registered stateful precompiles.
+    The replay classifiers must never treat these as plain transfers
+    (pair with state_transition.is_prohibited for blackhole/reserved)."""
+    return set(active_precompiles(rules)) | set(rules.active_precompiles)

@@ -760,6 +760,11 @@ class ReplayEngine:
         slot arithmetic itself runs batched on device (_slot_step)."""
         base_fee = block.base_fee
         rules = self.config.rules(block.number, block.time)
+        # precompile / prohibited targets have no code in state but DO
+        # execute (or reject) — never classifiable as plain transfers
+        from coreth_tpu.evm.precompiles import special_call_targets
+        from coreth_tpu.processor.state_transition import is_prohibited
+        avoid = special_call_targets(rules)
         token_ctx = self._token_block_ctx(rules, block) \
             if rules.is_apricot_phase1 else None
         senders, recips, values, fees, required, nonces, offsets = \
@@ -779,6 +784,8 @@ class ReplayEngine:
         TX_GAS = P.TX_GAS
         for tx in block.transactions:
             if tx.to is None or tx.access_list:
+                return None
+            if tx.to in avoid or is_prohibited(tx.to):
                 return None
             # always through Signer.sender: the recovery cache is primed
             # without chain-id validation ("prime it only"), and a
@@ -1349,6 +1356,29 @@ class ReplayEngine:
         self.stats.blocks_device += 1
         self.stats.txs += B
 
+    def _machine_executor(self):
+        """Lazy general-bytecode block executor (machine_block.py)."""
+        if not hasattr(self, "_machine"):
+            from coreth_tpu.replay.machine_block import (
+                MachineBlockExecutor)
+            self._machine = MachineBlockExecutor(self)
+        return self._machine
+
+    def _try_machine(self, block: Block) -> bool:
+        """Execute an unclassifiable block on the general device step
+        machine when every tx is device-eligible; False -> host path.
+        CORETH_MACHINE=0 forces the host path (A/B benching)."""
+        if not bool(int(__import__("os")
+                        .environ.get("CORETH_MACHINE", "1"))):
+            return False
+        mx = self._machine_executor()
+        t0 = time.monotonic()
+        plans = mx.classify(block)
+        self.stats.t_classify += time.monotonic() - t0
+        if plans is None:
+            return False
+        return mx.execute(block, plans) is not None
+
     def replay_block(self, block: Block) -> bytes:
         """Process one block synchronously (tests; replay() windows)."""
         self.warm_senders(block)
@@ -1356,6 +1386,8 @@ class ReplayEngine:
         batch = self._classify(block)
         self.stats.t_classify += time.monotonic() - t0
         if batch is None:
+            if self._try_machine(block):
+                return self.root
             return self._fallback(block)
         win = self._issue_window([(block, batch)])
         resume = self._complete_window(win, [block], 0)
@@ -1421,8 +1453,10 @@ class ReplayEngine:
                 continue
             if hit_fallback:
                 # pending retired, nothing speculative in flight: run
-                # the exact host path for the unreplayable block
-                self._fallback(blocks[i])
+                # the general step machine if the block is eligible,
+                # else the exact host path
+                if not self._try_machine(blocks[i]):
+                    self._fallback(blocks[i])
                 i += 1
         return self.root
 

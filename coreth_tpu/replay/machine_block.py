@@ -1,0 +1,351 @@
+"""Machine-block execution: general contract blocks on device with an
+optimistic execute-validate-retry scheduler.
+
+The ReplayEngine's transfer/token fast path covers two tx shapes; this
+module covers the general case (SURVEY.md §7.6): every tx whose callee
+bytecode is device-eligible executes on the batched step machine
+(evm/device/machine.py) against block-start state, and cross-tx
+ordering is repaired optimistically, Block-STM style:
+
+1. round 0 executes the whole block in one device batch;
+2. a sequential host sweep validates each tx's observed read set
+   against the in-block state produced by the valid prefix; txs whose
+   reads diverge are re-executed (only them — a conflict no longer
+   drops the whole block to the host path) with the best-known
+   pre-state snapshot;
+3. the first mismatched tx always receives its exact pre-state, so
+   every round validates at least one more tx — worst case (a fully
+   serial conflict chain, the reference's ring workload,
+   core/bench_test.go:64) degrades to one device round per tx, and
+   independent txs in the same block still batch.
+
+Account-level effects (nonces, buyGas solvency, value moves, fees) are
+applied by a host sweep over python ints — exact, and O(txs), not
+O(gas).  Reference semantics: core/state_processor.go:95 (the
+sequential loop this replaces), core/state_transition.go TransitionDb.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from coreth_tpu.evm.device import machine as M
+from coreth_tpu.evm.device import tables as DT
+from coreth_tpu.evm.device.adapter import (
+    BlockEnv, MachineRunner, TxSpec,
+)
+from coreth_tpu.params import protocol as P
+from coreth_tpu.processor.state_transition import (
+    intrinsic_gas, is_prohibited,
+)
+from coreth_tpu.types import (
+    Block, Log, Receipt, StateAccount, create_bloom, derive_sha,
+)
+from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
+from coreth_tpu import rlp
+
+
+@dataclass
+class TxPlan:
+    kind: str                  # "xfer" | "call"
+    sender: bytes
+    to: bytes
+    nonce: int
+    value: int
+    gas_limit: int
+    intrinsic: int
+    price: int                 # effective gas price
+    fee_cap: int
+    data: bytes = b""
+    code: bytes = b""
+
+
+class MachineBlockExecutor:
+    """Owns classification + execution of machine blocks for one
+    ReplayEngine (shares its tries and DeviceState mirrors)."""
+
+    def __init__(self, engine):
+        self.e = engine
+        self.rounds = 0            # OCC re-execution rounds (stats)
+        self.blocks = 0
+
+    # ------------------------------------------------------------ classify
+    def classify(self, block: Block) -> Optional[List[TxPlan]]:
+        """TxPlans if every tx is a pure transfer or a device-eligible
+        contract call, else None."""
+        e = self.e
+        rules = e.config.rules(block.number, block.time)
+        fork = DT.fork_key(rules)
+        if fork is None:
+            return None
+        base_fee = block.base_fee
+        from coreth_tpu.evm.precompiles import special_call_targets
+        avoid = special_call_targets(rules)
+        plans: List[TxPlan] = []
+        for tx in block.transactions:
+            if tx.to is None or tx.access_list:
+                return None
+            if tx.to in avoid or is_prohibited(tx.to):
+                return None
+            try:
+                sender = e.signer.sender(tx)
+            except ValueError:
+                return None
+            s_idx = e._account(sender)
+            if e.state.has_code[s_idx] or e.state.multicoin[s_idx]:
+                return None
+            gas_fee_cap = tx.gas_fee_cap
+            if base_fee is not None:
+                tip = tx.gas_tip_cap
+                if gas_fee_cap < base_fee or gas_fee_cap < tip:
+                    return None
+                price = min(base_fee + tip, gas_fee_cap)
+            else:
+                price = tx.gas_price
+            r_idx = e._account(tx.to)
+            has_code = e.state.has_code[r_idx]
+            if e.state.multicoin[r_idx]:
+                return None
+            intrinsic = intrinsic_gas(tx.data, [], False, rules)
+            if tx.gas < intrinsic:
+                return None
+            if not has_code:
+                if tx.data:
+                    # data to an EOA burns intrinsic only — still a
+                    # "transfer" shape for the account sweep
+                    pass
+                plans.append(TxPlan(
+                    kind="xfer", sender=sender, to=tx.to,
+                    nonce=tx.nonce, value=tx.value, gas_limit=tx.gas,
+                    intrinsic=intrinsic, price=price,
+                    fee_cap=gas_fee_cap))
+                continue
+            code = e.db.contract_code(e.state.code_hashes[r_idx])
+            info = DT.scan_code(code, fork)
+            if not info.eligible:
+                return None
+            if len(tx.data) > 4096:
+                return None
+            plans.append(TxPlan(
+                kind="call", sender=sender, to=tx.to, nonce=tx.nonce,
+                value=tx.value, gas_limit=tx.gas, intrinsic=intrinsic,
+                price=price, fee_cap=gas_fee_cap, data=tx.data,
+                code=code))
+        self._fork = fork
+        return plans
+
+    # ------------------------------------------------------------- storage
+    def _base_value(self, contract: bytes, key: bytes) -> int:
+        st = self.e._storage_trie(contract)
+        raw = st.get(key)
+        return int.from_bytes(rlp.decode(raw), "big") if raw else 0
+
+    # ------------------------------------------------------------- execute
+    def execute(self, block: Block,
+                plans: List[TxPlan]) -> Optional[bytes]:
+        """Run the block; returns the post-state root, or None when a
+        lane escapes to the host (caller falls back).  Raises
+        ReplayError on consensus validation failure, like the transfer
+        path."""
+        from coreth_tpu.replay.engine import ReplayError
+        e = self.e
+        t0 = time.monotonic()
+        env = BlockEnv(
+            coinbase=block.header.coinbase, timestamp=block.time,
+            number=block.number, gas_limit=block.header.gas_limit,
+            chain_id=e.config.chain_id, base_fee=block.base_fee or 0)
+        call_idx = [i for i, pl in enumerate(plans)
+                    if pl.kind == "call"]
+        results: Dict[int, object] = {}
+        base_cache: Dict[Tuple[bytes, bytes], int] = {}
+
+        def base(contract, key):
+            v = base_cache.get((contract, key))
+            if v is None:
+                v = self._base_value(contract, key)
+                base_cache[(contract, key)] = v
+            return v
+
+        # OCC loop: execute pending lanes, then sequentially validate
+        pending: List[Tuple[int, Dict]] = [(i, {}) for i in call_idx]
+        max_rounds = len(call_idx) + 2
+        for _ in range(max_rounds):
+            if pending:
+                specs = []
+                for i, overlay in pending:
+                    pl = plans[i]
+                    storage = {}
+                    for (c, k), v in overlay.items():
+                        if c == pl.to:
+                            storage[k] = (v, v)
+                    specs.append(TxSpec(
+                        code=pl.code, calldata=pl.data,
+                        gas=pl.gas_limit - pl.intrinsic,
+                        value=pl.value, caller=pl.sender,
+                        address=pl.to, origin=pl.sender,
+                        gas_price=pl.price, storage=storage))
+
+                def resolver(addr, key):
+                    # per-batch resolver: misses fall to block-start
+                    # state (overlay entries were preloaded in specs)
+                    return base(addr, key)
+
+                runner = MachineRunner(self._fork, env, resolver)
+                batch = runner.run(specs)
+                for (i, _), res in zip(pending, batch):
+                    results[i] = res
+            # sequential validation sweep
+            state: Dict[Tuple[bytes, bytes], int] = {}
+            pending = []
+            for i in call_idx:
+                pl = plans[i]
+                res = results.get(i)
+                if res is None:
+                    pending.append((i, dict(state)))
+                    continue
+                if res.needs_host:
+                    e.stats.t_device += time.monotonic() - t0
+                    return None
+                ok = True
+                for key, observed in res.reads.items():
+                    cur = state.get((pl.to, key))
+                    if cur is None:
+                        cur = base(pl.to, key)
+                    if cur != observed:
+                        ok = False
+                        break
+                if not ok:
+                    pending.append((i, dict(state)))
+                    continue
+                if res.status == M.STOP:
+                    for key, v in res.writes.items():
+                        state[(pl.to, key)] = v
+            if not pending:
+                break
+            self.rounds += 1
+        else:
+            e.stats.t_device += time.monotonic() - t0
+            return None  # conflict storm: host path takes the block
+        e.stats.t_device += time.monotonic() - t0
+
+        # ---------------- account sweep + receipts (host, O(txs))
+        t1 = time.monotonic()
+        accounts: Dict[bytes, List[int]] = {}  # addr -> [bal, nonce]
+
+        def acct(addr: bytes) -> List[int]:
+            st = accounts.get(addr)
+            if st is None:
+                raw = e.trie.get(addr)
+                if raw is not None:
+                    a = StateAccount.from_rlp(raw)
+                    st = [a.balance, a.nonce]
+                else:
+                    st = [0, 0]
+                accounts[addr] = st
+            return st
+
+        receipts: List[Receipt] = []
+        cum = 0
+        writes_final: Dict[Tuple[bytes, bytes], int] = {}
+        for i, pl in enumerate(plans):
+            s = acct(pl.sender)
+            if pl.nonce != s[1]:
+                raise ReplayError(
+                    f"machine block: nonce mismatch tx {i}")
+            if s[0] < pl.gas_limit * pl.fee_cap + pl.value:
+                raise ReplayError(
+                    f"machine block: insufficient funds tx {i}")
+            if pl.kind == "xfer":
+                used = pl.intrinsic
+                status = 1
+                logs: List[Log] = []
+                value_moves = True
+            else:
+                res = results[i]
+                used = pl.gas_limit - pl.intrinsic - res.gas_left \
+                    + pl.intrinsic
+                status = 1 if res.status == M.STOP else 0
+                value_moves = res.status == M.STOP
+                logs = []
+                if status == 1:
+                    for topics, data in res.logs:
+                        logs.append(Log(address=pl.to, topics=topics,
+                                        data=data))
+                    for key, v in res.writes.items():
+                        writes_final[(pl.to, key)] = v
+            s[1] += 1
+            s[0] -= used * pl.price
+            if value_moves:
+                s[0] -= pl.value
+                acct(pl.to)[0] += pl.value
+            acct(block.header.coinbase)[0] += used * pl.price
+            cum += used
+            receipts.append(Receipt(
+                tx_type=block.transactions[i].tx_type, status=status,
+                cumulative_gas_used=cum, gas_used=used, logs=logs))
+        if cum != block.header.gas_used:
+            raise ReplayError("machine block: gas used mismatch")
+        if derive_sha(receipts) != block.header.receipt_hash:
+            raise ReplayError("machine block: receipt root mismatch")
+        if create_bloom(receipts) != block.header.bloom:
+            raise ReplayError("machine block: bloom mismatch")
+        if e.config.is_apricot_phase4(block.time):
+            e.engine.verify_block_fee(
+                block.base_fee, block.header.block_gas_cost,
+                block.transactions, receipts, None)
+
+        # ---------------- fold storage + accounts into the tries
+        contracts: Dict[bytes, object] = {}
+        for (contract, key), v in writes_final.items():
+            st = e._storage_trie(contract)
+            if v == 0:
+                st.delete(key)
+            else:
+                st.update(key, rlp.encode(
+                    v.to_bytes(32, "big").lstrip(b"\x00")))
+            contracts[contract] = st
+        for contract, st in contracts.items():
+            idx = e.state.index[contract]
+            e.state.roots[idx] = e._rehash(st)
+        for addr, (bal, nonce) in accounts.items():
+            idx = e._account(addr)
+            code_hash = e.state.code_hashes[idx]
+            root = e.state.roots[idx]
+            if (bal == 0 and nonce == 0
+                    and code_hash == EMPTY_CODE_HASH
+                    and root == EMPTY_ROOT_HASH
+                    and not e.state.multicoin[idx]):
+                e.trie.delete(addr)
+            else:
+                e.trie.update(addr, StateAccount(
+                    nonce=nonce, balance=bal, root=root,
+                    code_hash=code_hash,
+                    is_multi_coin=e.state.multicoin[idx]).rlp())
+        root = e._rehash(e.trie)
+        e.stats.t_trie += time.monotonic() - t1
+        if root != block.header.root:
+            raise ReplayError(
+                f"machine block: state root mismatch at block "
+                f"{block.number}: {root.hex()} != "
+                f"{block.header.root.hex()}")
+
+        # ---------------- refresh the device-state mirrors
+        e._slot_overlay.clear()
+        e.state.flush_staged()
+        for addr, (bal, nonce) in accounts.items():
+            idx = e.state.index[addr]
+            e.state._staged.append((idx, bal, nonce))
+        for (contract, key), v in writes_final.items():
+            s_idx = e.state.slot_index.get((contract, key))
+            if s_idx is not None and e.state.slot_host[s_idx] != v:
+                e.state.slot_host[s_idx] = v
+                e.state._staged_slots.append((s_idx, v))
+        e.state.flush_staged()
+        e.root = root
+        e.parent_header = block.header
+        self.blocks += 1
+        e.stats.blocks_device += 1
+        e.stats.txs += len(block.transactions)
+        return root

@@ -34,9 +34,11 @@ _OPS = {
 }
 
 
-def _assemble(program: List) -> bytes:
+def _assemble(program: List, ops: Dict[str, int] = None) -> bytes:
     """Two-pass assembler: items are opcode names, ("PUSH", bytes),
-    ("PUSHL", label) 2-byte label pushes, or ("LABEL", name)."""
+    ("PUSHL", label) 2-byte label pushes, or ("LABEL", name).
+    `ops` overrides the opcode table (workloads/swap.py extends it)."""
+    _ops = ops or _OPS
     # pass 1: layout
     offsets: Dict[str, int] = {}
     pc = 0
@@ -56,7 +58,7 @@ def _assemble(program: List) -> bytes:
     out = bytearray()
     for item in program:
         if isinstance(item, str):
-            out.append(_OPS[item])
+            out.append(_ops[item])
         elif item[0] == "LABEL":
             out.append(_OPS["JUMPDEST"])
         elif item[0] == "PUSH":

@@ -297,6 +297,21 @@ def test_sstore_dirty_resets():
     both(sstore_seq([(push(5), 1), (push(5), 1)]))
 
 
+def test_blind_sstore_oog_on_speculated_miss_reruns():
+    """A blind SSTORE (no prior SLOAD) to a nonzero slot initially
+    speculates cur=orig=0 and prices as SET (22100); with gas between
+    the true RESET cost (5000) and the speculated one, the lane OOGs on
+    the miss — the F_MISS entry must still be recorded so the rerun
+    reprices with the true value and succeeds (round-5 review fix)."""
+    key = (3).to_bytes(32, "big")
+    code = push(9) + push(3) + "55" + "00"   # sstore(3, 9)
+    for gas in (10_000, 5_006, 5_005, 23_000):
+        h = host_run(bytes.fromhex(code), b"", gas, {key: 7})
+        d = device_run(bytes.fromhex(code), b"", gas, {key: 7})
+        assert d[0] == h[0], f"gas={gas}: device {d[0]} host {h[0]}"
+        assert d[1] == h[1], f"gas={gas}: device {d[1]} host {h[1]}"
+
+
 # ------------------------------------------------------------------ logs
 
 def test_logs():

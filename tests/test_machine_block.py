@@ -1,0 +1,203 @@
+"""Machine-block replay: general contract blocks on the device step
+machine through the ReplayEngine, with the optimistic
+execute-validate-retry scheduler (BASELINE config[3] contention).
+
+Ground truth is chain_makers (the host Processor): the engine must
+reproduce every generated root bit-identically, without the host
+fallback path."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.chain.chain_makers import generate_chain
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.replay import ReplayEngine
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+from coreth_tpu.workloads.erc20 import (
+    TOKEN_RUNTIME, token_genesis_account, transfer_calldata,
+)
+from coreth_tpu.workloads.swap import (
+    POOL_RUNTIME, pool_genesis_account, swap_calldata,
+)
+
+GWEI = 10**9
+KEYS = [0x2000 + i for i in range(8)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+POOL = b"\x70" * 20
+TOKEN = b"\x71" * 20
+
+
+def build_chain(n_blocks, gen_txs, extra_alloc=None):
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[POOL] = pool_genesis_account(10**15, 10**15)
+    alloc[TOKEN] = token_genesis_account(
+        {a: 10**21 for a in ADDRS})
+    if extra_alloc:
+        alloc.update(extra_alloc)
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    def gen(i, bg):
+        for tx in gen_txs(i, nonces):
+            bg.add_tx(tx)
+
+    blocks, receipts = generate_chain(CFG, gblock, db, n_blocks, gen,
+                                      gap=2)
+    return gblock, blocks, receipts
+
+
+def tx(k, nonces, to, data=b"", gas=200_000, value=0):
+    t = sign_tx(DynamicFeeTx(
+        chain_id_=CFG.chain_id, nonce=nonces[k], gas_tip_cap_=GWEI,
+        gas_fee_cap_=300 * GWEI, gas=gas, to=to, value=value,
+        data=data), KEYS[k], CFG.chain_id)
+    nonces[k] += 1
+    return t
+
+
+def fresh_engine(gblock, alloc):
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    g = genesis.to_block(db)
+    assert g.root == gblock.root
+    return ReplayEngine(CFG, db, g.root, parent_header=g.header,
+                        window=4)
+
+
+def run_machine_chain(n_blocks, gen_txs, expect_fallbacks=0):
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[POOL] = pool_genesis_account(10**15, 10**15)
+    alloc[TOKEN] = token_genesis_account({a: 10**21 for a in ADDRS})
+    gblock, blocks, receipts = build_chain(n_blocks, gen_txs)
+    eng = fresh_engine(gblock, alloc)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == expect_fallbacks
+    return eng
+
+
+def test_swap_contention_block():
+    """A block of swaps is a fully serial conflict chain: the OCC
+    scheduler must converge by re-executing only conflicting txs and
+    land on the exact host root."""
+    def gen(i, nonces):
+        return [tx(k, nonces, POOL, swap_calldata(1000 + 7 * i + k))
+                for k in range(6)]
+
+    eng = run_machine_chain(3, gen)
+    mx = eng._machine
+    assert mx.blocks == 3
+    assert mx.rounds > 0  # conflicts actually exercised the retry path
+
+
+def test_disjoint_machine_txs_single_round():
+    """balanceOf() calls are NOT token-fast-path-classifiable (only
+    transfer() is), so they ride the machine path; disjoint reads have
+    no conflicts: one OCC round suffices."""
+    from coreth_tpu.workloads.erc20 import BALANCEOF_SELECTOR
+
+    def gen(i, nonces):
+        return [tx(k, nonces, TOKEN,
+                   BALANCEOF_SELECTOR + b"\x00" * 12 + ADDRS[k])
+                for k in range(6)]
+
+    eng = run_machine_chain(2, gen)
+    assert eng._machine.blocks == 2
+    assert eng._machine.rounds == 0
+
+
+def test_mixed_block_swaps_tokens_and_transfers():
+    """Swaps + token calls + plain value transfers in ONE block all
+    ride the machine path (txs to EOAs become host-swept transfers)."""
+    def gen(i, nonces):
+        txs = [tx(0, nonces, POOL, swap_calldata(500)),
+               tx(1, nonces, TOKEN,
+                  transfer_calldata(b"\x42" * 20, 77)),
+               tx(2, nonces, bytes([0x43]) * 20, gas=21_000,
+                  value=12345),
+               tx(3, nonces, POOL, swap_calldata(900))]
+        return txs
+
+    eng = run_machine_chain(2, gen)
+    assert eng._machine.blocks == 2
+
+
+def test_machine_block_with_reverts():
+    """A token transfer exceeding the balance reverts; receipts carry
+    status 0 and the root still matches."""
+    def gen(i, nonces):
+        return [
+            tx(0, nonces, TOKEN, transfer_calldata(b"\x50" * 20, 10)),
+            tx(1, nonces, TOKEN,
+               transfer_calldata(b"\x51" * 20, 10**30)),  # reverts
+        ]
+
+    run_machine_chain(2, gen)
+
+
+def test_ineligible_block_falls_back():
+    """A tx calling host-only bytecode (BALANCE) drops the block to
+    the host path — and the result is still exact."""
+    balcode = bytes.fromhex("47600055" + "00")  # SELFBALANCE; sstore
+    extra = {b"\x72" * 20: GenesisAccount(balance=5, nonce=1,
+                                          code=balcode)}
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[POOL] = pool_genesis_account(10**15, 10**15)
+    alloc[TOKEN] = token_genesis_account({a: 10**21 for a in ADDRS})
+    alloc.update(extra)
+
+    def gen(i, nonces):
+        return [tx(0, nonces, b"\x72" * 20),
+                tx(1, nonces, POOL, swap_calldata(100))]
+
+    gblock, blocks, _ = build_chain(1, gen, extra_alloc=extra)
+    eng = fresh_engine(gblock, alloc)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 1
+
+
+def test_precompile_target_not_misclassified():
+    """A tx whose `to` is a classic precompile (0x..01 ecrecover) has
+    no code in state but still executes — it must never classify as a
+    plain transfer on either fast path (round-5 fix)."""
+    ec = b"\x00" * 19 + b"\x01"
+
+    def gen(i, nonces):
+        return [tx(0, nonces, ec, gas=50_000),
+                tx(1, nonces, bytes([0x55]) * 20, gas=21_000,
+                   value=5)]
+
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[POOL] = pool_genesis_account(10**15, 10**15)
+    alloc[TOKEN] = token_genesis_account({a: 10**21 for a in ADDRS})
+    gblock, blocks, receipts = build_chain(1, gen)
+    # the host-generated receipt must show the precompile consumed gas
+    assert receipts[0][0].gas_used > 21_000
+    eng = fresh_engine(gblock, alloc)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 1
+
+
+def test_machine_then_transfer_interleave():
+    """Machine blocks interleave with fast-path transfer blocks; the
+    device mirrors stay coherent across the hand-off."""
+    def gen(i, nonces):
+        if i % 2 == 0:
+            return [tx(k, nonces, POOL, swap_calldata(100 + k))
+                    for k in range(4)]
+        return [tx(k, nonces, bytes([0x60 + k]) * 20, gas=21_000,
+                   value=999) for k in range(4)]
+
+    eng = run_machine_chain(4, gen)
+    assert eng.stats.blocks_device == 4

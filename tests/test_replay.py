@@ -338,9 +338,10 @@ def test_replay_mixed_native_and_token_block():
 
 
 def test_replay_token_insufficient_falls_back_then_resumes():
-    """A would-revert transfer routes its block through the host path
-    (receipt status 0 there), and later token blocks return to the
-    device with refreshed slot values."""
+    """A would-revert transfer is not token-fast-path classifiable;
+    since round 5 it rides the GENERAL step machine (receipt status 0
+    computed on device) instead of the host fallback, and later token
+    blocks return to the fast path with refreshed slot values."""
     from coreth_tpu.workloads.erc20 import transfer_calldata
 
     def gen(i, bg):
@@ -369,8 +370,9 @@ def test_replay_token_insufficient_falls_back_then_resumes():
                           capacity=256, batch_pad=64)
     root = engine.replay(blocks)
     assert root == blocks[-1].root
-    assert engine.stats.blocks_fallback == 1   # the overdraw block
-    assert engine.stats.blocks_device == 2
+    assert engine.stats.blocks_fallback == 0
+    assert engine.stats.blocks_device == 3
+    assert engine._machine.blocks == 1        # the overdraw block
 
 
 def test_native_receipt_root_parity():
