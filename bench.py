@@ -1,22 +1,28 @@
 #!/usr/bin/env python
-"""Benchmark: batched TPU replay vs the sequential host processor.
+"""Benchmark: batched TPU replay vs the sequential compiled baselines.
 
-Two workloads:
+Workloads:
 - transfer (BASELINE config[2] shape): value-transfer chain, the
   reference's core/bench_test.go:45 InsertChain shape, replayed from
   wire bytes with full sender recovery + per-block root validation.
 - erc20 (BASELINE config[1] shape): transfer() call spam on the
-  workloads/erc20 token — the M2 minimum end-to-end slice: batched
-  storage-slot read/modify/write + Transfer logs/bloom + storage-trie
-  rehash folded into the account trie, bit-identical roots.
+  workloads/erc20 token — batched storage-slot read/modify/write +
+  Transfer logs/bloom + storage-trie rehash, bit-identical roots.
+  Measured twice: through the token fast path, and (erc20_machine)
+  forced through the GENERAL device step machine.
+- swap (BASELINE config[3] shape): shared-slot constant-product pool —
+  every tx conflicts through reserve slots 0/1 (the Uniswap-V2/ring
+  contention analog, reference core/bench_test.go:64); exercises the
+  optimistic scheduler's device rounds + host conflict-suffix.
 
-- baseline: the sequential host path (BlockChain.insert_chain — the
-  semantic twin of the Go StateProcessor loop; BASELINE.md records why
-  the Go reference itself cannot run here).
-- measured: coreth_tpu.replay.ReplayEngine.
+Baselines:
+- py host: BlockChain.insert_chain (the Python twin of the Go
+  StateProcessor loop).
+- native: compiled C++ replays — baseline.cc for transfers, evm.cc
+  (a real C++ EVM interpreter) for the contract workloads — so every
+  vs_baseline ratio has a compiled denominator (BASELINE.md round 5).
 
-Prints ONE json line; the primary metric is the transfer workload,
-with the erc20 numbers carried as extra fields.
+Prints ONE json line; the primary metric is the transfer workload.
 """
 
 import json
@@ -56,11 +62,19 @@ BASELINE_BLOCKS = int(os.environ.get("BENCH_BASELINE_BLOCKS", "64"))
 ERC20_TXS = int(os.environ.get("BENCH_ERC20_TXS", "256"))
 ERC20_BASELINE_BLOCKS = int(
     os.environ.get("BENCH_ERC20_BASELINE_BLOCKS", "32"))
+# contention + general-machine entries are dispatch-latency-bound on
+# the tunneled single chip; smaller shapes keep the driver run sane
+SWAP_BLOCKS = int(os.environ.get("BENCH_SWAP_BLOCKS", "64"))
+SWAP_TXS = int(os.environ.get("BENCH_SWAP_TXS", "32"))
+MACHINE_BLOCKS = int(os.environ.get("BENCH_MACHINE_BLOCKS", "64"))
+MIXED_BLOCKS = int(os.environ.get("BENCH_MIXED_BLOCKS", "128"))
+MIXED_TXS = int(os.environ.get("BENCH_MIXED_TXS", "32"))
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 GWEI = 10**9
 N_KEYS = int(os.environ.get("BENCH_KEYS", "1024"))
 TOKEN = bytes([0x77]) * 20
+POOL = bytes([0x78]) * 20
 
 # Single-run ratios on this contended 1-core host proved unfalsifiable
 # (round-3 recorded 0.29x while reruns gave 1.30x and 2.61x) — every
@@ -80,13 +94,22 @@ def _spread(xs):
 
 
 def _txs_per_block(workload):
-    return ERC20_TXS if workload == "erc20" else TXS_PER_BLOCK
+    if workload == "erc20":
+        return ERC20_TXS
+    if workload == "swap":
+        return SWAP_TXS
+    return TXS_PER_BLOCK
+
+
+def _n_blocks(workload):
+    return SWAP_BLOCKS if workload == "swap" else N_BLOCKS
 
 
 def _cache_path(workload):
     return os.path.join(
         _DIR, ".bench_cache",
-        f"{workload}_{N_BLOCKS}x{_txs_per_block(workload)}k{N_KEYS}.bin")
+        f"{workload}_{_n_blocks(workload)}x{_txs_per_block(workload)}"
+        f"k{N_KEYS}.bin")
 
 
 def _genesis(workload):
@@ -99,6 +122,9 @@ def _genesis(workload):
     if workload == "erc20":
         from coreth_tpu.workloads.erc20 import token_genesis_account
         alloc[TOKEN] = token_genesis_account({a: 10**24 for a in addrs})
+    elif workload == "swap":
+        from coreth_tpu.workloads.swap import pool_genesis_account
+        alloc[POOL] = pool_genesis_account(10**24, 10**24)
     genesis = Genesis(config=TEST_CHAIN_CONFIG, gas_limit=8_000_000,
                       alloc=alloc)
     return genesis, keys, addrs
@@ -158,10 +184,24 @@ def build_or_load_chain(workload):
             ), keys[k], CFG.chain_id))
             nonces[k] += 1
 
-    gen = gen_erc20 if workload == "erc20" else gen_transfer
+    def gen_swap(i, bg):
+        from coreth_tpu.workloads.swap import swap_calldata
+        for j in range(SWAP_TXS):
+            k = (i * SWAP_TXS + j) % N_KEYS
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=GWEI, gas_fee_cap_=2000 * GWEI, gas=100_000,
+                to=POOL, value=0,
+                data=swap_calldata(10**6 + i * 131 + j),
+            ), keys[k], CFG.chain_id))
+            nonces[k] += 1
+
+    gen = {"erc20": gen_erc20, "swap": gen_swap}.get(
+        workload, gen_transfer)
     # gap=10s: one block per fee window keeps the chain under the AP5
     # gas target so the base fee stays bounded over any chain length
-    blocks, _ = generate_chain(CFG, gblock, db, N_BLOCKS, gen, gap=10)
+    blocks, _ = generate_chain(CFG, gblock, db, _n_blocks(workload),
+                               gen, gap=10)
     os.makedirs(os.path.dirname(cache), exist_ok=True)
     with open(cache, "wb") as f:
         f.write(rlp.encode([b.encode() for b in blocks]))
@@ -199,19 +239,38 @@ def run_native_baseline(genesis, wire_blocks):
         + acct.nonce.to_bytes(8, "big")
         for addr, acct in genesis.alloc.items())
     txs = sum(len(b.transactions) for b in blocks)
+    return _native_reps(
+        native.baseline_replay,
+        (bytes(recs), offs, bytes(roots), bytes(cbs), accounts,
+         len(genesis.alloc)), txs, "transfer")
+
+
+def _native_reps(native_fn, args, txs, label):
+    """REPS timed runs of a compiled baseline entry point; rc != 0 is
+    a root/validation failure."""
     tps_runs, phases = [], None
     for _ in range(REPS):
         t0 = time.monotonic()
-        rc, phases = native.baseline_replay(
-            bytes(recs), offs, bytes(roots), bytes(cbs), accounts,
-            len(genesis.alloc))
+        rc, phases = native_fn(*args)
         dt = time.monotonic() - t0
         if rc != 0:
-            raise RuntimeError(f"native baseline failed rc={rc}")
+            raise RuntimeError(f"native {label} baseline failed rc={rc}")
         tps_runs.append(txs / dt)
     return tps_runs, {"t_sender": round(phases[0], 3),
                       "t_exec": round(phases[1], 3),
                       "t_trie": round(phases[2], 3)}
+
+
+def run_native_evm(genesis, wire_blocks):
+    """Compiled single-threaded C++ EVM replay (native/evm.cc) — the
+    contract-workload denominator; validates bit-identical roots."""
+    from coreth_tpu.crypto import native
+    from coreth_tpu.types import Block
+    from coreth_tpu.workloads.pack_native import pack_evm_replay
+    blocks = [Block.decode(w) for w in wire_blocks]
+    txs = sum(len(b.transactions) for b in blocks)
+    return _native_reps(native.evm_replay,
+                        pack_evm_replay(genesis, blocks), txs, "evm")
 
 
 def run_baseline(genesis, wire_blocks, n_blocks):
@@ -248,7 +307,7 @@ def _fresh_engine(genesis, txs_per_block):
                         window=int(os.environ.get("BENCH_WINDOW", "128")))
 
 
-def run_tpu(genesis, wire_blocks, txs_per_block):
+def run_tpu(genesis, wire_blocks, txs_per_block, machine_stats=None):
     from coreth_tpu.types import Block
 
     # Warm-up pass on throwaway blocks/engine: compiles (or cache-loads)
@@ -278,21 +337,39 @@ def run_tpu(genesis, wire_blocks, txs_per_block):
         assert engine.stats.blocks_fallback == 0, engine.stats.row()
         tps_runs.append(txs / dt)
         stats = engine.stats.row()
+        if machine_stats is not None and hasattr(engine, "_machine"):
+            machine_stats.update(
+                occ_rounds=engine._machine.rounds,
+                host_txs=engine._machine.host_txs,
+                machine_blocks=engine._machine.blocks)
     return tps_runs, stats
 
 
-def run_workload(workload, baseline_blocks):
+def run_workload(workload, baseline_blocks, tpu_blocks=None,
+                 machine_stats=None, skip_baselines=False):
     genesis, blocks = build_or_load_chain(workload)
     wire = [b.encode() for b in blocks]
-    base_runs, base_timers = run_baseline(genesis, wire, baseline_blocks)
-    native_runs = None
+    base_runs = base_timers = None
+    native_runs = native_phases = None
     from coreth_tpu.crypto import native as _native
-    if workload == "transfer" and _native.load() is not None:
-        native_runs, native_phases = run_native_baseline(genesis, wire)
-    tpu_runs, tpu_stats = run_tpu(genesis, wire, _txs_per_block(workload))
+    if not skip_baselines:
+        base_runs, base_timers = run_baseline(genesis, wire,
+                                              baseline_blocks)
+        if _native.load() is not None:
+            if workload == "transfer":
+                native_runs, native_phases = run_native_baseline(
+                    genesis, wire)
+            else:
+                native_runs, native_phases = run_native_evm(genesis, wire)
+    tpu_wire = wire[:tpu_blocks] if tpu_blocks else wire
+    tpu_runs, tpu_stats = run_tpu(genesis, tpu_wire,
+                                  _txs_per_block(workload),
+                                  machine_stats=machine_stats)
     if os.environ.get("BENCH_VERBOSE"):
-        print(f"[{workload}] py-host baseline", [round(x) for x in base_runs],
-              "txs/s", base_timers, file=sys.stderr)
+        if base_runs:
+            print(f"[{workload}] py-host baseline",
+                  [round(x) for x in base_runs], "txs/s", base_timers,
+                  file=sys.stderr)
         if native_runs:
             print(f"[{workload}] native baseline",
                   [round(x) for x in native_runs], "txs/s", native_phases,
@@ -302,12 +379,70 @@ def run_workload(workload, baseline_blocks):
     return base_runs, tpu_runs, native_runs
 
 
+def run_mixed():
+    """BASELINE config[4]: Avalanche-semantics segment (atomic ExtData
+    imports + nativeAssetCall + transfer spam) under the AP5 rule set.
+    Atomic/multicoin blocks ride the exact host path via the engine
+    callbacks; the fallback fraction is part of the result."""
+    from coreth_tpu.params import TEST_APRICOT_PHASE5_CONFIG
+    from coreth_tpu.workloads import mixed as MX
+    from coreth_tpu.types import Block
+    keys = [0xB0B + i for i in range(64)]
+    genesis, blocks = MX.build_mixed_chain(
+        TEST_APRICOT_PHASE5_CONFIG, MIXED_BLOCKS, MIXED_TXS, keys)
+    # reps decode fresh Block objects from wire so every run pays full
+    # sender recovery — same methodology as the other workloads
+    wire = [b.encode() for b in blocks]
+    want_root = blocks[-1].root
+    txs = sum(len(b.transactions) for b in blocks)
+    del blocks
+    py_runs = []
+    for _ in range(REPS):
+        fresh = [Block.decode(w) for w in wire]
+        chain = MX.host_chain(genesis, MIXED_BLOCKS, keys[0])
+        t0 = time.monotonic()
+        chain.insert_chain(fresh)
+        py_runs.append(txs / (time.monotonic() - t0))
+    tpu_runs, stats = [], None
+    for _ in range(REPS):
+        fresh = [Block.decode(w) for w in wire]
+        eng, _g = MX.replay_engine(genesis, MIXED_BLOCKS, keys[0],
+                                   window=int(os.environ.get(
+                                       "BENCH_WINDOW", "128")))
+        t0 = time.monotonic()
+        eng.replay(fresh)
+        dt = time.monotonic() - t0
+        assert eng.root == want_root
+        tpu_runs.append(txs / dt)
+        stats = eng.stats.row()
+    if os.environ.get("BENCH_VERBOSE"):
+        print("[mixed] py-host", [round(x) for x in py_runs], "txs/s",
+              file=sys.stderr)
+        print("[mixed] tpu", [round(x) for x in tpu_runs], "txs/s",
+              stats, file=sys.stderr)
+    return py_runs, tpu_runs, stats
+
+
 def main():
     py_runs, tpu_runs, native_runs = run_workload(
         "transfer", BASELINE_BLOCKS)
-    erc20_py, erc20_tpu, _ = run_workload("erc20", ERC20_BASELINE_BLOCKS)
+    erc20_py, erc20_tpu, erc20_native = run_workload(
+        "erc20", ERC20_BASELINE_BLOCKS)
+    # the SAME erc20 chain forced through the general step machine
+    os.environ["CORETH_NO_TOKEN_FASTPATH"] = "1"
+    mstats = {}
+    _, erc20m_tpu, _ = run_workload(
+        "erc20", ERC20_BASELINE_BLOCKS, tpu_blocks=MACHINE_BLOCKS,
+        machine_stats=mstats, skip_baselines=True)
+    del os.environ["CORETH_NO_TOKEN_FASTPATH"]
+    sstats = {}
+    swap_py, swap_tpu, swap_native = run_workload(
+        "swap", min(16, SWAP_BLOCKS), machine_stats=sstats)
+    mixed_py, mixed_tpu, mixed_stats = run_mixed()
     py_tps, tpu_tps = _median(py_runs), _median(tpu_runs)
     native_tps = _median(native_runs) if native_runs else None
+    erc20_native_tps = _median(erc20_native) if erc20_native else None
+    swap_native_tps = _median(swap_native) if swap_native else None
     result = {
         "metric": "transfer_replay_throughput",
         "value": round(tpu_tps, 1),
@@ -325,7 +460,40 @@ def main():
         "vs_py_host": round(tpu_tps / py_tps, 2),
         "erc20_txs_s": round(_median(erc20_tpu), 1),
         "erc20_spread_txs_s": _spread(erc20_tpu),
+        "erc20_vs_native": (round(_median(erc20_tpu) / erc20_native_tps, 3)
+                            if erc20_native_tps else None),
+        "erc20_native_txs_s": (round(erc20_native_tps, 1)
+                               if erc20_native_tps else None),
         "erc20_vs_py_host": round(_median(erc20_tpu) / _median(erc20_py), 2),
+        # the general step machine on the same token workload (no
+        # fast-path classification): config[1] through SURVEY 7.4
+        "erc20_machine_txs_s": round(_median(erc20m_tpu), 1),
+        "erc20_machine_vs_native": (
+            round(_median(erc20m_tpu) / erc20_native_tps, 3)
+            if erc20_native_tps else None),
+        "erc20_machine_stats": mstats,
+        # contention workload (config[3]): serial conflict chains;
+        # device rounds + host conflict-suffix
+        "swap_txs_s": round(_median(swap_tpu), 1),
+        "swap_vs_native": (round(_median(swap_tpu) / swap_native_tps, 3)
+                           if swap_native_tps else None),
+        "swap_native_txs_s": (round(swap_native_tps, 1)
+                              if swap_native_tps else None),
+        "swap_vs_py_host": round(_median(swap_tpu) / _median(swap_py), 2),
+        "swap_stats": sstats,
+        # Avalanche-semantics segment (config[4]): atomic ExtData +
+        # nativeAssetCall blocks fall back to the exact host path;
+        # fallback_fraction records how much of the segment that is
+        "mixed_txs_s": round(_median(mixed_tpu), 1),
+        "mixed_vs_py_host": round(_median(mixed_tpu) / _median(mixed_py), 2),
+        "mixed_fallback_fraction": round(
+            mixed_stats["blocks_fallback"]
+            / max(1, mixed_stats["blocks_fallback"]
+                  + mixed_stats["blocks_device"]), 3),
+        "mixed_phase_split": {
+            k: round(mixed_stats[k], 2)
+            for k in ("t_classify", "t_sender", "t_device", "t_trie",
+                      "t_fallback")},
         "host": {"cpus": os.cpu_count(),
                  "loadavg": [round(x, 2) for x in os.getloadavg()]},
     }

@@ -66,6 +66,12 @@ def load():
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p]
     lib.coreth_receipt_root.restype = None
+    lib.coreth_evm_replay.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_double)]
+    lib.coreth_evm_replay.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -128,6 +134,22 @@ def baseline_replay(tx_records: bytes, block_offsets, roots: bytes,
     rc = lib.coreth_baseline_replay(
         tx_records, off, n_blocks, roots, coinbases, accounts,
         n_accounts, phases)
+    return rc, list(phases)
+
+
+def evm_replay(tx_records: bytes, block_offsets, block_env: bytes,
+               accounts: bytes, n_accounts: int, contracts: bytes,
+               n_contracts: int, chain_id: int):
+    """Run the compiled sequential EVM processor (native/evm.cc — the
+    contract-workload baseline; see BASELINE.md round 5).  Returns
+    (rc, phases); rc==0 means every block's state root matched."""
+    lib = _require()
+    n_blocks = len(block_offsets) - 1
+    off = (ctypes.c_uint64 * len(block_offsets))(*block_offsets)
+    phases = (ctypes.c_double * 3)()
+    rc = lib.coreth_evm_replay(
+        tx_records, off, n_blocks, block_env, accounts, n_accounts,
+        contracts, n_contracts, chain_id, phases)
     return rc, list(phases)
 
 

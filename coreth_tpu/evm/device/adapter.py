@@ -200,7 +200,8 @@ class MachineRunner:
         for _ in range(self.max_rounds):
             p = self._params(txs)
             fn = M.get_machine(p)
-            out = fn(self._pack(txs, p))
+            out = self._Out(np.asarray(fn(self._pack(txs, p))["packed"]),
+                            p)
             missing = self._collect_misses(out, txs)
             if not missing:
                 return self._unpack(out, txs)
@@ -217,28 +218,49 @@ class MachineRunner:
         return out_res
 
     # ------------------------------------------------------------ unpack
-    def _collect_misses(self, out, txs) -> Dict[int, List[bytes]]:
-        sflag = np.asarray(out["sflag"])
-        scnt = np.asarray(out["scnt"])
-        status = np.asarray(out["status"])
-        skey = None
+    class _Out:
+        """View over the machine's single packed output tensor (one
+        device->host transfer; see machine.py 'packed')."""
+
+        def __init__(self, blob: np.ndarray, p: M.MachineParams):
+            S, LC, LD = p.scache_cap, p.log_cap, p.log_data_cap
+            o = 0
+
+            def take(n, shape=None):
+                nonlocal o
+                v = blob[:, o:o + n]
+                o += n
+                return v if shape is None else v.reshape(
+                    (blob.shape[0],) + shape)
+
+            self.status = take(1)[:, 0]
+            self.gas = take(1)[:, 0]
+            self.refund = take(1)[:, 0]
+            self.host_reason = take(1)[:, 0]
+            self.scnt = take(1)[:, 0]
+            self.sflag = take(S)
+            self.skey = take(S * 16, (S, 16))
+            self.sval = take(S * 16, (S, 16))
+            self.sorig = take(S * 16, (S, 16))
+            self.log_nt = take(LC)
+            self.log_dlen = take(LC)
+            self.log_cnt = take(1)[:, 0]
+            self.log_top = take(LC * 4 * 16, (LC, 4, 16))
+            self.log_data = take(LC * LD, (LC, LD))
+
+    def _collect_misses(self, out: "_Out", txs) -> Dict[int, List[bytes]]:
         missing: Dict[int, List[bytes]] = {}
         for i, t in enumerate(txs):
             # HOST lanes go to the host interpreter anyway; ERR lanes
             # may have mispriced on a speculative miss value, so they
             # must resolve + rerun too
-            n = int(scnt[i])
-            miss_rows = [j for j in range(n)
-                         if sflag[i, j] & M.F_MISS]
-            if not miss_rows:
-                continue
-            if skey is None:
-                skey = np.asarray(out["skey"])
+            n = int(out.scnt[i])
             keys = []
-            for j in miss_rows:
-                key = self._key_bytes(skey[i, j])
-                if key not in t.storage:
-                    keys.append(key)
+            for j in range(n):
+                if out.sflag[i, j] & M.F_MISS:
+                    key = self._key_bytes(out.skey[i, j])
+                    if key not in t.storage:
+                        keys.append(key)
             if keys:
                 missing[i] = keys
         return missing
@@ -256,44 +278,30 @@ class MachineRunner:
             v |= int(limbs[l]) << (16 * l)
         return v
 
-    def _unpack(self, out, txs) -> List[TxResult]:
-        status = np.asarray(out["status"])
-        gas = np.asarray(out["gas"])
-        refund = np.asarray(out["refund"])
-        reason = np.asarray(out["host_reason"])
-        skey = np.asarray(out["skey"])
-        sval = np.asarray(out["sval"])
-        sorig = np.asarray(out["sorig"])
-        sflag = np.asarray(out["sflag"])
-        scnt = np.asarray(out["scnt"])
-        log_top = np.asarray(out["log_top"])
-        log_nt = np.asarray(out["log_nt"])
-        log_data = np.asarray(out["log_data"])
-        log_dlen = np.asarray(out["log_dlen"])
-        log_cnt = np.asarray(out["log_cnt"])
+    def _unpack(self, out: "_Out", txs) -> List[TxResult]:
         results = []
         for i in range(len(txs)):
             reads: Dict[bytes, int] = {}
             writes: Dict[bytes, int] = {}
-            for j in range(int(scnt[i])):
-                fl = int(sflag[i, j])
+            for j in range(int(out.scnt[i])):
+                fl = int(out.sflag[i, j])
                 if not fl & M.F_VALID:
                     continue
-                key = self._key_bytes(skey[i, j])
+                key = self._key_bytes(out.skey[i, j])
                 if fl & M.F_READ:
-                    reads[key] = self._word_int(sorig[i, j])
+                    reads[key] = self._word_int(out.sorig[i, j])
                 if fl & M.F_WRITTEN:
-                    writes[key] = self._word_int(sval[i, j])
+                    writes[key] = self._word_int(out.sval[i, j])
             logs = []
-            for j in range(int(log_cnt[i])):
-                topics = [self._word_int(log_top[i, j, k]).to_bytes(
-                    32, "big") for k in range(int(log_nt[i, j]))]
+            for j in range(int(out.log_cnt[i])):
+                topics = [self._word_int(out.log_top[i, j, k]).to_bytes(
+                    32, "big") for k in range(int(out.log_nt[i, j]))]
                 data = bytes(
-                    log_data[i, j, :int(log_dlen[i, j])].astype(
+                    out.log_data[i, j, :int(out.log_dlen[i, j])].astype(
                         np.uint8).tolist())
                 logs.append((topics, data))
             results.append(TxResult(
-                status=int(status[i]), gas_left=int(gas[i]),
-                refund=int(refund[i]), logs=logs, reads=reads,
-                writes=writes, host_reason=int(reason[i])))
+                status=int(out.status[i]), gas_left=int(out.gas[i]),
+                refund=int(out.refund[i]), logs=logs, reads=reads,
+                writes=writes, host_reason=int(out.host_reason[i])))
         return results

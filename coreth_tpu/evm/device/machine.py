@@ -847,6 +847,19 @@ def build_machine(params: MachineParams):
         # every error consumes all gas (interpreter.go: any err but
         # ErrExecutionReverted burns the remaining gas)
         st["gas"] = jnp.where(st["status"] == ERR, 0, st["gas"])
+        # ONE packed int32 output row per lane: over the tunneled
+        # runtime every separate device->host array transfer pays a
+        # full sync (~0.2s), so the adapter downloads this single
+        # tensor instead of ~12 arrays (measured 2.4s -> 0.2s)
+        st["packed"] = jnp.concatenate([
+            st["status"][:, None], st["gas"][:, None],
+            st["refund"][:, None], st["host_reason"][:, None],
+            st["scnt"][:, None], st["sflag"],
+            st["skey"].reshape(B, -1), st["sval"].reshape(B, -1),
+            st["sorig"].reshape(B, -1), st["log_nt"],
+            st["log_dlen"], st["log_cnt"][:, None],
+            st["log_top"].reshape(B, -1),
+            st["log_data"].reshape(B, -1)], axis=1)
         return st
 
     return run

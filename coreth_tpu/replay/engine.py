@@ -360,25 +360,45 @@ class DeviceState:
 
     _staged: List[Tuple[int, int, int]]
 
+    @staticmethod
+    def _pad_pow2(n: int, floor: int = 64) -> int:
+        v = floor
+        while v < n:
+            v *= 2
+        return v
+
     def flush_staged(self):
         """Apply staged initial values; returns (accounts, slots) lists
         that were flushed so a speculative window can re-stage them if
-        its arrays are discarded after a fallback rewind."""
+        its arrays are discarded after a fallback rewind.
+
+        Scatter batches pad to pow2 buckets (OOB rows drop): every
+        distinct batch length would otherwise compile a fresh XLA
+        scatter — measured 0.65s per fallback block on the tunnel."""
         flushed_a, flushed_s = self._staged, self._staged_slots
         if self._staged:
-            idx = jnp.asarray([s[0] for s in self._staged],
-                              dtype=jnp.int32)
-            bal = u256.from_ints([s[1] for s in self._staged])
-            non = jnp.asarray([s[2] for s in self._staged],
-                              dtype=jnp.int32)
-            self.balances = self.balances.at[idx].set(bal)
-            self.nonces = self.nonces.at[idx].set(non)
+            n = len(self._staged)
+            pad = self._pad_pow2(n)
+            idx = np.full(pad, self.capacity, dtype=np.int32)
+            idx[:n] = [s[0] for s in self._staged]
+            bal = u256.pack_np([s[1] for s in self._staged]
+                               + [0] * (pad - n))
+            non = np.zeros(pad, dtype=np.int32)
+            non[:n] = [s[2] for s in self._staged]
+            self.balances = self.balances.at[jnp.asarray(idx)].set(
+                jnp.asarray(bal), mode="drop")
+            self.nonces = self.nonces.at[jnp.asarray(idx)].set(
+                jnp.asarray(non), mode="drop")
             self._staged = []
         if self._staged_slots:
-            idx = jnp.asarray([s[0] for s in self._staged_slots],
-                              dtype=jnp.int32)
-            val = u256.from_ints([s[1] for s in self._staged_slots])
-            self.slot_vals = self.slot_vals.at[idx].set(val)
+            n = len(self._staged_slots)
+            pad = self._pad_pow2(n)
+            idx = np.full(pad, self.slot_capacity, dtype=np.int32)
+            idx[:n] = [s[0] for s in self._staged_slots]
+            val = u256.pack_np([s[1] for s in self._staged_slots]
+                               + [0] * (pad - n))
+            self.slot_vals = self.slot_vals.at[jnp.asarray(idx)].set(
+                jnp.asarray(val), mode="drop")
             self._staged_slots = []
         return flushed_a, flushed_s
 
@@ -510,7 +530,8 @@ class ReplayEngine:
     def __init__(self, config: ChainConfig, db: Database, state_root: bytes,
                  parent_header=None, batch_pad: int = 1024,
                  capacity: int = 1 << 14, window: int = 16,
-                 slot_capacity: Optional[int] = None, mesh=None):
+                 slot_capacity: Optional[int] = None, mesh=None,
+                 engine=None):
         """mesh: a jax.sharding.Mesh with >1 device switches execution
         to the mesh-sharded kernels (parallel/mesh.py): tx batches and
         state rows shard over the ``dp`` axis, per-account/per-slot
@@ -550,7 +571,11 @@ class ReplayEngine:
                 self.trie)
         self.state = DeviceState(capacity, slot_capacity or capacity)
         self.signer = LatestSigner(config.chain_id)
-        self.engine = DummyEngine()
+        # a DummyEngine with ConsensusCallbacks makes the host fallback
+        # path apply atomic ExtData txs (onExtraStateChange,
+        # plugin/evm/vm.go:986) — required to replay Avalanche-semantics
+        # segments (BASELINE config[4])
+        self.engine = engine or DummyEngine()
         self.engine.set_config(config)
         self.processor = Processor(config, engine=self.engine)
         self.stats = ReplayStats()
@@ -758,6 +783,10 @@ class ReplayEngine:
         updates — the O(txs) bookkeeping that replaces O(gas) host
         interpretation) and pre-builds the Transfer log; the wide u256
         slot arithmetic itself runs batched on device (_slot_step)."""
+        if block.ext_data():
+            # atomic ExtData applies through the engine callbacks on
+            # the exact host path only
+            return None
         base_fee = block.base_fee
         rules = self.config.rules(block.number, block.time)
         # precompile / prohibited targets have no code in state but DO
@@ -765,8 +794,12 @@ class ReplayEngine:
         from coreth_tpu.evm.precompiles import special_call_targets
         from coreth_tpu.processor.state_transition import is_prohibited
         avoid = special_call_targets(rules)
+        # CORETH_NO_TOKEN_FASTPATH=1 routes token calls to the general
+        # step machine instead (A/B benching of the machine path)
+        no_token = bool(int(__import__("os").environ.get(
+            "CORETH_NO_TOKEN_FASTPATH", "0")))
         token_ctx = self._token_block_ctx(rules, block) \
-            if rules.is_apricot_phase1 else None
+            if rules.is_apricot_phase1 and not no_token else None
         senders, recips, values, fees, required, nonces, offsets = \
             [], [], [], [], [], [], []
         from_slots, to_slots, amounts, gas_used, tx_logs = \

@@ -70,12 +70,15 @@ class MachineBlockExecutor:
         self.e = engine
         self.rounds = 0            # OCC re-execution rounds (stats)
         self.blocks = 0
+        self.host_txs = 0          # conflict-suffix txs resolved on host
 
     # ------------------------------------------------------------ classify
     def classify(self, block: Block) -> Optional[List[TxPlan]]:
         """TxPlans if every tx is a pure transfer or a device-eligible
         contract call, else None."""
         e = self.e
+        if block.ext_data():
+            return None  # atomic ExtData needs the host engine hooks
         rules = e.config.rules(block.number, block.time)
         fork = DT.fork_key(rules)
         if fork is None:
@@ -136,6 +139,80 @@ class MachineBlockExecutor:
         self._fork = fork
         return plans
 
+    # -------------------------------------------------- host conflict path
+    def _host_resolve(self, block: Block, plans, call_idx, results,
+                      first: int) -> None:
+        """Sequentially re-execute every call tx at index >= `first`
+        through the exact host interpreter, against a scratch StateDB
+        carrying the device-valid prefix's storage writes.  One host
+        pass resolves an arbitrarily deep conflict chain; results slot
+        into the same validation sweep (reads empty = exact by
+        construction)."""
+        from coreth_tpu.evm.device.adapter import TxResult
+        from coreth_tpu.evm.evm import (
+            EVM, BlockContext, Config, TxContext)
+        from coreth_tpu.evm import vmerrs
+        from coreth_tpu.state import StateDB
+        e = self.e
+        rules = e.config.rules(block.number, block.time)
+        e.commit()  # persist engine tries so the scratch db can read
+        scratch = StateDB(e.root, e.db)
+        block_ctx = BlockContext(
+            coinbase=block.header.coinbase, number=block.number,
+            time=block.time, gas_limit=block.header.gas_limit,
+            base_fee=block.base_fee)
+        boosted = set()
+        for i in call_idx:
+            pl = plans[i]
+            if i < first:
+                res = results[i]
+                if res is not None and res.status == M.STOP:
+                    for key, v in res.writes.items():
+                        scratch.set_state(pl.to, key,
+                                          v.to_bytes(32, "big"))
+                    scratch.finalise(True)
+                continue
+            # solvency is validated later by the account sweep over
+            # exact sequential balances; the scratch db carries
+            # block-START balances, so boost the sender to keep the
+            # interpreter's CanTransfer from mis-failing mid-block
+            if pl.sender not in boosted:
+                scratch.add_balance(pl.sender, 1 << 200)
+                boosted.add(pl.sender)
+            scratch.prepare(rules, pl.sender, block.header.coinbase,
+                            pl.to, list(rules.active_precompiles), [])
+            evm = EVM(block_ctx,
+                      TxContext(origin=pl.sender, gas_price=pl.price),
+                      scratch, e.config, Config())
+            n_logs = len(scratch.logs)
+            ret, gas_left, err = evm.call(
+                pl.sender, pl.to, pl.data,
+                pl.gas_limit - pl.intrinsic, pl.value)
+            if err is None:
+                status = M.STOP
+            elif isinstance(err, vmerrs.ErrExecutionReverted):
+                status = M.REVERT
+            else:
+                status = M.ERR
+            logs = []
+            writes = {}
+            if status == M.STOP:
+                logs = [([bytes(t) for t in lg.topics], bytes(lg.data))
+                        for lg in scratch.logs[n_logs:]]
+                obj = scratch._objects.get(pl.to)
+                if obj is not None:
+                    for key in list(obj.dirty_storage):
+                        cur = scratch.get_state(pl.to, key,
+                                                _normalize=False)
+                        writes[key] = int.from_bytes(cur, "big")
+            else:
+                del scratch.logs[n_logs:]
+            scratch.finalise(True)
+            results[i] = TxResult(
+                status=status, gas_left=gas_left, refund=0, logs=logs,
+                reads={}, writes=writes)
+            self.host_txs += 1
+
     # ------------------------------------------------------------- storage
     def _base_value(self, contract: bytes, key: bytes) -> int:
         st = self.e._storage_trie(contract)
@@ -168,10 +245,27 @@ class MachineBlockExecutor:
                 base_cache[(contract, key)] = v
             return v
 
-        # OCC loop: execute pending lanes, then sequentially validate
+        # OCC loop: execute pending lanes, then sequentially validate.
+        # After DEVICE_ROUNDS optimistic device rounds, any txs still
+        # conflicting resolve SEQUENTIALLY on the exact host
+        # interpreter (per tx — independent txs keep their device
+        # results): a serial conflict chain costs one host pass, not
+        # one device dispatch per chain link (SURVEY §7.6's
+        # "sequential fallback identical to state_processor.go for
+        # conflicts", applied per tx instead of per block).
+        DEVICE_ROUNDS = int(__import__("os").environ.get(
+            "CORETH_OCC_DEVICE_ROUNDS", "2"))
         pending: List[Tuple[int, Dict]] = [(i, {}) for i in call_idx]
-        max_rounds = len(call_idx) + 2
-        for _ in range(max_rounds):
+        max_rounds = len(call_idx) + 3
+        for rnd in range(max_rounds):
+            if pending and rnd >= DEVICE_ROUNDS:
+                # serialize the conflict suffix on the exact host
+                # interpreter: everything from the first still-pending
+                # tx onward re-executes sequentially at its exact
+                # position (device keeps the conflict-free prefix)
+                self._host_resolve(block, plans, call_idx, results,
+                                   pending[0][0])
+                pending = []
             if pending:
                 specs = []
                 for i, overlay in pending:

@@ -1,0 +1,72 @@
+"""Packers for the compiled C++ replay baselines (native/baseline.cc,
+native/evm.cc).
+
+Python packs the wire data once (prep, excluded from timed regions —
+which favors the baselines, BASELINE.md); the C++ side then replays
+sequentially with bit-identical root validation per block.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.state.statedb import normalize_state_key
+from coreth_tpu.types import Block, LatestSigner
+
+
+def pack_evm_replay(genesis, blocks: List[Block]) -> Tuple:
+    """Args for crypto.native.evm_replay over a contract-call chain."""
+    signer = LatestSigner(genesis.config.chain_id)
+    txs = bytearray()
+    offs = [0]
+    env = bytearray()
+    n = 0
+    for b in blocks:
+        for tx in b.transactions:
+            r, s, recid = tx.inner.raw_signature()
+            price = tx.gas_price if b.base_fee is None else min(
+                tx.gas_fee_cap, b.base_fee + tx.gas_tip_cap)
+            required = tx.gas * tx.gas_fee_cap + tx.value
+            txs += signer.sig_hash(tx)
+            txs += r.to_bytes(32, "big") + s.to_bytes(32, "big") \
+                + bytes([recid])
+            txs += tx.to
+            txs += tx.value.to_bytes(32, "big")
+            txs += tx.gas.to_bytes(8, "big")
+            txs += price.to_bytes(32, "big")
+            txs += required.to_bytes(32, "big")
+            txs += tx.nonce.to_bytes(8, "big")
+            txs += len(tx.data).to_bytes(4, "little") + tx.data
+            n += 1
+        offs.append(n)
+        env += b.root
+        env += b.header.coinbase
+        env += b.time.to_bytes(8, "big")
+        env += b.number.to_bytes(8, "big")
+        env += b.header.gas_limit.to_bytes(8, "big")
+        env += (b.base_fee or 0).to_bytes(32, "big")
+        env += b.header.gas_used.to_bytes(8, "big")
+    accounts = bytearray()
+    contracts = bytearray()
+    n_accounts = 0
+    n_contracts = 0
+    for addr, acct in genesis.alloc.items():
+        code = getattr(acct, "code", b"") or b""
+        if code:
+            contracts += addr + keccak256(code)
+            contracts += len(code).to_bytes(4, "little") + code
+            storage = getattr(acct, "storage", None) or {}
+            contracts += len(storage).to_bytes(4, "little")
+            for key, val in storage.items():
+                contracts += normalize_state_key(key)
+                contracts += (val if isinstance(val, bytes)
+                              else val.to_bytes(32, "big")
+                              ).rjust(32, b"\x00")
+            n_contracts += 1
+        else:
+            accounts += addr + acct.balance.to_bytes(32, "big") \
+                + acct.nonce.to_bytes(8, "big")
+            n_accounts += 1
+    return (bytes(txs), offs, bytes(env), bytes(accounts), n_accounts,
+            bytes(contracts), n_contracts, genesis.config.chain_id)

@@ -99,6 +99,23 @@ def test_swap_contention_block():
     assert mx.rounds > 0  # conflicts actually exercised the retry path
 
 
+def test_deep_conflict_chain_host_suffix():
+    """A conflict chain deeper than the device OCC round budget
+    resolves its suffix sequentially on the host interpreter — per tx,
+    not per block: the conflict-free device prefix is kept and the
+    block never reaches the engine's whole-block fallback."""
+    def gen(i, nonces):
+        return [tx(k, nonces, POOL, swap_calldata(100 + 31 * i + k))
+                for k in range(8)]
+
+    eng = run_machine_chain(2, gen)
+    mx = eng._machine
+    assert mx.blocks == 2
+    assert mx.host_txs > 0             # suffix went to the host path
+    assert mx.host_txs < 2 * 8         # ... but not the whole blocks
+    assert eng.stats.blocks_fallback == 0
+
+
 def test_disjoint_machine_txs_single_round():
     """balanceOf() calls are NOT token-fast-path-classifiable (only
     transfer() is), so they ride the machine path; disjoint reads have

@@ -1,0 +1,179 @@
+"""BASELINE config[4]: a historical Avalanche-semantics segment —
+atomic ExtData blocks (ImportTx incl. a non-AVAX asset) and
+nativeAssetCall multicoin transfers interleaved with plain transfer
+blocks — replayed through the ReplayEngine with engine callbacks.
+
+Atomic + multicoin blocks route through the exact host path (the
+engine's onExtraStateChange seam, reference plugin/evm/vm.go:986);
+transfer blocks stay on the device path.  Roots must match
+bit-identically across the hand-off in both directions."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.atomic import (
+    AtomicBackend, ChainContext, EVMOutput, Memory, TransferableInput,
+    TransferableOutput, Tx, UnsignedImportTx, UTXO, X2C_RATE,
+    make_callbacks,
+)
+from coreth_tpu.atomic.shared_memory import Element, Requests
+from coreth_tpu.chain import Genesis, GenesisAccount, generate_chain
+from coreth_tpu.consensus.engine import DummyEngine
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.evm.precompiles import NATIVE_ASSET_CALL_ADDR
+from coreth_tpu.params import TEST_APRICOT_PHASE5_CONFIG as CFG
+from coreth_tpu.replay import ReplayEngine
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+from tests.test_atomic import _short_addr
+
+GWEI = 10**9
+KEYS = [0x6000 + i for i in range(4)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+CTX = ChainContext()
+ASSET = b"\x5a" * 32
+ASSET_RECIPIENT = b"\x44" * 20
+
+
+def seed_utxo(memory: Memory, asset_id: bytes, amount: int,
+              owner_priv: int, tx_id: bytes):
+    out = TransferableOutput(asset_id=asset_id, amount=amount,
+                            addrs=[_short_addr(owner_priv)])
+    utxo = UTXO(tx_id=tx_id, output_index=0, out=out)
+    sm_x = memory.new_shared_memory(CTX.x_chain_id)
+    req = Requests(put_requests=[Element(utxo.input_id(), utxo.encode(),
+                                         out.addrs)])
+    sm_x.apply({CTX.chain_id: req})
+    return utxo
+
+
+def make_mixed_import(avax_utxo, asset_utxo, to: bytes, key: int,
+                      avax_credit: int, asset_credit: int) -> Tx:
+    """ImportTx bringing AVAX (fee burn) + a non-AVAX asset (multicoin
+    credit) in one atomic operation."""
+    unsigned = UnsignedImportTx(
+        network_id=CTX.network_id, blockchain_id=CTX.chain_id,
+        source_chain=CTX.x_chain_id,
+        imported_inputs=[
+            TransferableInput(
+                tx_id=avax_utxo.tx_id,
+                output_index=avax_utxo.output_index,
+                asset_id=CTX.avax_asset_id,
+                amount=avax_utxo.out.amount, sig_indices=[0]),
+            TransferableInput(
+                tx_id=asset_utxo.tx_id,
+                output_index=asset_utxo.output_index,
+                asset_id=ASSET, amount=asset_utxo.out.amount,
+                sig_indices=[0]),
+        ],
+        outs=[EVMOutput(address=to, amount=avax_credit,
+                        asset_id=CTX.avax_asset_id),
+              EVMOutput(address=to, amount=asset_credit,
+                        asset_id=ASSET)])
+    tx = Tx(unsigned)
+    tx.sign([[key], [key]])
+    return tx
+
+
+def build_mixed_segment(n_blocks=8):
+    memory = Memory()
+    alloc = {a: GenesisAccount(balance=10**21) for a in ADDRS}
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    pending = []
+    cb = make_callbacks(backend, CFG,
+                        pending_atomic_txs=lambda: pending)
+    engine = DummyEngine(cb=cb)
+    engine.set_config(CFG)
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    # seed shared memory for the two atomic blocks
+    imports = []
+    for bi, key in ((0, KEYS[0]), (4, KEYS[1])):
+        avax_u = seed_utxo(memory, CTX.avax_asset_id, 50_000_000, key,
+                           bytes([0x20 + bi]) * 32)
+        asset_u = seed_utxo(memory, ASSET, 777_000, key,
+                            bytes([0x40 + bi]) * 32)
+        imports.append((bi, key, avax_u, asset_u))
+
+    def gen(i, bg):
+        pending.clear()
+        for bi, key, avax_u, asset_u in imports:
+            if bi == i:
+                pending.append(make_mixed_import(
+                    avax_u, asset_u, priv_to_address(key), key,
+                    avax_credit=40_000_000, asset_credit=777_000))
+        if i in (1, 5):
+            # nativeAssetCall: move some of the imported asset to
+            # another address (multicoin transfer + empty nested call)
+            k = 0 if i == 1 else 1
+            data = (ASSET_RECIPIENT + ASSET
+                    + (1000 + i).to_bytes(32, "big") + b"")
+            bg.add_tx(_tx(k, nonces, NATIVE_ASSET_CALL_ADDR,
+                          data=data, gas=200_000))
+        else:
+            # transfers from NON-importer keys: importers become
+            # multicoin accounts, which the device classifier
+            # conservatively routes to the host path
+            for k in (2, 3):
+                bg.add_tx(_tx(k, nonces, bytes([0x30 + k]) * 20,
+                              gas=21_000, value=1234 + i))
+
+    def _tx(k, nonces, to, data=b"", gas=21_000, value=0):
+        t = sign_tx(DynamicFeeTx(
+            chain_id_=CFG.chain_id, nonce=nonces[k],
+            gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI, gas=gas,
+            to=to, value=value, data=data), KEYS[k], CFG.chain_id)
+        nonces[k] += 1
+        return t
+
+    blocks, receipts = generate_chain(CFG, gblock, db, n_blocks, gen,
+                                      gap=2, engine=engine)
+    return memory, genesis, gblock, blocks
+
+
+def replay_engine_for(genesis, memory):
+    db = Database()
+    gblock = genesis.to_block(db)
+    backend = AtomicBackend(CTX, memory.new_shared_memory(CTX.chain_id))
+    cb = make_callbacks(backend, CFG, pending_atomic_txs=lambda: [])
+    engine = DummyEngine(cb=cb)
+    return ReplayEngine(CFG, db, gblock.root,
+                        parent_header=gblock.header, engine=engine,
+                        window=4)
+
+
+def test_mixed_segment_replay():
+    memory, genesis, gblock, blocks = build_mixed_segment(8)
+    # atomic blocks carry ExtData; nativeAssetCall blocks have the
+    # reserved precompile target
+    assert blocks[0].ext_data() != b""
+    assert blocks[4].ext_data() != b""
+    eng = replay_engine_for(genesis, memory)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    # 2 atomic + 2 nativeAssetCall blocks on the host path, 4 transfer
+    # blocks on the device path
+    assert eng.stats.blocks_fallback == 4
+    assert eng.stats.blocks_device == 4
+
+
+def test_mixed_segment_multicoin_state():
+    memory, genesis, gblock, blocks = build_mixed_segment(8)
+    eng = replay_engine_for(genesis, memory)
+    eng.replay(blocks)
+    eng.commit()
+    from coreth_tpu.state import StateDB
+    statedb = StateDB(eng.root, eng.db)
+    # the asset moved: recipient holds the nativeAssetCall amounts
+    got = statedb.get_balance_multi_coin(ASSET_RECIPIENT, ASSET)
+    assert got == (1000 + 1) + (1000 + 5)
+    # importers hold the remainder
+    assert statedb.get_balance_multi_coin(ADDRS[0], ASSET) \
+        == 777_000 - 1001
