@@ -28,6 +28,7 @@ both engines can interleave over one chain.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -796,7 +797,7 @@ class ReplayEngine:
         avoid = special_call_targets(rules)
         # CORETH_NO_TOKEN_FASTPATH=1 routes token calls to the general
         # step machine instead (A/B benching of the machine path)
-        no_token = bool(int(__import__("os").environ.get(
+        no_token = bool(int(os.environ.get(
             "CORETH_NO_TOKEN_FASTPATH", "0")))
         token_ctx = self._token_block_ctx(rules, block) \
             if rules.is_apricot_phase1 and not no_token else None
@@ -1401,8 +1402,7 @@ class ReplayEngine:
         """Execute an unclassifiable block on the general device step
         machine when every tx is device-eligible; False -> host path.
         CORETH_MACHINE=0 forces the host path (A/B benching)."""
-        if not bool(int(__import__("os")
-                        .environ.get("CORETH_MACHINE", "1"))):
+        if not bool(int(os.environ.get("CORETH_MACHINE", "1"))):
             return False
         mx = self._machine_executor()
         t0 = time.monotonic()

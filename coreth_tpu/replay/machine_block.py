@@ -27,6 +27,7 @@ sequential loop this replaces), core/state_transition.go TransitionDb.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -253,7 +254,7 @@ class MachineBlockExecutor:
         # one device dispatch per chain link (SURVEY §7.6's
         # "sequential fallback identical to state_processor.go for
         # conflicts", applied per tx instead of per block).
-        DEVICE_ROUNDS = int(__import__("os").environ.get(
+        DEVICE_ROUNDS = int(os.environ.get(
             "CORETH_OCC_DEVICE_ROUNDS", "2"))
         pending: List[Tuple[int, Dict]] = [(i, {}) for i in call_idx]
         max_rounds = len(call_idx) + 3

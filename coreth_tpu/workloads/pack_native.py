@@ -55,6 +55,8 @@ def pack_evm_replay(genesis, blocks: List[Block]) -> Tuple:
         code = getattr(acct, "code", b"") or b""
         if code:
             contracts += addr + keccak256(code)
+            contracts += acct.balance.to_bytes(32, "big")
+            contracts += acct.nonce.to_bytes(8, "big")
             contracts += len(code).to_bytes(4, "little") + code
             storage = getattr(acct, "storage", None) or {}
             contracts += len(storage).to_bytes(4, "little")

@@ -755,7 +755,8 @@ extern "C" {
 // block env record (per block): root32 coinbase20 ts8 num8 gaslimit8
 //            basefee32 gasused8
 // accounts: addr20 bal32 nonce8
-// contracts: addr20 codehash32 len4 code nslots4 (key32 val32)*
+// contracts: addr20 codehash32 bal32 nonce8 len4 code nslots4
+//            (key32 val32)*
 int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
                       uint64_t n_blocks, const uint8_t* block_env,
                       const uint8_t* accounts, uint64_t n_accounts,
@@ -785,11 +786,19 @@ int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
     std::string addr((const char*)p, 20);
     Contract& c = pool[i];
     std::memcpy(c.code_hash, p + 20, 32);
+    u128 cbal = 0;
+    bool cbig = false;
+    for (int j = 0; j < 16; ++j)
+      if (p[52 + j]) cbig = true;
+    for (int j = 16; j < 32; ++j) cbal = (cbal << 8) | p[52 + j];
+    if (cbig) return -1;
+    uint64_t cnonce = 0;
+    for (int j = 0; j < 8; ++j) cnonce = (cnonce << 8) | p[84 + j];
     uint32_t clen;
-    std::memcpy(&clen, p + 52, 4);
-    c.code.assign(p + 56, p + 56 + clen);
+    std::memcpy(&clen, p + 92, 4);
+    c.code.assign(p + 96, p + 96 + clen);
     analyze_jumpdests(&c);
-    p += 56 + clen;
+    p += 96 + clen;
     uint32_t nslots;
     std::memcpy(&nslots, p, 4);
     p += 4;
@@ -801,7 +810,8 @@ int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
     }
     auto& acct = state[addr];
     acct.contract = &c;
-    if (!acct.nonce) acct.nonce = 1;
+    acct.balance = cbal;
+    acct.nonce = cnonce;
   }
 
   // per-contract storage tries built once from initial slots
@@ -921,7 +931,7 @@ int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
       for (int j = 16; j < 32; ++j)
         value = (value << 8) | tp[117 + j];
       for (int j = 0; j < 16; ++j)
-        if (tp[117 + j]) too_big = true;
+        if (tp[117 + j] | tp[157 + j] | tp[189 + j]) too_big = true;
       uint64_t gas_limit = 0;
       for (int j = 0; j < 8; ++j)
         gas_limit = (gas_limit << 8) | tp[149 + j];
