@@ -10,11 +10,15 @@ compressed); aggregation is point addition on either side.
 The pairing is the optimal-ate over the Fq12 tower computed with
 affine Miller-loop arithmetic (the py_ecc-style formulation: clarity
 over speed — this is host-side control-plane crypto, not the TPU hot
-path).  Hash-to-curve uses deterministic try-and-increment with
-cofactor clearing rather than RFC 9380 SSWU; semantics and security
-(ROM) match, but signatures are NOT wire-compatible with blst's —
-documented divergence, acceptable while no cross-implementation peer
-exists.
+path).  Hash-to-curve is the RFC 9380 SSWU suite
+(BLS12381G2_XMD:SHA-256_SSWU_RO_ with blst's proof-of-possession DST,
+crypto/h2c.py): expand_message_xmd, hash_to_field, simplified SWU onto
+the 3-isogenous curve, the degree-3 isogeny back to E2 (coefficients
+validated on-curve at import), and cofactor clearing — replacing the
+earlier try-and-increment map (round-4 verdict #6).  The pipeline
+reproduces the RFC 9380 Appendix J.10.1 known-answer vectors
+byte-for-byte (tests/test_crypto), so signatures are wire-compatible
+with blst.
 """
 
 from __future__ import annotations
@@ -494,30 +498,14 @@ def g2_decompress(data: bytes):
 
 # -------------------------------------------------------- hash to curve
 
-DST = b"CORETH-TPU-BLS-SIG-V01-TAI-G2"
+# blst's min-pk proof-of-possession ciphersuite tag (crypto/h2c.py)
+DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 
 
 def hash_to_g2(msg: bytes, dst: bytes = DST):
-    """Deterministic try-and-increment onto E2, then clear the cofactor.
-    Secure in the ROM; NOT the RFC 9380 SSWU map blst uses (see module
-    docstring)."""
-    ctr = 0
-    while True:
-        seed = hashlib.sha256(dst + len(dst).to_bytes(1, "big")
-                              + msg + ctr.to_bytes(4, "big")).digest()
-        c0 = int.from_bytes(hashlib.sha512(seed + b"\x00").digest(),
-                            "big") % P
-        c1 = int.from_bytes(hashlib.sha512(seed + b"\x01").digest(),
-                            "big") % P
-        x = Fq2(c0, c1)
-        y = (x.sq() * x + B2).sqrt()
-        if y is not None:
-            # deterministic sign choice
-            neg = -y
-            if (y[1], y[0]) > (neg[1], neg[0]):
-                y = neg
-            return g2_mul((x, y), H_EFF_G2)
-        ctr += 1
+    """RFC 9380 hash_to_curve for G2 (SSWU; see crypto/h2c.py)."""
+    from coreth_tpu.crypto import h2c
+    return h2c.hash_to_g2(msg, dst)
 
 
 # ------------------------------------------------------------- the API

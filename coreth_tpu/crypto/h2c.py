@@ -1,0 +1,197 @@
+"""RFC 9380 hash-to-curve for BLS12-381 G2 (SSWU, XMD:SHA-256).
+
+The suite blst implements for Avalanche warp signatures
+(BLS12381G2_XMD:SHA-256_SSWU_RO_, reference warp/backend.go:136 via
+supranational/blst): expand_message_xmd (§5.3.1), hash_to_field with
+m=2 / L=64 / count=2 (§5.2), the simplified SWU map onto the
+3-isogenous curve E' (§6.6.2: A' = 240*I, B' = 1012*(1+I),
+Z = -(2+I)), the degree-3 isogeny back to E2 (Appendix E.3), and
+cofactor clearing by the effective G2 cofactor.
+
+Validation: (a) the isogeny coefficients are cross-checked at import
+by mapping random E' points and asserting the images satisfy E2's
+curve equation y^2 = x^3 + 4(1+I); (b) the full pipeline reproduces
+the RFC 9380 Appendix J.10.1 known-answer vectors byte-for-byte
+(tests/test_crypto.test_rfc9380_known_answer_vectors), which pins
+wire compatibility with every conforming implementation, blst
+included.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Tuple
+
+from coreth_tpu.crypto import bls as _b
+
+P = _b.P
+Fq2 = _b.Fq2
+
+# E' (the 3-isogenous SSWU target): y^2 = x^3 + A'x + B'  (RFC 8.8.2)
+A_ISO = Fq2(0, 240)
+B_ISO = Fq2(1012, 1012)
+Z_SSWU = Fq2(P - 2, P - 1)          # -(2 + I)
+
+# Degree-3 isogeny E' -> E2 coefficients (RFC 9380 Appendix E.3).
+# Layout: x = x_num(x')/x_den(x'), y = y' * y_num(x')/y_den(x') with
+# coefficient lists ordered from degree 0 upward; x_den and y_den are
+# monic (leading 1 implicit in the lists below).
+_K = 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6
+_L = 0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A
+_M = 0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D
+
+X_NUM = [
+    Fq2(_K, _K),
+    Fq2(0, _L),
+    Fq2(_L + 4, _M),                   # ...c71e, ...e38d
+    Fq2(0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1, 0),  # noqa: E501
+]
+X_DEN = [
+    Fq2(0, P - 72),                    # ...aa63
+    Fq2(12, P - 12),                   # ...aa9f
+    Fq2(1, 0),                         # monic x^2
+]
+Y_NUM = [
+    Fq2(0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,  # noqa: E501
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),  # noqa: E501
+    Fq2(0, _K - 24),                   # ...a97be
+    Fq2(_L + 2, _M + 2),               # ...c71c, ...e38f
+    Fq2(0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10, 0),  # noqa: E501
+]
+Y_DEN = [
+    Fq2(P - 432, P - 432),             # ...a8fb
+    Fq2(0, P - 216),                   # ...a9d3
+    Fq2(18, P - 18),                   # ...aa99
+    Fq2(1, 0),                         # monic x^3
+]
+
+
+def expand_message_xmd(msg: bytes, dst: bytes,
+                       len_in_bytes: int) -> bytes:
+    """RFC 9380 §5.3.1 with SHA-256 (b=32, s=64)."""
+    if len(dst) > 255:
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    ell = (len_in_bytes + 31) // 32
+    if ell > 255:
+        raise ValueError("len_in_bytes too large")
+    dst_prime = dst + len(dst).to_bytes(1, "big")
+    z_pad = b"\x00" * 64
+    l_i_b = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(
+        z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
+    bi = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    out = [bi]
+    for i in range(2, ell + 1):
+        x = bytes(a ^ b for a, b in zip(b0, bi))
+        bi = hashlib.sha256(x + i.to_bytes(1, "big")
+                            + dst_prime).digest()
+        out.append(bi)
+    return b"".join(out)[:len_in_bytes]
+
+
+def hash_to_field_fq2(msg: bytes, dst: bytes,
+                      count: int = 2) -> List[Fq2]:
+    """RFC 9380 §5.2: m=2, L=64 for BLS12-381."""
+    L = 64
+    blob = expand_message_xmd(msg, dst, count * 2 * L)
+    out = []
+    for i in range(count):
+        cs = []
+        for j in range(2):
+            off = L * (j + i * 2)
+            cs.append(int.from_bytes(blob[off:off + L], "big") % P)
+        out.append(Fq2(cs[0], cs[1]))
+    return out
+
+
+def _sgn0(x: Fq2) -> int:
+    """RFC 9380 §4.1 sgn0 for m=2."""
+    sign_0 = x[0] % 2
+    zero_0 = x[0] == 0
+    sign_1 = x[1] % 2
+    return sign_0 | (1 if (zero_0 and sign_1) else 0)
+
+
+def _g_iso(x: Fq2) -> Fq2:
+    return x.sq() * x + A_ISO * x + B_ISO
+
+
+def sswu(u: Fq2) -> Tuple[Fq2, Fq2]:
+    """Simplified SWU onto E' (RFC 9380 §6.6.2)."""
+    u2 = u.sq()
+    tv1 = Z_SSWU * u2
+    tv2 = tv1.sq() + tv1                   # Z^2 u^4 + Z u^2
+    if tv2.is_zero():
+        x1 = B_ISO * (Z_SSWU * A_ISO).inv()
+    else:
+        x1 = NEG_B_OVER_A * (FQ2_ONE + tv2.inv())
+    gx1 = _g_iso(x1)
+    y1 = gx1.sqrt()
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x = tv1 * x1
+        gx2 = _g_iso(x)
+        y = gx2.sqrt()
+        assert y is not None  # exactly one of gx1/gx2 is square
+    if _sgn0(u) != _sgn0(y):
+        y = -y
+    return x, y
+
+
+FQ2_ONE = Fq2(1, 0)
+NEG_B_OVER_A = -(B_ISO * A_ISO.inv())
+
+
+def _horner(coeffs: List[Fq2], x: Fq2) -> Fq2:
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = acc * x + c
+    return acc
+
+
+def iso3(pt: Tuple[Fq2, Fq2]) -> Tuple[Fq2, Fq2]:
+    """The 3-isogeny E' -> E2 (Appendix E.3)."""
+    x, y = pt
+    xn = _horner(X_NUM, x)
+    xd = _horner(X_DEN, x)
+    yn = _horner(Y_NUM, x)
+    yd = _horner(Y_DEN, x)
+    return xn * xd.inv(), y * yn * yd.inv()
+
+
+def map_to_curve_g2(u: Fq2) -> Tuple[Fq2, Fq2]:
+    return iso3(sswu(u))
+
+
+# AvalancheGo/blst ciphersuite tags (min-pk, proof-of-possession
+# scheme): signatures hash with the SIG tag, possession proofs with POP
+DST_SIG = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST_SIG):
+    """Full hash_to_curve: two field elements, two SSWU maps, point
+    add on E2, clear cofactor (§3 hash_to_curve)."""
+    u0, u1 = hash_to_field_fq2(msg, dst)
+    q0 = map_to_curve_g2(u0)
+    q1 = map_to_curve_g2(u1)
+    r = _b.g2_add(q0, q1)
+    return _b.g2_mul(r, _b.H_EFF_G2)
+
+
+def _selfcheck(n: int = 4, seed: bytes = b"h2c-import-check") -> None:
+    """Map n deterministic pseudo-random field elements and assert the
+    SSWU output lies on E' and the isogeny image lies on E2 — a wrong
+    curve/isogeny constant fails here with probability ~1."""
+    for i in range(n):
+        blob = hashlib.sha512(seed + bytes([i])).digest()
+        u = Fq2(int.from_bytes(blob[:32], "big") % P,
+                int.from_bytes(blob[32:], "big") % P)
+        xp, yp = sswu(u)
+        assert yp.sq() == _g_iso(xp), "SSWU point off E'"
+        x, y = iso3((xp, yp))
+        assert y.sq() == x.sq() * x + _b.B2, "isogeny image off E2"
+
+
+_selfcheck()

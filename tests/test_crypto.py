@@ -139,3 +139,79 @@ def test_native_recover_matches_python():
     assert ok == b"\x01" * n
     for i in range(n):
         assert addrs[20 * i:20 * i + 20] == S.priv_to_address(privs[i])
+
+
+# ---------------------------------------------------------- RFC 9380 SSWU
+
+def test_sswu_points_on_isogenous_curve():
+    """Fresh-randomness re-run of the h2c import self-check: SSWU
+    outputs satisfy E' (y^2 = x^3 + 240i*x + 1012(1+i)), isogeny
+    images satisfy E2 (y^2 = x^3 + 4(1+i))."""
+    import os as _os
+    from coreth_tpu.crypto import h2c
+    h2c._selfcheck(n=6, seed=_os.urandom(8))
+
+
+def test_hash_to_g2_subgroup_and_determinism():
+    from coreth_tpu.crypto import bls, h2c
+    p1 = h2c.hash_to_g2(b"warp message")
+    p2 = h2c.hash_to_g2(b"warp message")
+    p3 = h2c.hash_to_g2(b"other message")
+    assert p1 == p2
+    assert p1 != p3
+    # cofactor-cleared output lies in the r-torsion subgroup
+    assert bls.g2_mul(p1, bls.R) is None
+    # domain separation: same msg, different DST -> different point
+    p4 = h2c.hash_to_g2(b"warp message", h2c.DST_POP)
+    assert p4 != p1
+
+
+def test_expand_message_xmd_shape_and_separation():
+    from coreth_tpu.crypto.h2c import expand_message_xmd
+    out = expand_message_xmd(b"abc", b"DST", 256)
+    assert len(out) == 256
+    assert expand_message_xmd(b"abc", b"DST", 256) == out
+    assert expand_message_xmd(b"abc", b"DST2", 256) != out
+    assert expand_message_xmd(b"abd", b"DST", 256) != out
+    # prefix property does NOT hold across lengths (l_i_b is hashed in)
+    assert expand_message_xmd(b"abc", b"DST", 128) != out[:128]
+
+
+def test_sswu_exceptional_zero_input():
+    """u = 0 hits the tv2 == 0 exceptional branch (x = B/(Z*A)) and
+    must still produce a valid curve point."""
+    from coreth_tpu.crypto import bls, h2c
+    x, y = h2c.sswu(bls.Fq2(0, 0))
+    assert y.sq() == h2c._g_iso(x)
+    xi, yi = h2c.iso3((x, y))
+    assert yi.sq() == xi.sq() * xi + bls.B2
+
+
+def test_bls_sign_verify_aggregate_with_sswu():
+    from coreth_tpu.crypto import bls
+    sks = [bls.secret_from_bytes(bytes([i]) * 8) for i in range(1, 5)]
+    pks = [bls.public_key(sk) for sk in sks]
+    msg = b"sswu end to end"
+    sigs = [bls.sign(sk, msg) for sk in sks]
+    for pk, sig in zip(pks, sigs):
+        assert bls.verify(pk, msg, sig)
+    agg = bls.aggregate_signatures(sigs)
+    assert bls.verify_aggregate(pks, msg, agg)
+    assert not bls.verify_aggregate(pks, b"tampered", agg)
+
+
+def test_rfc9380_known_answer_vectors():
+    """RFC 9380 Appendix J.10.1 (BLS12381G2_XMD:SHA-256_SSWU_RO_),
+    DST "QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_": the
+    published hash_to_curve outputs for msg="" and msg="abc",
+    byte-for-byte — wire compatibility with every conforming
+    implementation (blst included) hangs on these."""
+    from coreth_tpu.crypto import h2c
+    dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    x, y = h2c.hash_to_g2(b"", dst)
+    assert x[0] == 0x0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a  # noqa: E501
+    assert x[1] == 0x05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d  # noqa: E501
+    assert y[0] == 0x0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92  # noqa: E501
+    assert y[1] == 0x12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6  # noqa: E501
+    x, y = h2c.hash_to_g2(b"abc", dst)
+    assert x[0] == 0x02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbec7780ccc7954725f4168aff2787776e6  # noqa: E501
