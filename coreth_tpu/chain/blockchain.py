@@ -164,14 +164,17 @@ class BlockChain:
         flushed_height = flushed_height or 0
         if self._want_snapshots:
             # rebuild the flat state at the on-disk base (snapshot
-            # Rebuild, snapshot.go:745); tail re-execution below adds
-            # diff layers on top through insert_block
+            # Rebuild, snapshot.go:745) on a BACKGROUND thread
+            # (generate.go): the reopened node serves immediately,
+            # reads above the marker fall through to the trie; tail
+            # re-execution below adds diff layers on top concurrently
+            from coreth_tpu.state.snapshot import Tree
             base_root = flushed_root if flushed_root is not None \
                 else g.root
             base_hash = schema.read_canonical_hash(
                 self.chain_kv, flushed_height) or g.hash()
-            self.snaps = generate_from_trie(self.db, base_root,
-                                            base_hash)
+            self.snaps = Tree(base_root, base_hash)
+            self.snaps.rebuild(self.db, base_root, base_hash)
         # walk the canonical chain from the last flushed state forward,
         # re-executing into memory (insert_block reads parent state
         # through the disk-backed node dict)

@@ -1,0 +1,56 @@
+"""Cross-process AppRequest/AppGossip transport over a peer VM's unix
+socket.
+
+The reference's peer.Network (peer/network.go:41) rides AvalancheGo's
+TLS p2p stack between validator PROCESSES; the in-process AppNetwork
+(peer/network.py) simulates only the routing.  This module supplies
+the real process boundary for this framework's seam: a SocketPeer
+speaks the same JSON-frame wire protocol as the rpcchainvm socket
+(plugin/service.py) and carries sync requests, warp signature
+requests, and tx gossip to a VM living in another OS process
+(exercised by tests/test_two_process.py, the role of reference
+plugin/evm/syncervm_test.go:621 with an actual process boundary).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class SocketPeer:
+    """bytes -> bytes AppRequest client against a remote VM process."""
+
+    def __init__(self, path: str):
+        from coreth_tpu.plugin.service import VMClient
+        self.path = path
+        self._client = VMClient(path)
+
+    # the peer.NetworkClient seam (sync/client.py transport contract)
+    def send_request(self, payload: bytes) -> bytes:
+        out = self._client.call("appRequest", payload=payload.hex())
+        return bytes.fromhex(out["response"])
+
+    # single-peer topology: any == the one peer
+    send_request_any = send_request
+
+    def gossip(self, payload: bytes) -> int:
+        self._client.call("appGossip", payload=payload.hex())
+        return 1
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class MultiPeer:
+    """Fan-out gossip adapter over several SocketPeers (the push side
+    of gossiper.go across process boundaries)."""
+
+    def __init__(self, peers: List[SocketPeer]):
+        self.peers = peers
+
+    def gossip(self, payload: bytes) -> int:
+        n = 0
+        for p in self.peers:
+            p.gossip(payload)
+            n += 1
+        return n

@@ -51,6 +51,18 @@ class VMServer:
         # VM's internal locks; this VM has none, so serialize here
         self._lock = threading.Lock()
         self._cpu_profiler = None
+        # cross-process app network state (appRequest/appGossip seam)
+        self._app_handler = None
+        self._peers: list = []
+        self._gossiper = None
+
+    def _inbound_gossiper(self):
+        if self._gossiper is None:
+            from coreth_tpu.plugin.gossiper import Gossiper
+            self._gossiper = Gossiper(
+                None, self.vm.txpool,
+                atomic_mempool=getattr(self.vm, "atomic_mempool", None))
+        return self._gossiper
 
     # ------------------------------------------------------------ dispatch
     def handle(self, method: str, params: dict):
@@ -122,6 +134,47 @@ class VMServer:
                     vm.to_engine.popleft() if vm.to_engine else None}
         if method == "health":
             return vm.health()
+        # ---- cross-process app network (peer/socket_transport.py):
+        # the AppRequest/AppGossip seam served over THIS process's
+        # socket, so sync/warp/gossip flow between VM processes
+        if method == "appRequest":
+            if self._app_handler is None:
+                self._app_handler = vm.app_request_handler()
+            resp = self._app_handler(bytes.fromhex(params["payload"]))
+            return {"response": resp.hex()}
+        if method == "appGossip":
+            self._inbound_gossiper().handle_gossip(
+                bytes.fromhex(params["payload"]))
+            return {}
+        if method == "connectPeer":
+            from coreth_tpu.peer.socket_transport import SocketPeer
+            self._peers.append(SocketPeer(params["path"]))
+            return {"peers": len(self._peers)}
+        if method == "getLastStateSummary":
+            summary = vm.state_sync_server.get_last_state_summary()
+            return {"summary": summary.encode().hex()}
+        if method == "stateSyncFromPeer":
+            # sync this VM from the last connected peer: fetch the
+            # peer's latest summary over its socket, then run the full
+            # syncervm client against the cross-process transport
+            peer = self._peers[-1]
+            raw = bytes.fromhex(peer._client.call(
+                "getLastStateSummary")["summary"])
+            client = vm.state_sync_client(peer.send_request)
+            client.accept_summary(client.parse_state_summary(raw))
+            return {"height": vm.chain.last_accepted.number,
+                    "stats": client.stats}
+        if method == "getBlockByHeight":
+            blk = vm.chain.get_block_by_number(int(params["height"]))
+            return {"bytes": blk.encode().hex()}
+        if method == "gossipTx":
+            from coreth_tpu.peer.socket_transport import MultiPeer
+            from coreth_tpu.plugin.gossiper import Gossiper
+            from coreth_tpu.types import Transaction as _Tx
+            g = Gossiper(MultiPeer(self._peers), vm.txpool)
+            n = g.gossip_txs(
+                [_Tx.decode(bytes.fromhex(params["tx"]))])
+            return {"gossiped": n}
         # admin.* (plugin/evm/admin.go surface): profiling control,
         # log level, live VM config
         if method == "admin.startCPUProfiler":

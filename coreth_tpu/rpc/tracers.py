@@ -64,9 +64,102 @@ class CallTracer(Tracer):
         return self.root or {}
 
 
+class FourByteTracer(Tracer):
+    """Selector census (eth/tracers/native/4byte.go): counts
+    'selector-calldatasize' pairs over the top-level call and every
+    nested CALL-family frame."""
+
+    def __init__(self):
+        self.counts: dict = {}
+
+    def _note(self, input_: bytes):
+        if len(input_) >= 4:
+            key = "0x" + input_[:4].hex() + "-" + str(len(input_) - 4)
+            self.counts[key] = self.counts.get(key, 0) + 1
+
+    def capture_start(self, evm, origin, to, create, input_, gas, value):
+        if not create:
+            self._note(input_)
+
+    def capture_enter(self, op, caller, to, input_, gas, value):
+        if op in (0xF1, 0xF2, 0xF4, 0xFA):  # CALL family only
+            self._note(input_)
+
+    def result(self) -> dict:
+        return self.counts
+
+
+class PrestateTracer(Tracer):
+    """Pre-transaction state of every account the tx touches
+    (eth/tracers/native/prestate.go): balance/nonce/code plus the
+    storage slots read or written, each captured at FIRST touch."""
+
+    def __init__(self):
+        self._db = None
+        self._accounts: dict = {}
+
+    def _lookup(self, addr: bytes) -> dict:
+        acct = self._accounts.get(addr)
+        if acct is None:
+            acct = {
+                "balance": hex(self._db.get_balance(addr)),
+                "nonce": self._db.get_nonce(addr),
+                "storage": {},
+            }
+            code = self._db.get_code(addr)
+            if code:
+                acct["code"] = "0x" + code.hex()
+            self._accounts[addr] = acct
+        return acct
+
+    def capture_start(self, evm, origin, to, create, input_, gas, value):
+        self._db = evm.statedb
+        self._coinbase = evm.block_ctx.coinbase
+        self._lookup(origin)
+        self._lookup(to)
+        self._lookup(self._coinbase)
+
+    def capture_enter(self, op, caller, to, input_, gas, value):
+        self._lookup(to)
+
+    def capture_state(self, pc, op, gas, cost, frame, stack,
+                      return_data, depth):
+        if not stack:
+            return
+        if op in (0x54, 0x55):          # SLOAD / SSTORE: slot pre-value
+            key = (stack[-1] % (1 << 256)).to_bytes(32, "big")
+            acct = self._lookup(frame.address)
+            kh = "0x" + key.hex()
+            if kh not in acct["storage"]:
+                acct["storage"][kh] = "0x" + self._db.get_state(
+                    frame.address, key).hex()
+        elif op in (0x31, 0x3B, 0x3C, 0x3F):  # BALANCE/EXTCODE*
+            addr = (stack[-1] % (1 << 160)).to_bytes(20, "big")
+            self._lookup(addr)
+        elif op in (0xF1, 0xF2, 0xF4, 0xFA) and len(stack) >= 2:
+            addr = (stack[-2] % (1 << 160)).to_bytes(20, "big")
+            self._lookup(addr)
+        elif op == 0xFF:                # SELFDESTRUCT beneficiary
+            addr = (stack[-1] % (1 << 160)).to_bytes(20, "big")
+            self._lookup(addr)
+
+    def result(self) -> dict:
+        out = {}
+        for addr, acct in self._accounts.items():
+            entry = dict(acct)
+            if not entry["storage"]:
+                entry.pop("storage")
+            out["0x" + addr.hex()] = entry
+        return out
+
+
 def _make_tracer(options: Optional[dict]):
     options = options or {}
     name = options.get("tracer")
+    if name == "4byteTracer":
+        return FourByteTracer()
+    if name == "prestateTracer":
+        return PrestateTracer()
     if name in (None, "", "structLogger"):
         return StructLogger(limit=int(options.get("limit", 0)))
     if name == "callTracer":

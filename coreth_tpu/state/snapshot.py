@@ -32,18 +32,49 @@ class SnapshotError(Exception):
 class DiskLayer:
     """The base flat state (disklayer.go role).  Storage is two-level
     (addr_hash -> slot_hash -> value) so destructing an account is one
-    pop, not a scan of every slot on disk."""
+    pop, not a scan of every slot on disk.
+
+    While a background rebuild runs (generate.go role), ``gen_marker``
+    holds the hashed key the generator has reached: reads at or above
+    it fall through to the state trie (``_fallback``), so a node that
+    lost its snapshot serves correct state immediately and gets O(1)
+    reads progressively."""
 
     def __init__(self, root: bytes):
         self.root = root
         self.accounts: Dict[bytes, bytes] = {}   # keccak(addr) -> RLP
         self.storage: Dict[bytes, Dict[bytes, bytes]] = {}
+        self.gen_marker: Optional[bytes] = None  # None = complete
+        self._fallback = None                    # (node_db, state_root)
+        # keys written by flatten() while the generator runs: the
+        # generator must not clobber them with older trie values
+        self._gen_overrides: set = set()
+
+    def _covered(self, addr_hash: bytes) -> bool:
+        return self.gen_marker is None or addr_hash < self.gen_marker \
+            or addr_hash in self._gen_overrides
+
+    def _trie_account(self, addr_hash: bytes) -> Optional[bytes]:
+        from coreth_tpu.mpt.trie import Trie
+        node_db, root = self._fallback
+        return Trie(root_hash=root, db=node_db).get(addr_hash)
 
     def account(self, addr_hash: bytes) -> Optional[bytes]:
+        if not self._covered(addr_hash):
+            return self._trie_account(addr_hash)
         return self.accounts.get(addr_hash)
 
     def storage_slot(self, addr_hash: bytes,
                      slot_hash: bytes) -> Optional[bytes]:
+        if not self._covered(addr_hash):
+            from coreth_tpu.mpt.trie import Trie
+            from coreth_tpu.types import StateAccount
+            raw = self._trie_account(addr_hash)
+            if raw is None:
+                return None
+            acct = StateAccount.from_rlp(raw)
+            node_db, _ = self._fallback
+            return Trie(root_hash=acct.root, db=node_db).get(slot_hash)
         sub = self.storage.get(addr_hash)
         return sub.get(slot_hash) if sub is not None else None
 
@@ -156,16 +187,26 @@ class Tree:
             while isinstance(node, DiffLayer):
                 chain.append(node)
                 node = node.parent
+            generating = self.disk.gen_marker is not None
             for diff in reversed(chain):
                 for ah in diff.destructs:
                     self.disk.storage.pop(ah, None)
+                    if generating:
+                        self.disk._gen_overrides.add(ah)
                 for ah, v in diff.accounts.items():
+                    if generating:
+                        # flattened values are NEWER than whatever the
+                        # generator would read from the rebuild-root
+                        # trie; mark so it skips these accounts
+                        self.disk._gen_overrides.add(ah)
                     if v == DELETED:
                         self.disk.accounts.pop(ah, None)
                         self.disk.storage.pop(ah, None)
                     else:
                         self.disk.accounts[ah] = v
                 for (ah, sh), v in diff.storage.items():
+                    if generating:
+                        self.disk._gen_overrides.add(ah)
                     if v == DELETED:
                         sub = self.disk.storage.get(ah)
                         if sub is not None:
@@ -198,6 +239,77 @@ class Tree:
                         and l.parent.block_hash == block_hash:
                     l.parent = self.disk
             self.layers = survivors
+
+
+    # --------------------------------------------------- background gen
+    def rebuild(self, db, state_root: bytes, block_hash: bytes,
+                batch: int = 256) -> threading.Thread:
+        """Rebuild the disk layer from the state trie on a WORKER
+        thread (generate.go role): a node that lost its snapshot
+        serves immediately — reads above the generation marker fall
+        through to the trie — while the flat state fills in key order.
+        Diff layers may stack and flatten concurrently; values they
+        land are protected from the generator via the override set.
+        Returns the worker thread (join it, or wait_generated())."""
+        from coreth_tpu.mpt.iterator import leaves
+        from coreth_tpu.mpt.trie import Trie
+        from coreth_tpu.types import StateAccount
+        from coreth_tpu.types.account import EMPTY_ROOT_HASH
+
+        with self._lock:
+            disk = DiskLayer(state_root)
+            disk.gen_marker = b""          # nothing covered yet
+            disk._fallback = (db.node_db, state_root)
+            self.disk = disk
+            self.disk_block = block_hash
+            self.layers = {}
+
+        def worker():
+            account_trie = Trie(root_hash=state_root, db=db.node_db)
+            pending = []
+            for addr_hash, raw in leaves(account_trie):
+                pending.append((addr_hash, raw))
+                if len(pending) >= batch:
+                    self._apply_generated(db, disk, pending)
+                    pending = []
+            self._apply_generated(db, disk, pending)
+            with self._lock:
+                disk.gen_marker = None
+                disk._fallback = None
+                disk._gen_overrides = set()
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="snapshot-generator")
+        t.start()
+        self._gen_thread = t
+        return t
+
+    def _apply_generated(self, db, disk: DiskLayer, items) -> None:
+        from coreth_tpu.mpt.iterator import leaves
+        from coreth_tpu.mpt.trie import Trie
+        from coreth_tpu.types import StateAccount
+        from coreth_tpu.types.account import EMPTY_ROOT_HASH
+        if not items:
+            return
+        with self._lock:
+            for addr_hash, raw in items:
+                if addr_hash in disk._gen_overrides:
+                    continue  # flatten landed newer data
+                disk.accounts[addr_hash] = raw
+                acct = StateAccount.from_rlp(raw)
+                if acct.root != EMPTY_ROOT_HASH:
+                    st = Trie(root_hash=acct.root, db=db.node_db)
+                    sub = disk.storage.setdefault(addr_hash, {})
+                    for slot_hash, v in leaves(st):
+                        sub[slot_hash] = v
+            disk.gen_marker = items[-1][0] + b"\x01"
+
+    def wait_generated(self, timeout: float = 60.0) -> None:
+        t = getattr(self, "_gen_thread", None)
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise SnapshotError("snapshot generation timed out")
 
 
 # ----------------------------------------------------------- generation

@@ -34,10 +34,24 @@ class SyncError(Exception):
 
 class StateSyncer:
     def __init__(self, client: SyncClient, db: Optional[Database] = None,
-                 page: int = 1024, progress: Optional[dict] = None):
+                 page: int = 1024, progress: Optional[dict] = None,
+                 workers: int = 4, client_factory=None):
+        """workers: storage tries download on a thread pool (the
+        reference's per-segment leaf-syncer concurrency,
+        sync/statesync/trie_segments.go + leaf_syncer.go).
+        client_factory: () -> SyncClient giving each worker its own
+        request stream (required for transports that are not
+        thread-safe, e.g. one socket); with None, workers share
+        `client` under a lock — latency still overlaps with local
+        trie-building work."""
+        import threading
         self.client = client
         self.db = db or Database()
         self.page = page
+        self.workers = max(1, workers)
+        self.client_factory = client_factory
+        self._client_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
         # progress markers: {"account_pos": key|b"done",
         #                    "storage": {root_hex: pos|b"done"},
         #                    "codes": set of fetched hex hashes}
@@ -49,9 +63,18 @@ class StateSyncer:
                       "storage_tries": 0, "codes": 0, "pages": 0}
 
     # ------------------------------------------------------------ sub-syncs
-    def _sync_trie(self, root: bytes, pos_get, pos_set):
+    def _get_leafs(self, client, root, pos):
+        """One verified range request; a shared client serializes via
+        the lock, per-worker clients go straight through."""
+        if client is not self.client:
+            return client.get_leafs(root, start=pos, limit=self.page)
+        with self._client_lock:
+            return client.get_leafs(root, start=pos, limit=self.page)
+
+    def _sync_trie(self, root: bytes, pos_get, pos_set, client=None):
         """Pull one trie by verified ranges into a local Trie backed by
         the shared node store; returns (trie, leaf_count), committed."""
+        client = client or self.client
         # the done-marker is only trusted when the root is actually
         # resident in THIS db — a progress dict paired with a fresh
         # Database (or a crash before commit) re-syncs instead of
@@ -67,9 +90,9 @@ class StateSyncer:
         pos = ZERO_KEY
         count = 0
         while True:
-            keys, vals, more = self.client.get_leafs(
-                root, start=pos, limit=self.page)
-            self.stats["pages"] += 1
+            keys, vals, more = self._get_leafs(client, root, pos)
+            with self._stats_lock:
+                self.stats["pages"] += 1
             for k, v in zip(keys, vals):
                 t.update(k, v)
             count += len(keys)
@@ -114,7 +137,13 @@ class StateSyncer:
                 seen_code.add(acct.code_hash)
                 code_hashes.append(acct.code_hash)
 
-        for root in storage_roots:
+        # storage tries are independent: download them on a worker
+        # pool (trie_segments.go / leaf_syncer.go concurrency).  Each
+        # worker gets its own client when a factory is supplied; the
+        # node store is the shared Python dict (GIL-atomic writes,
+        # disjoint tries commit disjoint node sets + shared subtrees
+        # write identical bytes).
+        def one(root, client):
             key = root.hex()
 
             def pos_get(key=key):
@@ -123,9 +152,26 @@ class StateSyncer:
             def pos_set(v, key=key):
                 storage_progress[key] = v
 
-            _st, n = self._sync_trie(root, pos_get, pos_set)
-            self.stats["storage_tries"] += 1
-            self.stats["storage_leafs"] += n
+            _st, n = self._sync_trie(root, pos_get, pos_set,
+                                     client=client)
+            with self._stats_lock:
+                self.stats["storage_tries"] += 1
+                self.stats["storage_leafs"] += n
+
+        nworkers = min(self.workers, max(1, len(storage_roots)))
+        if nworkers <= 1 or len(storage_roots) <= 1:
+            for root in storage_roots:
+                one(root, self.client)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            clients = [self.client_factory() if self.client_factory
+                       else self.client for _ in range(nworkers)]
+            with ThreadPoolExecutor(max_workers=nworkers) as pool:
+                futs = [pool.submit(one, root,
+                                    clients[i % nworkers])
+                        for i, root in enumerate(storage_roots)]
+                for f in futs:
+                    f.result()  # propagate SyncError
 
         todo = [h for h in code_hashes
                 if h.hex() not in self.progress["codes"]]

@@ -174,6 +174,27 @@ def test_debug_tracers(stack):
     assert res["type"] == "CALL"
 
 
+def test_prestate_and_4byte_tracers(stack):
+    server, backend, chain, blocks = stack
+    tx = blocks[1].transactions[0]  # an erc20 transfer() call
+    h = "0x" + tx.hash().hex()
+    # 4byteTracer: exactly the transfer selector with 64 arg bytes
+    counts = call(server, "debug_traceTransaction", h,
+                  {"tracer": "4byteTracer"})
+    assert counts == {"0xa9059cbb-64": 1}
+    # prestateTracer: sender, token (with code + touched slots),
+    # coinbase all captured with pre-tx values
+    pre = call(server, "debug_traceTransaction", h,
+               {"tracer": "prestateTracer"})
+    token_key = "0x" + TOKEN.hex()
+    assert token_key in pre
+    assert pre[token_key]["code"].startswith("0x6000")
+    assert len(pre[token_key]["storage"]) == 2   # from + to balance slots
+    sender = "0x" + ADDR.hex()
+    assert sender in pre
+    assert int(pre[sender]["balance"], 16) > 0
+
+
 def test_http_round_trip_and_batch(stack):
     server, backend, chain, blocks = stack
     port = server.serve_http()

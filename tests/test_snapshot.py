@@ -169,3 +169,99 @@ def test_destruct_resurrect_masks_old_storage():
     tree.flatten(b"\xAA" * 32)
     assert tree.disk.account(ah) == b"\xc0"
     assert tree.disk.storage_slot(ah, sh) is None
+
+
+# ------------------------------------------------- background generation
+
+def test_background_rebuild_matches_synchronous():
+    """Tree.rebuild on a worker thread converges to exactly the flat
+    state generate_from_trie builds synchronously (generate.go role)."""
+    db, root = build_state()
+    sync_tree = generate_from_trie(db, root, b"\x01" * 32)
+    bg = Tree(root, b"\x01" * 32)
+    bg.rebuild(db, root, b"\x01" * 32, batch=3)
+    bg.wait_generated()
+    assert bg.disk.gen_marker is None
+    assert bg.disk.accounts == sync_tree.disk.accounts
+    assert bg.disk.storage == sync_tree.disk.storage
+
+
+def test_reads_fall_through_during_generation():
+    """Reads above the generation marker serve from the trie; below it
+    from the flat state — both exactly (the GeneratingLayer seam)."""
+    from coreth_tpu.state.snapshot import DiskLayer
+    db, root = build_state()
+    disk = DiskLayer(root)
+    disk.gen_marker = b""              # nothing covered: all fall back
+    disk._fallback = (db.node_db, root)
+    plain = StateDB(root, db)
+    for a in ADDRS:
+        ah = keccak256(a)
+        got = disk.account(ah)
+        assert got is not None
+        fast = StateDB(root, db, snap=disk)
+        assert fast.get_balance(a) == plain.get_balance(a)
+        assert fast.get_state(TOKEN, balance_slot(a)) == \
+            plain.get_state(TOKEN, balance_slot(a))
+    # absent account / slot still read as absent through the fallback
+    assert disk.account(b"\xfe" * 32) is None
+
+
+def test_flatten_during_generation_wins():
+    """A diff layer flattened while the generator runs must survive:
+    the generator may not clobber newer flattened values with older
+    trie data (the override set)."""
+    db, root = build_state()
+    tree = Tree(root, b"\x01" * 32)
+    # seed overrides by flattening BEFORE letting a (slow) generator
+    # run: simulate by rebuilding with a tiny batch, then immediately
+    # stacking + flattening a block that rewrites an account
+    tree.rebuild(db, root, b"\x01" * 32, batch=1)
+    ah = keccak256(ADDRS[0])
+    newer = b"\x99newer-account-rlp"
+    tree.update(b"\x02" * 32, b"\x01" * 32, b"\x22" * 32,
+                {ah: newer}, {})
+    tree.flatten(b"\x02" * 32)
+    tree.wait_generated()
+    assert tree.disk.accounts[ah] == newer
+
+
+def test_chain_reopen_background_generation():
+    """A KV-backed chain reopened after accepts regenerates its
+    snapshot in the background and serves identical state."""
+    import tempfile
+    from coreth_tpu.chain import BlockChain
+    from coreth_tpu.rawdb.kv import FileDB
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    keys = [0x4400 + i for i in range(3)]
+    addrs = [priv_to_address(k) for k in keys]
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc={a: GenesisAccount(balance=10**21)
+                             for a in addrs})
+    with tempfile.TemporaryDirectory() as td:
+        kv = FileDB(os.path.join(td, "chain"))
+        chain = BlockChain(genesis, chain_kv=kv)
+        from coreth_tpu.chain import generate_chain as _gen
+        from coreth_tpu.state import Database as _DB
+        db2 = _DB()
+        g2 = genesis.to_block(db2)
+
+        def gen(i, bg):
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=i, gas_tip_cap_=10**9,
+                gas_fee_cap_=300 * 10**9, gas=21_000,
+                to=addrs[1], value=777), keys[0], CFG.chain_id))
+
+        blocks, _ = _gen(CFG, g2, db2, 3, gen, gap=2)
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b.hash())
+        chain.close()
+        # reopen: background rebuild kicks off inside _load_last_state
+        kv2 = FileDB(os.path.join(td, "chain"))
+        chain2 = BlockChain(genesis, chain_kv=kv2)
+        assert chain2.snaps is not None
+        chain2.snaps.wait_generated()
+        state = chain2.state_at(chain2.last_accepted.root)
+        assert state.get_balance(addrs[1]) == 10**21 + 3 * 777
+        chain2.close()

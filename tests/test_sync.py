@@ -217,3 +217,31 @@ def test_cross_chain_eth_call_over_network():
     bad = client.send_request_any(
         EthCallRequest(to=token, data=b"\xde\xad\xbe\xef").encode())
     assert decode_message(bad).error != ""
+
+
+def test_concurrent_storage_workers_identical_result():
+    """Storage tries downloaded by a 4-worker pool (per-worker
+    clients) produce exactly the single-worker database — node sets,
+    stats, codes (trie_segments.go / leaf_syncer.go concurrency)."""
+    # several token contracts -> several independent storage tries
+    alloc = {a: GenesisAccount(balance=10**20 + i)
+             for i, a in enumerate(ADDRS)}
+    for c in range(6):
+        alloc[bytes([0x7A + c]) * 20] = token_genesis_account(
+            {a: 10**15 + c * 1000 + i for i, a in enumerate(ADDRS)})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    src_db = Database()
+    root = genesis.to_block(src_db).root
+    handler = SyncHandler(src_db)
+    single = StateSyncer(SyncClient(handler.handle), workers=1,
+                         page=16)
+    db1 = single.sync(root)
+    multi = StateSyncer(SyncClient(handler.handle), workers=4,
+                        page=16,
+                        client_factory=lambda: SyncClient(
+                            handler.handle))
+    db4 = multi.sync(root)
+    assert single.stats["storage_tries"] == 6
+    assert multi.stats == single.stats
+    assert set(db1.node_db.keys()) == set(db4.node_db.keys())
+    assert db1.code_db == db4.code_db

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Mesh scaling curve: engine replay txs/s at n_devices in {1,2,4,8}
+on the VIRTUAL CPU mesh (round-4 verdict #8 — attach a number to the
+psum_scatter design in parallel/mesh.py).
+
+CAVEAT, recorded in the output: virtual CPU devices all live on ONE
+host core, so the collectives are memcpy emulations and the curve
+measures SHARDING OVERHEAD, not ICI speedup — on real multi-chip
+hardware the dp-sharded segment sums scale with chip count while this
+harness can only show that the sharded program stays correct and how
+much partitioning costs when the hardware underneath is serial.
+
+Writes MULTICHIP_SCALING.json at the repo root and prints it.
+"""
+
+import json
+import os
+import sys
+import time
+
+_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _DIR)
+
+N_MAX = 8
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={N_MAX}"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_DIR, "tests", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from coreth_tpu.chain import Genesis, GenesisAccount, generate_chain  # noqa: E402
+from coreth_tpu.crypto.secp256k1 import priv_to_address  # noqa: E402
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG  # noqa: E402
+from coreth_tpu.parallel import make_mesh  # noqa: E402
+from coreth_tpu.replay import ReplayEngine  # noqa: E402
+from coreth_tpu.state import Database  # noqa: E402
+from coreth_tpu.types import Block, DynamicFeeTx, sign_tx  # noqa: E402
+
+GWEI = 10**9
+TXS = int(os.environ.get("SCALE_TXS", "512"))
+N_BLOCKS = int(os.environ.get("SCALE_BLOCKS", "16"))
+REPS = int(os.environ.get("SCALE_REPS", "3"))
+
+
+def build_chain():
+    keys = [0xD00D + i for i in range(64)]
+    addrs = [priv_to_address(k) for k in keys]
+    genesis = Genesis(config=CFG, gas_limit=30_000_000,
+                      alloc={a: GenesisAccount(balance=10**27)
+                             for a in addrs})
+    db = Database()
+    g0 = genesis.to_block(db)
+    nonces = [0] * len(keys)
+
+    def gen(i, bg):
+        for j in range(TXS):
+            k = (i * TXS + j) % len(keys)
+            to = b"\xe0" + (i * TXS + j).to_bytes(4, "big") * 4 \
+                + b"\xe0" * 3
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=GWEI, gas_fee_cap_=2000 * GWEI,
+                gas=21_000, to=to, value=10**12 + j),
+                keys[k], CFG.chain_id))
+            nonces[k] += 1
+
+    blocks, _ = generate_chain(CFG, g0, db, N_BLOCKS, gen, gap=10)
+    return genesis, [b.encode() for b in blocks]
+
+
+def run_once(genesis, wire, mesh):
+    blocks = [Block.decode(w) for w in wire]
+    db = Database()
+    gb = genesis.to_block(db)
+    eng = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                       capacity=4096, batch_pad=TXS, window=8,
+                       mesh=mesh)
+    t0 = time.monotonic()
+    root = eng.replay(blocks)
+    dt = time.monotonic() - t0
+    assert root == blocks[-1].header.root
+    assert eng.stats.blocks_fallback == 0
+    return N_BLOCKS * TXS / dt
+
+
+def main():
+    genesis, wire = build_chain()
+    devices = jax.devices("cpu")
+    result = {
+        "harness": "virtual CPU mesh (xla_force_host_platform_"
+                   "device_count) on ONE physical core",
+        "caveat": "collectives are host-memory emulations: this curve "
+                  "measures partitioning overhead and correctness, NOT "
+                  "ICI scaling; real multi-chip speedup requires real "
+                  "chips",
+        "workload": f"{N_BLOCKS} blocks x {TXS} transfer txs, "
+                    f"full ReplayEngine incl. sender recovery + trie",
+        "reps": REPS,
+        "points": [],
+    }
+    for n in (1, 2, 4, 8):
+        mesh = make_mesh(devices[:n]) if n > 1 else None
+        runs = []
+        for r in range(REPS + 1):
+            tps = run_once(genesis, wire, mesh)
+            if r > 0:          # rep 0 = compile warm-up
+                runs.append(tps)
+        runs.sort()
+        result["points"].append({
+            "n_devices": n,
+            "txs_s_median": round(runs[len(runs) // 2], 1),
+            "txs_s_spread": [round(runs[0], 1), round(runs[-1], 1)],
+        })
+        print(f"n={n}: {runs}", file=sys.stderr)
+    out = os.path.join(_DIR, "MULTICHIP_SCALING.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
