@@ -69,12 +69,12 @@ class AtomicMempool:
             owner = self._utxo_spenders.get(inp)
             if owner is not None and owner != tx_id:
                 conflicts.append(owner)
-        for owner in set(conflicts):
+        for owner in sorted(set(conflicts)):
             if owner in self._issued:
                 raise MempoolError("conflicts with an issued tx")
             if self._price[owner] >= price:
                 raise MempoolError("conflicting tx with higher fee known")
-        for owner in set(conflicts):
+        for owner in sorted(set(conflicts)):
             self._remove(owner)
         if len(self._txs) >= self.max_size:
             self._evict_cheapest(floor=price)

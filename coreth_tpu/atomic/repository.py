@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from coreth_tpu.atomic.tx import Tx
-from coreth_tpu.atomic.wire import Packer, Unpacker
+from coreth_tpu.wire import Packer, Unpacker
 
 _TX_PREFIX = b"atx"       # txID -> height(8) ++ tx bytes
 _HEIGHT_PREFIX = b"ath"   # height(8) -> packed list of tx bytes

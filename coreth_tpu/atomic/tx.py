@@ -16,7 +16,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from coreth_tpu.atomic.wire import (
+from coreth_tpu.wire import (
     CODEC_VERSION, Packer, TYPE_EXPORT_TX, TYPE_IMPORT_TX,
     TYPE_SECP_CREDENTIAL, TYPE_SECP_TRANSFER_INPUT,
     TYPE_SECP_TRANSFER_OUTPUT, Unpacker,

@@ -22,6 +22,7 @@ from coreth_tpu.consensus.engine import ConsensusError, DummyEngine
 from coreth_tpu.params import ChainConfig
 from coreth_tpu.processor.state_processor import Processor
 from coreth_tpu.state import Database, StateDB
+from coreth_tpu.mpt import StackTrie
 from coreth_tpu.types import Block, Receipt, create_bloom, derive_sha
 from coreth_tpu.types.block import calc_ext_data_hash
 
@@ -331,7 +332,7 @@ class BlockChain:
     def _validate_body(self, block: Block) -> None:
         """ValidateBody (block_validator.go): structural roots."""
         header = block.header
-        tx_root = derive_sha(block.transactions)
+        tx_root = derive_sha(block.transactions, StackTrie())
         if tx_root != header.tx_hash:
             raise BadBlockError(
                 f"tx root mismatch: {tx_root.hex()} != "
@@ -352,7 +353,7 @@ class BlockChain:
         bloom = create_bloom(receipts)
         if bloom != header.bloom:
             raise BadBlockError("bloom mismatch")
-        receipt_root = derive_sha(receipts)
+        receipt_root = derive_sha(receipts, StackTrie())
         if receipt_root != header.receipt_hash:
             raise BadBlockError(
                 f"receipt root mismatch: {receipt_root.hex()} != "
@@ -575,7 +576,7 @@ class BlockChain:
                 if self._acceptor_error is None:
                     self._accept_side_effects(entry)
                     self.acceptor_tip = entry.block
-            except BaseException as exc:  # surfaced on drain/close
+            except BaseException as exc:  # noqa: BLE001 — surfaced on drain/close; acceptor must record even SystemExit
                 self._acceptor_error = exc
             finally:
                 self._acceptor_queue.task_done()

@@ -41,7 +41,7 @@ class BlockGen:
         self.signer = LatestSigner(config.chain_id)
         self._used_gas = [0]
         self._evm: Optional[EVM] = None
-        from coreth_tpu.warp.predicate import PredicateResults
+        from coreth_tpu.predicate import PredicateResults
         self.predicate_results = PredicateResults()
 
     def set_coinbase(self, addr: bytes) -> None:
@@ -61,7 +61,7 @@ class BlockGen:
             ctx = new_block_context(
                 self.header, predicate_results=self.predicate_results)
             self._evm = EVM(ctx, TxContext(), self.statedb, self.config)
-        from coreth_tpu.warp.predicate import check_tx_predicates
+        from coreth_tpu.predicate import check_tx_predicates
         # rules resolved at add time: set_timestamp() may have moved
         # the block across a fork/activation boundary since __init__
         rules = self.config.rules(self.header.number, self.header.time)

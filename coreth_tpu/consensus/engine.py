@@ -13,6 +13,7 @@ from typing import Callable, List, Optional
 from coreth_tpu.consensus.dynamic_fees import (
     calc_base_fee, calc_block_gas_cost,
 )
+from coreth_tpu.mpt import StackTrie
 from coreth_tpu.params import ChainConfig
 from coreth_tpu.params import protocol as P
 from coreth_tpu.types import Block, Header, derive_sha, create_bloom
@@ -215,8 +216,8 @@ class DummyEngine:
                                   txs, receipts, contribution)
         header.root = statedb.intermediate_root(
             config.is_eip158(header.number))
-        header.tx_hash = derive_sha(txs)
-        header.receipt_hash = derive_sha(receipts)
+        header.tx_hash = derive_sha(txs, StackTrie())
+        header.receipt_hash = derive_sha(receipts, StackTrie())
         header.bloom = create_bloom(receipts)
         if config.is_apricot_phase1(header.time):
             header.ext_data_hash = calc_ext_data_hash(extra_data)

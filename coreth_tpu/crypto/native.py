@@ -25,7 +25,7 @@ def _build() -> bool:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_LIB_PATH)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — any build failure leaves the pure-py path active
         return False
 
 

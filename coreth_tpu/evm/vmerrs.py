@@ -1,85 +1,8 @@
-"""EVM error taxonomy (twin of reference vmerrs/vmerrs.go)."""
+"""Compatibility shim — the error taxonomy moved to ``coreth_tpu.vmerrs``.
 
+Mirrors the reference, where ``vmerrs/`` is a standalone top-level
+package precisely so ``precompile/`` can raise EVM errors without
+importing ``core/vm`` (see coreth vmerrs/vmerrs.go).
+"""
 
-class VMError(Exception):
-    """Base: consumes all remaining gas unless stated otherwise."""
-
-
-class ErrOutOfGas(VMError):
-    pass
-
-
-class ErrCodeStoreOutOfGas(VMError):
-    pass
-
-
-class ErrDepth(VMError):
-    pass
-
-
-class ErrInsufficientBalance(VMError):
-    pass
-
-
-class ErrContractAddressCollision(VMError):
-    pass
-
-
-class ErrExecutionReverted(VMError):
-    """REVERT opcode: remaining gas is returned to the caller."""
-
-
-class ErrMaxCodeSizeExceeded(VMError):
-    pass
-
-
-class ErrMaxInitCodeSizeExceeded(VMError):
-    pass
-
-
-class ErrInvalidJump(VMError):
-    pass
-
-
-class ErrWriteProtection(VMError):
-    pass
-
-
-class ErrReturnDataOutOfBounds(VMError):
-    pass
-
-
-class ErrGasUintOverflow(VMError):
-    pass
-
-
-class ErrInvalidCode(VMError):
-    """EIP-3541: new code starting with 0xEF."""
-
-
-class ErrNonceUintOverflow(VMError):
-    pass
-
-
-class ErrAddrProhibited(VMError):
-    """Avalanche: calls to the blackhole address are forbidden."""
-
-
-class ErrInvalidCoinID(VMError):
-    pass
-
-
-class ErrStackUnderflow(VMError):
-    pass
-
-
-class ErrStackOverflow(VMError):
-    pass
-
-
-class ErrInvalidOpCode(VMError):
-    pass
-
-
-class ErrToAddrProhibited6(VMError):
-    """ApricotPhase6: prohibited to-addresses for native asset call."""
+from coreth_tpu.vmerrs import *  # noqa: F401,F403

@@ -89,7 +89,7 @@ class Worker:
         checked per tx BEFORE execution and dropped again when the tx
         is dropped (worker.go:253/:264), keyed by the tx's final index
         in the block."""
-        from coreth_tpu.warp.predicate import (
+        from coreth_tpu.predicate import (
             PredicateResults, check_tx_predicates,
         )
         gas_pool = GasPool(header.gas_limit)

@@ -83,7 +83,7 @@ class PluginBlock:
         from coreth_tpu.plugin.block_verification import (
             BlockVerificationError,
         )
-        from coreth_tpu.warp.predicate import (
+        from coreth_tpu.predicate import (
             PredicateResults, check_tx_predicates,
             results_bytes_from_extra,
         )

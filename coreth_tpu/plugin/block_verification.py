@@ -14,6 +14,7 @@ from typing import Optional
 
 from coreth_tpu.evm.precompiles import BLACKHOLE_ADDR
 from coreth_tpu.params import protocol as P
+from coreth_tpu.mpt import StackTrie
 from coreth_tpu.types import derive_sha
 from coreth_tpu.types.block import EMPTY_UNCLE_HASH, calc_ext_data_hash
 
@@ -95,7 +96,7 @@ class SyntacticBlockValidator:
 
         # body hashes (:161-169); uncles are unsupported so the header
         # hash must be the canonical empty-list hash
-        if derive_sha(block.transactions) != header.tx_hash:
+        if derive_sha(block.transactions, StackTrie()) != header.tx_hash:
             _fail("tx hash mismatch")
         if block.uncles:
             _fail("uncles unsupported")

@@ -23,7 +23,7 @@ KIND_ATOMIC_TX = 1
 
 
 def _encode_gossip(kind: int, items: List[bytes]) -> bytes:
-    from coreth_tpu.atomic.wire import Packer
+    from coreth_tpu.wire import Packer
     p = Packer()
     p.u8(kind)
     p.u32(len(items))
@@ -33,7 +33,7 @@ def _encode_gossip(kind: int, items: List[bytes]) -> bytes:
 
 
 def _decode_gossip(data: bytes):
-    from coreth_tpu.atomic.wire import Unpacker
+    from coreth_tpu.wire import Unpacker
     u = Unpacker(data)
     kind = u.u8()
     return kind, [u.var_bytes() for _ in range(u.u32())]
@@ -114,7 +114,7 @@ class Gossiper:
             for raw in items:
                 try:
                     tx = AtomicTx.decode(raw)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — undecodable gossip is dropped
                     continue
                 if not self._seen(tx.id()):
                     try:

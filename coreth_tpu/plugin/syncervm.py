@@ -13,7 +13,7 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from coreth_tpu.crypto import keccak256
-from coreth_tpu.atomic.wire import Packer, Unpacker
+from coreth_tpu.wire import Packer, Unpacker
 
 # how many ancestor blocks the client fetches behind the summary
 # (syncervm_client.go parentsToGet = 256)

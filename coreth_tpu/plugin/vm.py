@@ -221,7 +221,7 @@ class VM:
         before initialize(); the module registry is global, so tests
         must disable_warp() when done."""
         from coreth_tpu.precompile.modules import register_module
-        from coreth_tpu.precompile.warp_contract import (
+        from coreth_tpu.warp.contract import (
             WarpConfig, make_warp_module,
         )
         from coreth_tpu.warp.backend import WarpBackend
@@ -235,7 +235,7 @@ class VM:
 
     def disable_warp(self) -> None:
         from coreth_tpu.precompile.modules import unregister_module
-        from coreth_tpu.precompile.warp_contract import WARP_ADDRESS
+        from coreth_tpu.warp.contract import WARP_ADDRESS
         unregister_module(WARP_ADDRESS)
         self.warp_backend = None
         self.warp_config = None
@@ -244,7 +244,7 @@ class VM:
         """Accepted-block hook (block.go:234 handlePrecompileAccept):
         every SendWarpMessage log in the accepted block lands in the
         warp backend, which can then sign it for aggregators."""
-        from coreth_tpu.precompile.warp_contract import (
+        from coreth_tpu.warp.contract import (
             SEND_WARP_MESSAGE_TOPIC, WARP_ADDRESS,
         )
         from coreth_tpu.warp.messages import UnsignedMessage
@@ -315,7 +315,7 @@ class VM:
             block = self.miner.generate_block()
             blk = PluginBlock(self, block)
             blk.verify()
-        except Exception:
+        except Exception:  # noqa: BLE001 — any build failure must unwind issued atomic txs
             # a failed build must not strand issued atomic txs: discard
             # them (onFinalizeAndAssemble-error semantics — the tx was
             # pulled and found unbuildable)

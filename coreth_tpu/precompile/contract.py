@@ -15,7 +15,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
 from coreth_tpu.crypto import keccak256
-from coreth_tpu.evm import vmerrs
+from coreth_tpu import vmerrs
 
 
 def selector(signature: str) -> bytes:

@@ -97,7 +97,7 @@ class Processor:
         # execution-time getVerifiedWarpMessage reads them
         predicate_results = None
         if self.config.is_durango(header.time):
-            from coreth_tpu.warp.predicate import (
+            from coreth_tpu.predicate import (
                 PredicateResults, results_bytes_from_extra,
             )
             raw = results_bytes_from_extra(header.extra)

@@ -10,7 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-from coreth_tpu.evm import vmerrs
+from coreth_tpu import vmerrs
 from coreth_tpu.evm.evm import EVM
 from coreth_tpu.evm.precompiles import BLACKHOLE_ADDR
 from coreth_tpu.params import Rules

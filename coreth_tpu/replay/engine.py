@@ -49,6 +49,7 @@ from coreth_tpu.workloads.erc20 import (
     TOKEN_CODE_HASH, TRANSFER_TOPIC, balance_slot,
     measure_transfer_exec_gas, parse_transfer_calldata,
 )
+from coreth_tpu.mpt import StackTrie
 from coreth_tpu.types import (
     Block, LatestSigner, Log, Receipt, StateAccount, Transaction,
     create_bloom, derive_sha,
@@ -77,7 +78,7 @@ def _has_accelerator() -> bool:
     try:
         import jax
         return jax.default_backend() != "cpu"
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — no/broken jax backend probe means CPU
         return False
 
 
@@ -1298,7 +1299,7 @@ class ReplayEngine:
                 gas_used=gas_list[i],
                 logs=[logs[i]] if logs[i] is not None else [])
                 for i, tx in enumerate(block.transactions)]
-            if derive_sha(receipts) != block.header.receipt_hash:
+            if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
                 raise ReplayError("receipt root mismatch")
             if create_bloom(receipts) != block.header.bloom:
                 raise ReplayError("bloom mismatch")
@@ -1520,7 +1521,7 @@ class ReplayEngine:
             block, parent, statedb)
         if used_gas != block.header.gas_used:
             raise ReplayError("gas used mismatch (fallback)")
-        if derive_sha(receipts) != block.header.receipt_hash:
+        if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
             raise ReplayError("receipt root mismatch (fallback)")
         root = statedb.intermediate_root(True)
         if root != block.header.root:

@@ -41,6 +41,7 @@ from coreth_tpu.params import protocol as P
 from coreth_tpu.processor.state_transition import (
     intrinsic_gas, is_prohibited,
 )
+from coreth_tpu.mpt import StackTrie
 from coreth_tpu.types import (
     Block, Log, Receipt, StateAccount, create_bloom, derive_sha,
 )
@@ -152,7 +153,7 @@ class MachineBlockExecutor:
         from coreth_tpu.evm.device.adapter import TxResult
         from coreth_tpu.evm.evm import (
             EVM, BlockContext, Config, TxContext)
-        from coreth_tpu.evm import vmerrs
+        from coreth_tpu import vmerrs
         from coreth_tpu.state import StateDB
         e = self.e
         rules = e.config.rules(block.number, block.time)
@@ -382,7 +383,7 @@ class MachineBlockExecutor:
                 cumulative_gas_used=cum, gas_used=used, logs=logs))
         if cum != block.header.gas_used:
             raise ReplayError("machine block: gas used mismatch")
-        if derive_sha(receipts) != block.header.receipt_hash:
+        if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
             raise ReplayError("machine block: receipt root mismatch")
         if create_bloom(receipts) != block.header.bloom:
             raise ReplayError("machine block: bloom mismatch")

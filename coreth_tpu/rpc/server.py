@@ -73,7 +73,7 @@ class RPCServer:
     def handle_raw(self, body: bytes) -> bytes:
         try:
             req = json.loads(body)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — malformed body becomes a PARSE_ERROR response
             return json.dumps(_err(None, PARSE_ERROR, "parse error")
                               ).encode()
         return json.dumps(self.handle_request(req)).encode()

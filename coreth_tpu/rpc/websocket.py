@@ -285,7 +285,7 @@ class WSServer:
     def _dispatch(self, payload: bytes, send):
         try:
             req = json.loads(payload)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — malformed frame becomes a parse-error response
             return {"jsonrpc": "2.0", "id": None,
                     "error": {"code": -32700, "message": "parse error"}}
         if not isinstance(req, dict):
@@ -375,6 +375,6 @@ class WSClient:
         try:
             self._file.write(_encode_frame(OP_CLOSE, b""))
             self._file.flush()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — close frame is best-effort on a dying socket
             pass
         self.sock.close()

@@ -304,7 +304,7 @@ class Tree:
                         sub[slot_hash] = v
             disk.gen_marker = items[-1][0] + b"\x01"
 
-    def wait_generated(self, timeout: float = 60.0) -> None:
+    def wait_generated(self, timeout: float = 60.0) -> None:  # noqa: DET001 — host-side thread-join wait, not consensus data
         t = getattr(self, "_gen_thread", None)
         if t is not None:
             t.join(timeout)

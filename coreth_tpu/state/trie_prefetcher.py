@@ -74,8 +74,8 @@ class TriePrefetcher:
                     self._tries[root] = trie
                 trie.get(key)  # resolves the path, pulling KV nodes
                 self.loaded += 1
-            except Exception:
-                pass  # missing/partial tries are fine; warming is best-effort
+            except Exception:  # noqa: BLE001 — missing/partial tries are fine; warming is best-effort
+                pass
             finally:
                 self._queue.task_done()
 

@@ -81,6 +81,7 @@ class SyncClient:
         # hash-chain + body-integrity checks: the block id only covers
         # the header, so the tx root and ext-data hash must also be
         # recomputed from the body (client.go parseBlocks semantics)
+        from coreth_tpu.mpt import StackTrie
         from coreth_tpu.types import Block, derive_sha
         from coreth_tpu.types.block import calc_ext_data_hash
         want = block_hash
@@ -91,7 +92,7 @@ class SyncClient:
                 raise SyncClientError(f"undecodable block: {e}") from None
             if b.hash() != want:
                 raise SyncClientError("block hash mismatch")
-            if derive_sha(b.transactions) != b.header.tx_hash:
+            if derive_sha(b.transactions, StackTrie()) != b.header.tx_hash:
                 raise SyncClientError("block tx root mismatch")
             if calc_ext_data_hash(b.ext_data()) != b.header.ext_data_hash:
                 raise SyncClientError("block ext-data hash mismatch")

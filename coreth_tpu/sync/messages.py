@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from coreth_tpu.atomic.wire import Packer, Unpacker
+from coreth_tpu.wire import Packer, Unpacker
 
 
 # LeafsRequest node types (message/leafs_request.go NodeType)

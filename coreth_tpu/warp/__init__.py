@@ -14,7 +14,7 @@ from coreth_tpu.warp.messages import (
 from coreth_tpu.warp.validators import Validator, ValidatorSet
 from coreth_tpu.warp.backend import WarpBackend
 from coreth_tpu.warp.aggregator import Aggregator, AggregateError
-from coreth_tpu.warp.predicate import (
+from coreth_tpu.predicate import (
     PredicateResults, pack_predicate, unpack_predicate,
 )
 

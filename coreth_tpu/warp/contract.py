@@ -17,7 +17,7 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from coreth_tpu.crypto import keccak256
-from coreth_tpu.evm import vmerrs
+from coreth_tpu import vmerrs
 from coreth_tpu.precompile.contract import (
     StatefulPrecompiledContract, abi_pack_bytes, abi_word, deduct_gas,
     selector,
@@ -26,7 +26,7 @@ from coreth_tpu.precompile.modules import Module
 from coreth_tpu.warp.messages import (
     AddressedCall, SignedMessage, UnsignedMessage,
 )
-from coreth_tpu.warp.predicate import (
+from coreth_tpu.predicate import (
     PredicateError, pack_predicate, unpack_predicate,
 )
 
@@ -172,7 +172,7 @@ def verify_block_predicates(config: WarpConfig, block, rules,
     verifyPredicates): for every tx access-list tuple addressed to the
     warp precompile, run VerifyPredicate and record failures in the
     per-tx results bitset."""
-    from coreth_tpu.warp.predicate import PredicateResults, slots_to_bytes
+    from coreth_tpu.predicate import PredicateResults, slots_to_bytes
     results = PredicateResults()
     for tx_index, tx in enumerate(block.transactions):
         per_addr: dict = {}

@@ -13,7 +13,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from coreth_tpu.atomic.wire import Packer, Unpacker
+from coreth_tpu.wire import Packer, Unpacker
 from coreth_tpu.crypto import bls
 
 

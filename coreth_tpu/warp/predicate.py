@@ -1,111 +1,19 @@
-"""Predicate byte packing + per-block results bitset.
+"""Compatibility shim — predicate packing moved to ``coreth_tpu.predicate``.
 
-Twin of reference predicate/ (predicate_bytes.go:29 PackPredicate —
-append the 0xff delimiter then zero-pad to 32-byte alignment so the
-bytes survive the access-list storage-key representation;
-predicate_results.go:44-84 — the per-tx bitset of FAILED predicates
-carried in the block for post-Durango verification).
+Mirrors the reference, where ``predicate/`` is a standalone low-level
+package (predicate_bytes.go, predicate_results.go) imported by core,
+miner, and the warp precompile alike; keeping it inside ``warp`` forced
+processor/chain/miner to import upward across the layer map.
 """
 
-from __future__ import annotations
-
-from typing import Dict, List
-
-DELIMITER = 0xFF
-CHUNK = 32
-
-
-class PredicateError(Exception):
-    pass
-
-
-def pack_predicate(data: bytes) -> bytes:
-    padded = data + bytes([DELIMITER])
-    if len(padded) % CHUNK:
-        padded += b"\x00" * (CHUNK - len(padded) % CHUNK)
-    return padded
-
-
-def unpack_predicate(packed: bytes) -> bytes:
-    if not packed or len(packed) % CHUNK:
-        raise PredicateError("predicate bytes not 32-byte aligned")
-    trimmed = packed.rstrip(b"\x00")
-    if not trimmed or trimmed[-1] != DELIMITER:
-        raise PredicateError("predicate delimiter missing")
-    return trimmed[:-1]
-
-
-def slots_to_bytes(slots: List[bytes]) -> bytes:
-    """Access-list storage keys -> packed predicate byte stream."""
-    return b"".join(slots)
-
-
-def results_bytes_from_extra(extra: bytes):
-    """Extract the predicate-results bytes carried after the 80-byte
-    dynamic-fee window in a post-Durango header Extra
-    (predicate.GetPredicateResultBytes)."""
-    from coreth_tpu.params import protocol as P
-    if len(extra) <= P.DYNAMIC_FEE_EXTRA_DATA_SIZE:
-        return None
-    return extra[P.DYNAMIC_FEE_EXTRA_DATA_SIZE:]
-
-
-def check_tx_predicates(rules, tx) -> Dict[bytes, bytes]:
-    """One tx's per-predicater-address failure bitsets
-    (core/predicate_check.go:30 CheckPredicates): group the tx's
-    access-list tuples by predicater address in order, verify each
-    tuple's packed predicate, set the bit on failure."""
-    out: Dict[bytes, bytes] = {}
-    if not rules.predicaters:
-        return out
-    per_addr: Dict[bytes, List[List[bytes]]] = {}
-    for addr, keys in (tx.access_list or []):
-        if addr in rules.predicaters:
-            per_addr.setdefault(addr, []).append(list(keys))
-    for addr, tuple_list in per_addr.items():
-        predicater = rules.predicaters[addr]
-        bits = bytearray((len(tuple_list) + 7) // 8)
-        for i, keys in enumerate(tuple_list):
-            if not predicater.verify_predicate(slots_to_bytes(keys)):
-                bits[i // 8] |= 1 << (i % 8)
-        out[addr] = bytes(bits)
-    return out
-
-
-class PredicateResults:
-    """txIndex -> per-predicate failure bitset (results.go)."""
-
-    def __init__(self):
-        self.results: Dict[int, Dict[bytes, bytes]] = {}
-
-    def set_result(self, tx_index: int, address: bytes,
-                   bitset: bytes) -> None:
-        self.results.setdefault(tx_index, {})[address] = bitset
-
-    def get_result(self, tx_index: int, address: bytes) -> bytes:
-        return self.results.get(tx_index, {}).get(address, b"")
-
-    def encode(self) -> bytes:
-        from coreth_tpu.atomic.wire import Packer
-        p = Packer()
-        p.u32(len(self.results))
-        for tx_index in sorted(self.results):
-            p.u32(tx_index)
-            entries = self.results[tx_index]
-            p.u32(len(entries))
-            for addr in sorted(entries):
-                p.fixed(addr, 20)
-                p.var_bytes(entries[addr])
-        return p.bytes()
-
-    @classmethod
-    def decode(cls, data: bytes) -> "PredicateResults":
-        from coreth_tpu.atomic.wire import Unpacker
-        u = Unpacker(data)
-        out = cls()
-        for _ in range(u.u32()):
-            tx_index = u.u32()
-            for _ in range(u.u32()):
-                addr = u.fixed(20)
-                out.set_result(tx_index, addr, u.var_bytes())
-        return out
+from coreth_tpu.predicate import (  # noqa: F401
+    CHUNK,
+    DELIMITER,
+    PredicateError,
+    PredicateResults,
+    check_tx_predicates,
+    pack_predicate,
+    results_bytes_from_extra,
+    slots_to_bytes,
+    unpack_predicate,
+)

@@ -19,7 +19,7 @@ from coreth_tpu.mpt import EMPTY_ROOT
 from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
 from coreth_tpu.precompile.contract import abi_pack_bytes, abi_word
 from coreth_tpu.precompile.modules import register_module, unregister_module
-from coreth_tpu.precompile.warp_contract import (
+from coreth_tpu.warp.contract import (
     GET_BLOCKCHAIN_ID, GET_VERIFIED_WARP_MESSAGE, SEND_WARP_MESSAGE,
     SEND_WARP_MESSAGE_TOPIC, WARP_ADDRESS, WarpConfig, make_warp_module,
     verify_block_predicates,
@@ -268,7 +268,7 @@ def test_vm_warp_end_to_end():
     )
     from coreth_tpu.rpc import RPCServer, register_warp_api
     from coreth_tpu.types import DynamicFeeTx, sign_tx
-    from coreth_tpu.warp.predicate import (
+    from coreth_tpu.predicate import (
         PredicateResults, results_bytes_from_extra,
     )
     from tests.test_plugin import CHAIN_ID, KEY, genesis_json

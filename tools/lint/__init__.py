@@ -1,0 +1,48 @@
+"""corethlint — AST-based architecture lint for the coreth_tpu tree.
+
+Four passes, all pure-AST (no imports of the linted code, safe to run
+anywhere, no JAX/device access):
+
+- **layers** (LAY001/LAY002): the package DAG declared in
+  ``tools/lint/layers.toml`` (the Python twin of the reference's
+  ``scripts/lint_allowed_geth_imports.sh`` + SURVEY §1 layer map) is
+  enforced — a package may import same-or-lower layers only, and every
+  package must appear in the map.
+- **determinism** (DET001-DET006): consensus-critical packages must be
+  bit-reproducible — no float/complex literals or casts, no
+  ``time``/``random``/``secrets``/``os.urandom``, no builtin
+  ``hash()``/``id()`` (PYTHONHASHSEED-dependent), no iteration over
+  unordered sets, no unordered collections fed to hashing/encoding.
+- **jit purity** (JIT001-JIT005): functions compiled by ``jax.jit`` /
+  ``pallas_call`` must be pure — no ``print``, host ``np.*`` ops, I/O,
+  closure/global mutation, or ``.item()``-style host syncs.
+- **bare excepts** (EXC001/EXC002): ``except Exception`` and broader
+  require a same-line ``# noqa: BLE001 — <reason>`` rationale (the
+  idiom already used across the tree).
+
+Findings can be suppressed inline with ``# noqa: <CODE> — <reason>``
+(reason mandatory) or via ``tools/lint/baseline.txt`` for accepted
+pre-existing debt.  CLI: ``python -m tools.lint coreth_tpu/``.
+"""
+
+from tools.lint.core import Finding, Source, collect_sources, is_suppressed  # noqa: F401
+from tools.lint.layers import check_layers, load_config  # noqa: F401
+from tools.lint.determinism import check_determinism  # noqa: F401
+from tools.lint.jitpurity import check_jit_purity  # noqa: F401
+from tools.lint.excepts import check_excepts  # noqa: F401
+from tools.lint.baseline import load_baseline, split_findings  # noqa: F401
+
+
+def run_all(paths, config, baseline=frozenset()):
+    """Run all four passes; returns (new, baselined, stale_keys)."""
+    from tools.lint.core import _display_path
+    sources = collect_sources(paths)
+    findings = []
+    findings += check_layers(sources, config)
+    findings += check_determinism(sources, config)
+    findings += check_jit_purity(sources)
+    findings += check_excepts(sources)
+    by_path = {s.path: s for s in sources}
+    findings = [f for f in findings if not is_suppressed(f, by_path)]
+    return split_findings(findings, baseline,
+                          scope_roots=[_display_path(p) for p in paths])

@@ -1,0 +1,76 @@
+"""corethlint CLI — ``python -m tools.lint [paths...]``.
+
+Exit 0: clean (baselined findings allowed).  Exit 1: new findings or
+stale baseline entries (the tier-1 gate rejects both, so the CLI must
+too).  Exit 2: configuration problem (unparseable file, bad layer map).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.lint import run_all
+from tools.lint.baseline import load_baseline
+from tools.lint.layers import DEFAULT_TOML, load_config
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="AST lint: layer boundaries, determinism, jit "
+                    "purity, bare excepts.")
+    ap.add_argument("paths", nargs="*", default=["coreth_tpu"],
+                    help="files/directories to lint (default: coreth_tpu)")
+    ap.add_argument("--layers", default=DEFAULT_TOML,
+                    help="layer map (default: tools/lint/layers.toml)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression baseline (default: tools/lint/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings as failures too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="append every new finding's key to the baseline")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or ["coreth_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"corethlint: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        config = load_config(args.layers)
+    except (OSError, ValueError) as e:
+        print(f"corethlint: bad layer map {args.layers}: {e}", file=sys.stderr)
+        return 2
+    try:
+        baseline = (frozenset() if args.no_baseline
+                    else load_baseline(args.baseline))
+    except ValueError as e:
+        print(f"corethlint: {e}", file=sys.stderr)
+        return 2
+
+    new, baselined, stale = run_all(paths, config, baseline)
+    new.sort(key=lambda f: (f.path, f.line, f.code))
+
+    for f in new:
+        print(f.render())
+    for key in stale:
+        print(f"corethlint: stale baseline entry (no longer matches): {key}",
+              file=sys.stderr)
+    print(f"corethlint: {len(new)} finding(s), {len(baselined)} baselined, "
+          f"{len(stale)} stale baseline entr(ies)")
+
+    if args.write_baseline and new:
+        with open(args.baseline, "a", encoding="utf-8") as fh:
+            for f in new:
+                fh.write(f"{f.baseline_key}  # TODO justify\n")
+        print(f"corethlint: appended {len(new)} entr(ies) to {args.baseline} "
+              f"— replace each TODO with a real justification")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

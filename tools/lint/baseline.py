@@ -1,0 +1,73 @@
+"""Suppression baseline — accepted pre-existing findings.
+
+``baseline.txt`` holds one line per accepted *occurrence*,
+``path::CODE::detail`` (line numbers deliberately excluded so unrelated
+edits don't churn it), followed by a mandatory ``# justification`` —
+the same rule inline noqa enforces; ``--write-baseline``'s
+``# TODO justify`` stub does not count, so an unedited stub fails the
+run.  Identical keys accumulate: two lines accept exactly two matching
+findings, and a third occurrence introduced later is NEW — one entry
+must not open the gate for every future duplicate of the same (file,
+code, detail) class.  Unmatched entries are reported as stale so the
+file shrinks monotonically.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable, List, Mapping, Tuple
+
+from tools.lint.core import Finding
+
+
+def load_baseline(path: str) -> Mapping[str, int]:
+    """Baseline keys with their accepted-occurrence counts."""
+    entries: Counter = Counter()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for lineno, raw in enumerate(fh, 1):
+                key, _, comment = raw.partition(" #")
+                key = key.strip()
+                if not key or key.startswith("#"):
+                    continue
+                reason = comment.strip()
+                if not reason or reason.upper().startswith("TODO"):
+                    raise ValueError(
+                        f"{path}:{lineno}: baseline entry needs a real "
+                        f"'# justification' (not a TODO stub): {key}")
+                entries[key] += 1
+    except FileNotFoundError:
+        pass
+    return entries
+
+
+def split_findings(findings: Iterable[Finding],
+                   baseline: Mapping[str, int] | Iterable[str],
+                   scope_roots: Iterable[str] = ("",),
+                   ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """``baseline`` maps keys to accepted-occurrence counts (a plain
+    iterable of keys counts each once).  ``scope_roots`` are the
+    repo-root-relative paths this run scanned (default: everything).
+    Only in-scope baseline entries can be stale — a partial run
+    (``python -m tools.lint coreth_tpu/mpt``) must not flag entries for
+    files it never looked at."""
+    roots = [r.rstrip("/") for r in scope_roots]
+    remaining = Counter(baseline)  # Counter(mapping) copies counts
+    new, baselined = [], []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        if remaining[f.baseline_key] > 0:
+            remaining[f.baseline_key] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+
+    def in_scope(key: str) -> bool:
+        path = key.split("::", 1)[0]
+        return any(not r or path == r or path.startswith(r + "/")
+                   for r in roots)
+
+    stale: List[str] = []
+    for key in sorted(remaining):
+        if remaining[key] > 0 and in_scope(key):
+            stale.extend([key] * remaining[key])
+    return new, baselined, stale

@@ -1,0 +1,166 @@
+"""Shared corethlint machinery: findings, sources, noqa suppression."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+ROOT_PACKAGE = "coreth_tpu"
+
+# Same-line suppression: ``# noqa: DET001 — reason`` (em/en dash or
+# hyphen, rationale mandatory — a bare code is not a justification).
+_NOQA_RE = re.compile(r"#\s*noqa:\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)"
+                      r"(?:\s*[—–-]+\s*(?P<reason>\S.*))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str      # normalized, '/'-separated, as scanned
+    line: int
+    code: str      # LAY001, DET003, JIT002, EXC001, ...
+    message: str   # human diagnostic
+    detail: str    # line-number-free key component for the baseline
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.path}::{self.code}::{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+# compound statements own a body: their end_lineno is the body's last
+# line, which must NOT count as "the same line" for noqa purposes
+_COMPOUND_STMTS = (ast.For, ast.AsyncFor, ast.While, ast.If, ast.With,
+                   ast.AsyncWith, ast.Try, ast.FunctionDef,
+                   ast.AsyncFunctionDef, ast.ClassDef, ast.Match)
+
+
+class Source:
+    """One parsed file plus the metadata the passes need."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.package = package_of(self.path)
+        self._stmt_ends: Optional[dict] = None
+
+    def stmt_end(self, lineno: int) -> Optional[int]:
+        """End line of a multi-line *simple* statement starting at
+        ``lineno`` (e.g. a parenthesized import) — the closing line is a
+        legitimate noqa site.  Compound statements are excluded: their
+        end_lineno is the last body line, an unrelated statement."""
+        if self._stmt_ends is None:
+            ends: dict = {}
+            for stmt in ast.walk(self.tree):
+                if (isinstance(stmt, ast.stmt)
+                        and not isinstance(stmt, _COMPOUND_STMTS)):
+                    end = getattr(stmt, "end_lineno", None)
+                    if end and end != stmt.lineno:
+                        ends[stmt.lineno] = max(end, ends.get(stmt.lineno, 0))
+            self._stmt_ends = ends
+        return self._stmt_ends.get(lineno)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def noqa_codes(self, node: ast.AST) -> dict:
+        """{code: reason-or-None} from the node's physical line(s)."""
+        out = {}
+        linenos = {getattr(node, "lineno", 0)}
+        end = getattr(node, "end_lineno", None)
+        if end:
+            linenos.add(end)
+        for ln in linenos:
+            m = _NOQA_RE.search(self.line(ln))
+            if m:
+                reason = m.group("reason")
+                for code in re.split(r"\s*,\s*", m.group("codes")):
+                    out[code] = reason
+        return out
+
+
+def package_of(path: str) -> Optional[str]:
+    """Map a file path to its coreth_tpu package name.
+
+    ``coreth_tpu/mpt/trie.py`` -> ``mpt``; top-level modules map to
+    their stem (``coreth_tpu/rlp.py`` -> ``rlp``); the root
+    ``__init__.py`` maps to the root package itself.  Files outside
+    ``coreth_tpu`` (fixtures, synthetic trees) resolve relative to the
+    last ``coreth_tpu`` path component so tmp-dir copies lint the same.
+    """
+    parts = path.replace(os.sep, "/").split("/")
+    if ROOT_PACKAGE not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index(ROOT_PACKAGE)
+    rest = parts[idx + 1:]
+    if not rest:
+        return ROOT_PACKAGE
+    if len(rest) == 1:
+        stem = rest[0][:-3] if rest[0].endswith(".py") else rest[0]
+        return ROOT_PACKAGE if stem == "__init__" else stem
+    return rest[0]
+
+
+def collect_sources(paths: Sequence[str]) -> List[Source]:
+    files = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            files.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames) if f.endswith(".py"))
+    sources = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            sources.append(Source(_display_path(f), text))
+        except SyntaxError as e:
+            raise SystemExit(f"corethlint: cannot parse {f}: {e}")
+    return sources
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _display_path(path: str) -> str:
+    """Repo-root-relative, so baseline keys are stable across cwds."""
+    ab = os.path.abspath(path)
+    rel = os.path.relpath(ab, _REPO_ROOT)
+    return ab.replace(os.sep, "/") if rel.startswith("..") else rel
+
+
+def is_suppressed(finding: Finding, sources_by_path) -> bool:
+    """A finding is suppressed by a same-line noqa naming its code (or
+    BLE001 for the except pass) WITH a rationale.  For a multi-line
+    simple statement the noqa may sit on the closing line — the only
+    place a formatter will keep it — so that line counts too."""
+    src = sources_by_path.get(finding.path)
+    if src is None:
+        return False
+    lines = {finding.line}
+    end = src.stmt_end(finding.line)
+    if end:
+        lines.add(end)
+    for ln in sorted(lines):
+        m = _NOQA_RE.search(src.line(ln))
+        if not m or not m.group("reason"):
+            continue
+        codes = set(re.split(r"\s*,\s*", m.group("codes")))
+        if finding.code in codes:
+            return True
+        # the tree-wide idiom for broad excepts is ruff's BLE001
+        if finding.code in ("EXC001", "EXC002") and "BLE001" in codes:
+            return True
+    return False

@@ -1,0 +1,146 @@
+"""Determinism pass — consensus-critical packages must be bit-reproducible.
+
+The whole backend contract (PAPER.md: bit-identical state roots) dies on
+one ``float``, wall-clock read, or hash-seed-dependent iteration in a
+consensus path.  Packages listed under ``[determinism]`` in layers.toml
+are scanned for:
+
+- DET001  float/complex literal
+- DET002  ``float(...)`` / ``complex(...)`` cast
+- DET003  wall-clock / entropy: ``time.*``, ``datetime.*``,
+          ``random.*``, ``secrets.*``, ``os.urandom``/``os.getrandom``
+          (imports and uses, including aliased module imports)
+- DET004  builtin ``hash()`` / ``id()`` — PYTHONHASHSEED / allocator
+          dependent, must never order or key consensus data
+- DET005  iteration over a set/set-comprehension/``set(...)`` —
+          unordered; wrap in ``sorted(...)``
+- DET006  unordered collection (``set``, ``.keys()``) passed straight
+          to a hashing/encoding call
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from tools.lint.core import Finding, Source
+
+_ENTROPY_MODULES = {"time", "random", "secrets", "datetime"}
+_OS_ENTROPY_ATTRS = {"urandom", "getrandom"}
+# sha256/sha3_256/sha512... but NOT shape/shard/shard_map/shallow_copy
+_SHA_RE = re.compile(r"sha\d|sha3_|shake_")
+
+
+def _leaf_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_hashing_call(func: ast.AST) -> bool:
+    leaf = _leaf_name(func)
+    return (leaf in ("encode", "encode_list") or "keccak" in leaf
+            or leaf.startswith("hash_") or bool(_SHA_RE.match(leaf)))
+
+
+def _is_unordered(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    if isinstance(node, ast.Call) and _leaf_name(node.func) == "keys":
+        return True
+    return False
+
+
+def check_determinism(sources: List[Source], config) -> List[Finding]:
+    packages = set(config.determinism_packages)
+    findings = []
+    for src in sources:
+        if src.package not in packages:
+            continue
+        # module names (incl. aliases) bound to entropy modules
+        entropy_aliases, os_aliases = set(), set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    bound = alias.asname or root
+                    if root in _ENTROPY_MODULES:
+                        entropy_aliases.add(bound)
+                        findings.append(Finding(
+                            src.path, node.lineno, "DET003",
+                            f"import of nondeterministic module "
+                            f"'{alias.name}' in consensus package",
+                            f"import:{alias.name}"))
+                    elif root == "os":
+                        os_aliases.add(bound)
+            elif isinstance(node, ast.ImportFrom) and not node.level:
+                mod = (node.module or "").split(".")[0]
+                if mod in _ENTROPY_MODULES:
+                    findings.append(Finding(
+                        src.path, node.lineno, "DET003",
+                        f"import from nondeterministic module '{mod}' "
+                        f"in consensus package", f"import:{mod}"))
+                elif mod == "os":
+                    for alias in node.names:
+                        if alias.name in _OS_ENTROPY_ATTRS:
+                            findings.append(Finding(
+                                src.path, node.lineno, "DET003",
+                                f"import of os.{alias.name} in consensus "
+                                f"package", f"import:os.{alias.name}"))
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, (float, complex)):
+                findings.append(Finding(
+                    src.path, node.lineno, "DET001",
+                    f"{type(node.value).__name__} literal {node.value!r} "
+                    f"in consensus package",
+                    f"literal:{node.value!r}"))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Name) and func.id in ("float", "complex"):
+                    findings.append(Finding(
+                        src.path, node.lineno, "DET002",
+                        f"{func.id}() cast in consensus package",
+                        f"cast:{func.id}"))
+                elif isinstance(func, ast.Name) and func.id in ("hash", "id"):
+                    findings.append(Finding(
+                        src.path, node.lineno, "DET004",
+                        f"builtin {func.id}() is PYTHONHASHSEED/allocator-"
+                        f"dependent — never order consensus data with it",
+                        f"builtin:{func.id}"))
+                elif _is_hashing_call(func):
+                    for arg in node.args:
+                        if _is_unordered(arg):
+                            findings.append(Finding(
+                                src.path, node.lineno, "DET006",
+                                f"unordered collection fed to "
+                                f"{_leaf_name(func)}() — sort first",
+                                f"unordered-arg:{_leaf_name(func)}"))
+                if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+                    base = func.value.id
+                    if base in entropy_aliases or (base in (os_aliases | {"os"})
+                                                   and func.attr in _OS_ENTROPY_ATTRS):
+                        findings.append(Finding(
+                            src.path, node.lineno, "DET003",
+                            f"call to {base}.{func.attr}() in consensus "
+                            f"package", f"use:{base}.{func.attr}"))
+            iters = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters = [node.iter]
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters = [g.iter for g in node.generators]
+            for it in iters:
+                if isinstance(it, (ast.Set, ast.SetComp)) or (
+                        isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Name)
+                        and it.func.id in ("set", "frozenset")):
+                    findings.append(Finding(
+                        src.path, node.lineno, "DET005",
+                        "iteration over an unordered set in consensus "
+                        "package — wrap in sorted(...)", "set-iteration"))
+    return findings

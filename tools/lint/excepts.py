@@ -1,0 +1,68 @@
+"""Bare-except pass — broad handlers need a written rationale.
+
+``except Exception`` / ``except BaseException`` (EXC001) and bare
+``except:`` (EXC002) swallow consensus-relevant failures unless the
+author says why that is safe.  The required idiom is the one already in
+the tree (ruff's blind-except code + an explanation):
+
+    except Exception:  # noqa: BLE001 — warming is best-effort
+
+A ``noqa`` without a reason does not count.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.lint.core import Finding, Source
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _broad_names(type_node) -> List[str]:
+    if type_node is None:
+        return []
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+    out = []
+    for n in nodes:
+        name = n.attr if isinstance(n, ast.Attribute) else getattr(n, "id", "")
+        if name in _BROAD:
+            out.append(name)
+    return out
+
+
+def _has_rationale(src: Source, lineno: int) -> bool:
+    codes = src.noqa_codes(_FakeNode(lineno))
+    for code in ("BLE001", "EXC001", "EXC002"):
+        if code in codes and codes[code]:
+            return True
+    return False
+
+
+class _FakeNode:
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+
+
+def check_excepts(sources: List[Source]) -> List[Finding]:
+    findings = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if not _has_rationale(src, node.lineno):
+                    findings.append(Finding(
+                        src.path, node.lineno, "EXC002",
+                        "bare 'except:' — name the exception, or add "
+                        "'# noqa: BLE001 — <why>'", "bare-except"))
+                continue
+            for name in _broad_names(node.type):
+                if not _has_rationale(src, node.lineno):
+                    findings.append(Finding(
+                        src.path, node.lineno, "EXC001",
+                        f"'except {name}' without rationale — narrow it, "
+                        f"or add '# noqa: BLE001 — <why>'",
+                        f"broad:{name}"))
+    return findings

@@ -1,0 +1,169 @@
+"""JIT-purity pass — traced functions must be side-effect free.
+
+A function compiled by ``jax.jit`` or ``pallas_call`` runs its Python
+body once at trace time; side effects silently execute at a different
+time (or never again), and host ops force device syncs.  Detected as
+jitted: functions whose decorator chain ends in ``jit``/``pallas_call``
+(including ``functools.partial(jax.jit, ...)``), and named functions
+passed to a ``jit``/``pallas_call`` call in the same module.
+
+- JIT001  ``print(...)`` inside a jitted function
+- JIT002  host numpy op (``np.*`` / ``numpy.*``) — use ``jnp``
+- JIT003  I/O (``open``/``input``) inside a jitted function
+- JIT004  mutation of closed-over/global state (mutating method call on
+          a non-local name, ``global``/``nonlocal`` declarations)
+- JIT005  host sync (``.item()``/``.tolist()``) inside a jitted function
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.lint.core import Finding, Source
+
+_JIT_LEAVES = {"jit", "pallas_call"}
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "add", "discard", "update", "setdefault", "popitem",
+             "write", "writelines"}
+_HOST_SYNC = {"item", "tolist"}
+
+
+def _dotted_leaf(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    if _dotted_leaf(dec) in _JIT_LEAVES:
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(static_argnums=...) or @partial(jax.jit, ...)
+        if _dotted_leaf(dec.func) in _JIT_LEAVES:
+            return True
+        if _dotted_leaf(dec.func) == "partial":
+            return any(_dotted_leaf(a) in _JIT_LEAVES for a in dec.args)
+    return False
+
+
+def _local_names(fn: ast.FunctionDef) -> Set[str]:
+    names = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                             + fn.args.kwonlyargs)}
+    for special in (fn.args.vararg, fn.args.kwarg):
+        if special:
+            names.add(special.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+        elif isinstance(node, ast.NamedExpr) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for sub in ast.walk(node.optional_vars):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+    return names
+
+
+def _jitted_functions(tree: ast.AST):
+    defs = [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    by_name = {}
+    for d in defs:
+        by_name.setdefault(d.name, d)
+    jitted = [d for d in defs if any(_decorator_is_jit(x) for x in d.decorator_list)]
+    # fn = jax.jit(step)  /  return pallas_call(kernel, ...)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and _dotted_leaf(node.func) in _JIT_LEAVES):
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    jitted.append(by_name[arg.id])
+    seen, out = set(), []
+    for d in jitted:
+        if id(d) not in seen:
+            seen.add(id(d))
+            out.append(d)
+    return out
+
+
+def _imported_names(tree: ast.AST) -> Set[str]:
+    """Names bound by imports anywhere in the module — ``u256.add(...)``
+    on an imported module is a function call, not a closure mutation."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+    return names
+
+
+def check_jit_purity(sources: List[Source]) -> List[Finding]:
+    findings = []
+    for src in sources:
+        imported = _imported_names(src.tree)
+        for fn in _jitted_functions(src.tree):
+            locals_ = _local_names(fn) | imported
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    findings.append(Finding(
+                        src.path, node.lineno, "JIT004",
+                        f"{type(node).__name__.lower()} declaration inside "
+                        f"jitted '{fn.name}' — traced once, mutates host "
+                        f"state", f"{fn.name}:scope-decl"))
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                leaf = _dotted_leaf(func)
+                if isinstance(func, ast.Name) and leaf == "print":
+                    findings.append(Finding(
+                        src.path, node.lineno, "JIT001",
+                        f"print() inside jitted '{fn.name}' — use "
+                        f"jax.debug.print", f"{fn.name}:print"))
+                elif isinstance(func, ast.Name) and leaf in ("open", "input"):
+                    findings.append(Finding(
+                        src.path, node.lineno, "JIT003",
+                        f"{leaf}() I/O inside jitted '{fn.name}'",
+                        f"{fn.name}:{leaf}"))
+                elif isinstance(func, ast.Attribute):
+                    root = func.value
+                    while isinstance(root, ast.Attribute):
+                        root = root.value
+                    root_id = root.id if isinstance(root, ast.Name) else ""
+                    if root_id in ("np", "numpy"):
+                        findings.append(Finding(
+                            src.path, node.lineno, "JIT002",
+                            f"host numpy op {root_id}.{leaf}() inside "
+                            f"jitted '{fn.name}' — use jnp",
+                            f"{fn.name}:np.{leaf}"))
+                    elif leaf in _HOST_SYNC:
+                        findings.append(Finding(
+                            src.path, node.lineno, "JIT005",
+                            f".{leaf}() host sync inside jitted "
+                            f"'{fn.name}'", f"{fn.name}:{leaf}"))
+                    elif (leaf in _MUTATORS
+                          and isinstance(func.value, ast.Name)
+                          and func.value.id not in locals_):
+                        findings.append(Finding(
+                            src.path, node.lineno, "JIT004",
+                            f"mutating .{leaf}() on closed-over "
+                            f"'{func.value.id}' inside jitted "
+                            f"'{fn.name}'",
+                            f"{fn.name}:{func.value.id}.{leaf}"))
+    return findings

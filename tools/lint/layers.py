@@ -1,0 +1,184 @@
+"""Layer-boundary pass — the Python twin of the reference's
+``scripts/lint_allowed_geth_imports.sh`` + ``geth-allowed-packages.txt``.
+
+``layers.toml`` declares a total order of package layers (mirroring
+SURVEY §1, L0 storage → top API).  A package may import packages at its
+own layer or below; an upward import is LAY001, a package missing from
+the map (source or target) is LAY002, and a bare ``import coreth_tpu``
+(which executes the root __init__ and thus the whole upper tree) is
+LAY003.  *All* imports count, including function-local lazy ones —
+laziness changes import time, not the architecture.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tools.lint.core import Finding, ROOT_PACKAGE, Source
+
+DEFAULT_TOML = os.path.join(os.path.dirname(__file__), "layers.toml")
+
+
+@dataclass
+class Config:
+    levels: Dict[str, int] = field(default_factory=dict)
+    determinism_packages: List[str] = field(default_factory=list)
+
+
+def _parse_minitoml(text: str) -> dict:
+    """Parse the subset of TOML layers.toml uses (py3.10 has no
+    tomllib): ``[section]`` / ``[[array-of-tables]]``, int, string, and
+    string-list values; ``#`` comments."""
+    root: dict = {}
+    current = root
+    buf_key = None
+    buf_items: List[str] = []
+
+    def strip_comment(line: str) -> str:
+        out, in_str = [], False
+        for ch in line:
+            if ch == '"':
+                in_str = not in_str
+            if ch == "#" and not in_str:
+                break
+            out.append(ch)
+        return "".join(out).strip()
+
+    def parse_scalar(tok: str):
+        tok = tok.strip()
+        if tok.startswith('"') and tok.endswith('"'):
+            return tok[1:-1]
+        return int(tok)
+
+    for raw in text.splitlines():
+        line = strip_comment(raw)
+        if not line:
+            continue
+        if buf_key is not None:  # inside a multi-line list
+            buf_items.append(line)
+            if line.endswith("]"):
+                joined = " ".join(buf_items)
+                current[buf_key] = [parse_scalar(t) for t in
+                                    re.split(r"\s*,\s*", joined.strip("[] ")) if t]
+                buf_key, buf_items = None, []
+            continue
+        m = re.fullmatch(r"\[\[(\w+)\]\]", line)
+        if m:
+            current = {}
+            root.setdefault(m.group(1), []).append(current)
+            continue
+        m = re.fullmatch(r"\[(\w+)\]", line)
+        if m:
+            current = root.setdefault(m.group(1), {})
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("[") and not val.endswith("]"):
+            buf_key, buf_items = key, [val]
+        elif val.startswith("["):
+            current[key] = [parse_scalar(t) for t in
+                            re.split(r"\s*,\s*", val.strip("[] ")) if t]
+        else:
+            current[key] = parse_scalar(val)
+    return root
+
+
+def load_config(toml_path: str = DEFAULT_TOML) -> Config:
+    with open(toml_path, encoding="utf-8") as fh:
+        data = _parse_minitoml(fh.read())
+    cfg = Config()
+    for layer in data.get("layer", []):
+        for pkg in layer.get("packages", []):
+            cfg.levels[pkg] = layer["level"]
+    cfg.determinism_packages = data.get("determinism", {}).get("packages", [])
+    return cfg
+
+
+def _import_targets(src: Source):
+    """Yield (node, target_package, name_form) for every coreth_tpu
+    import, module-level or nested.  Relative imports are resolved
+    against the source file's own package — ``from ..state import X``
+    inside ``coreth_tpu/mpt/`` targets ``state`` exactly like the
+    absolute form, so the standard relative idiom cannot dodge the
+    gate.  ``name_form`` marks ``from coreth_tpu import X`` aliases,
+    where X may be a plain re-exported symbol rather than a package."""
+    parts = src.path.split("/")
+    pkg_parts = None  # the file's containing package, e.g. [root, "mpt"]
+    if ROOT_PACKAGE in parts:
+        idx = len(parts) - 1 - parts[::-1].index(ROOT_PACKAGE)
+        pkg_parts = parts[idx:-1] or [ROOT_PACKAGE]
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod = alias.name.split(".")
+                if mod[0] == ROOT_PACKAGE:
+                    # len==1: bare root import — target is the root
+                    # itself (check_layers turns it into LAY003)
+                    yield node, mod[1] if len(mod) > 1 else ROOT_PACKAGE, False
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                if pkg_parts is None or node.level > len(pkg_parts):
+                    continue  # resolves above coreth_tpu — not ours
+                base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                mod = base + (node.module.split(".") if node.module else [])
+            else:
+                mod = (node.module or "").split(".")
+            if mod[0] != ROOT_PACKAGE:
+                continue
+            if len(mod) > 1:
+                yield node, mod[1], False
+            else:  # from coreth_tpu import rlp, wire  /  from .. import rlp
+                for alias in node.names:
+                    yield node, alias.name, True
+
+
+def check_layers(sources: List[Source], config: Config) -> List[Finding]:
+    findings = []
+    present = {s.package for s in sources}  # packages actually scanned
+    for src in sources:
+        pkg = src.package
+        if pkg is None or pkg == ROOT_PACKAGE:
+            continue  # outside the tree / root __init__ re-exports
+        if pkg not in config.levels:
+            findings.append(Finding(
+                src.path, 1, "LAY002",
+                f"package '{pkg}' is not in tools/lint/layers.toml — "
+                f"assign it a layer", f"package:{pkg}"))
+            continue
+        level = config.levels[pkg]
+        seen = set()
+        for node, target, name_form in _import_targets(src):
+            if target == pkg:
+                continue
+            if target == ROOT_PACKAGE:
+                findings.append(Finding(
+                    src.path, node.lineno, "LAY003",
+                    f"bare 'import {ROOT_PACKAGE}' executes the root "
+                    f"__init__ (the whole upper tree) — import the "
+                    f"needed subpackage directly", "bare-root-import"))
+                continue
+            if name_form and target not in config.levels and target not in present:
+                continue  # plain re-exported symbol, not a package
+            if target not in config.levels:
+                key = (node.lineno, "?", target)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    src.path, node.lineno, "LAY002",
+                    f"import of package '{target}' which is not in "
+                    f"tools/lint/layers.toml", f"unmapped:{target}"))
+            elif config.levels[target] > level:
+                key = (node.lineno, target)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    src.path, node.lineno, "LAY001",
+                    f"upward import: {pkg} (L{level}) -> {target} "
+                    f"(L{config.levels[target]})", f"{pkg}->{target}"))
+    return findings
