@@ -25,9 +25,26 @@ from coreth_tpu.ops import u256
 
 WORD_ZERO = b"\x00" * 32
 
+# Device dispatches issued through this module (single-shot machine
+# runs AND fused OCC windows).  The bench prints dispatches-per-block
+# from it and the OCC-equivalence tests assert the O(txs) -> O(1)
+# reduction against it.
+DISPATCH_COUNT = 0
+
+
+def _count_dispatch() -> None:
+    global DISPATCH_COUNT
+    DISPATCH_COUNT += 1
+
 
 def addr_word(addr: bytes) -> int:
     return int.from_bytes(addr, "big")
+
+
+def word16(v: int) -> np.ndarray:
+    """u256 int -> 16 little-endian int32 limbs (the machine layout)."""
+    return np.frombuffer(
+        v.to_bytes(32, "little"), dtype=np.uint16).astype(np.int32)
 
 
 @dataclass
@@ -195,12 +212,24 @@ class MachineRunner:
 
     def run(self, txs: List[TxSpec]) -> List[TxResult]:
         """Execute txs (independently, against their given pre-states),
-        resolving storage misses through rerun rounds."""
+        resolving storage misses through rerun rounds.
+
+        Raises ValueError when a TxSpec's code is not device-eligible:
+        scan_code returns empty jumpdests for ineligible code, so any
+        taken JUMP would silently become a bad_jump ERR (gas burned)
+        instead of a HOST escape — callers must route such txs to the
+        host interpreter themselves (machine_block.classify does)."""
         txs = list(txs)
+        for t in txs:
+            info = T.scan_code(t.code, self.fork)
+            if not info.eligible:
+                raise ValueError(
+                    f"TxSpec code not device-eligible: {info.reason}")
         for _ in range(self.max_rounds):
             p = self._params(txs)
             fn = M.get_machine(p)
-            out = self._Out(np.asarray(fn(self._pack(txs, p))["packed"]),
+            _count_dispatch()
+            out = PackedOut(np.asarray(fn(self._pack(txs, p))["packed"]),
                             p)
             missing = self._collect_misses(out, txs)
             if not missing:
@@ -217,91 +246,448 @@ class MachineRunner:
             out_res[i].host_reason = M.R_SCACHE
         return out_res
 
-    # ------------------------------------------------------------ unpack
-    class _Out:
-        """View over the machine's single packed output tensor (one
-        device->host transfer; see machine.py 'packed')."""
-
-        def __init__(self, blob: np.ndarray, p: M.MachineParams):
-            S, LC, LD = p.scache_cap, p.log_cap, p.log_data_cap
-            o = 0
-
-            def take(n, shape=None):
-                nonlocal o
-                v = blob[:, o:o + n]
-                o += n
-                return v if shape is None else v.reshape(
-                    (blob.shape[0],) + shape)
-
-            self.status = take(1)[:, 0]
-            self.gas = take(1)[:, 0]
-            self.refund = take(1)[:, 0]
-            self.host_reason = take(1)[:, 0]
-            self.scnt = take(1)[:, 0]
-            self.sflag = take(S)
-            self.skey = take(S * 16, (S, 16))
-            self.sval = take(S * 16, (S, 16))
-            self.sorig = take(S * 16, (S, 16))
-            self.log_nt = take(LC)
-            self.log_dlen = take(LC)
-            self.log_cnt = take(1)[:, 0]
-            self.log_top = take(LC * 4 * 16, (LC, 4, 16))
-            self.log_data = take(LC * LD, (LC, LD))
-
-    def _collect_misses(self, out: "_Out", txs) -> Dict[int, List[bytes]]:
+    def _collect_misses(self, out: "PackedOut",
+                        txs) -> Dict[int, List[bytes]]:
         missing: Dict[int, List[bytes]] = {}
         for i, t in enumerate(txs):
             # HOST lanes go to the host interpreter anyway; ERR lanes
             # may have mispriced on a speculative miss value, so they
             # must resolve + rerun too
-            n = int(out.scnt[i])
             keys = []
-            for j in range(n):
-                if out.sflag[i, j] & M.F_MISS:
-                    key = self._key_bytes(out.skey[i, j])
-                    if key not in t.storage:
-                        keys.append(key)
+            for key in miss_keys(out, i):
+                if key not in t.storage:
+                    keys.append(key)
             if keys:
                 missing[i] = keys
         return missing
 
-    @staticmethod
-    def _key_bytes(limbs: np.ndarray) -> bytes:
-        return b"".join(
-            int(limbs[l]).to_bytes(2, "little") for l in range(16)
-        )[::-1]
+    def _unpack(self, out: "PackedOut", txs) -> List[TxResult]:
+        return [result_from_row(out, i) for i in range(len(txs))]
 
-    @staticmethod
-    def _word_int(limbs: np.ndarray) -> int:
-        v = 0
-        for l in range(16):
-            v |= int(limbs[l]) << (16 * l)
-        return v
 
-    def _unpack(self, out: "_Out", txs) -> List[TxResult]:
-        results = []
-        for i in range(len(txs)):
-            reads: Dict[bytes, int] = {}
-            writes: Dict[bytes, int] = {}
-            for j in range(int(out.scnt[i])):
-                fl = int(out.sflag[i, j])
-                if not fl & M.F_VALID:
-                    continue
-                key = self._key_bytes(out.skey[i, j])
-                if fl & M.F_READ:
-                    reads[key] = self._word_int(out.sorig[i, j])
-                if fl & M.F_WRITTEN:
-                    writes[key] = self._word_int(out.sval[i, j])
-            logs = []
-            for j in range(int(out.log_cnt[i])):
-                topics = [self._word_int(out.log_top[i, j, k]).to_bytes(
-                    32, "big") for k in range(int(out.log_nt[i, j]))]
-                data = bytes(
-                    out.log_data[i, j, :int(out.log_dlen[i, j])].astype(
-                        np.uint8).tolist())
-                logs.append((topics, data))
-            results.append(TxResult(
-                status=int(out.status[i]), gas_left=int(out.gas[i]),
-                refund=int(out.refund[i]), logs=logs, reads=reads,
-                writes=writes, host_reason=int(out.host_reason[i])))
-        return results
+# ------------------------------------------------------------ unpack
+class PackedOut:
+    """View over the machine's single packed output tensor (one
+    device->host transfer; see machine.py 'packed')."""
+
+    def __init__(self, blob: np.ndarray, p: M.MachineParams):
+        S, LC, LD = p.scache_cap, p.log_cap, p.log_data_cap
+        o = 0
+
+        def take(n, shape=None):
+            nonlocal o
+            v = blob[:, o:o + n]
+            o += n
+            return v if shape is None else v.reshape(
+                (blob.shape[0],) + shape)
+
+        self.status = take(1)[:, 0]
+        self.gas = take(1)[:, 0]
+        self.refund = take(1)[:, 0]
+        self.host_reason = take(1)[:, 0]
+        self.scnt = take(1)[:, 0]
+        self.sflag = take(S)
+        self.skey = take(S * 16, (S, 16))
+        self.sval = take(S * 16, (S, 16))
+        self.sorig = take(S * 16, (S, 16))
+        self.log_nt = take(LC)
+        self.log_dlen = take(LC)
+        self.log_cnt = take(1)[:, 0]
+        self.log_top = take(LC * 4 * 16, (LC, 4, 16))
+        self.log_data = take(LC * LD, (LC, LD))
+
+
+def _key_bytes(limbs: np.ndarray) -> bytes:
+    return b"".join(
+        int(limbs[l]).to_bytes(2, "little") for l in range(16)
+    )[::-1]
+
+
+def _word_int(limbs: np.ndarray) -> int:
+    v = 0
+    for l in range(16):
+        v |= int(limbs[l]) << (16 * l)
+    return v
+
+
+def miss_keys(out: PackedOut, i: int) -> List[bytes]:
+    """Storage keys lane i touched that were NOT in its seeded cache
+    (F_MISS entries — executed against a speculative zero)."""
+    keys = []
+    for j in range(int(out.scnt[i])):
+        if out.sflag[i, j] & M.F_MISS:
+            keys.append(_key_bytes(out.skey[i, j]))
+    return keys
+
+
+def result_from_row(out: PackedOut, i: int) -> TxResult:
+    """One lane's TxResult from a PackedOut row."""
+    reads: Dict[bytes, int] = {}
+    writes: Dict[bytes, int] = {}
+    for j in range(int(out.scnt[i])):
+        fl = int(out.sflag[i, j])
+        if not fl & M.F_VALID:
+            continue
+        key = _key_bytes(out.skey[i, j])
+        if fl & M.F_READ:
+            reads[key] = _word_int(out.sorig[i, j])
+        if fl & M.F_WRITTEN:
+            writes[key] = _word_int(out.sval[i, j])
+    logs = []
+    for j in range(int(out.log_cnt[i])):
+        topics = [_word_int(out.log_top[i, j, k]).to_bytes(32, "big")
+                  for k in range(int(out.log_nt[i, j]))]
+        data = bytes(
+            out.log_data[i, j, :int(out.log_dlen[i, j])].astype(
+                np.uint8).tolist())
+        logs.append((topics, data))
+    return TxResult(
+        status=int(out.status[i]), gas_left=int(out.gas[i]),
+        refund=int(out.refund[i]), logs=logs, reads=reads,
+        writes=writes, host_reason=int(out.host_reason[i]))
+
+
+# ----------------------------------------------------------- OCC window
+@dataclass
+class WindowResult:
+    """Per-block outcome of one fused OCC window (see
+    machine.build_occ_machine).  `clean[k]` means every lane of block k
+    committed on device; a dirty block (and everything after it, whose
+    base table is speculative) must be redone by the caller."""
+    results: List[List[TxResult]]       # per block, per call lane
+    committed: List[np.ndarray]         # (lanes,) bool per block
+    escape: List[np.ndarray]            # (lanes,) bool per block
+    clean: List[bool]
+    rounds: List[int]                   # device OCC rounds per block
+    attempts: int                       # dispatches this window took
+
+
+class MachineWindowRunner:
+    """Device-resident OCC over WINDOWS of machine blocks.
+
+    One dispatch executes up to `blocks`-many machine blocks: the
+    Block-STM round loop, read-set validation, and cross-block state
+    folding all run inside the jitted program against a global
+    slot-value table resident in HBM (machine.build_occ_machine).  The
+    host only supplies per-lane inputs and a premapped slot-id layout,
+    and fetches one packed result tensor per window — dispatches per
+    machine block drop from O(txs) (one per OCC round, round-5 design)
+    to O(1).
+
+    Persistent across windows:
+    - ``slot_gid``: (contract, key32) -> global table row;
+    - ``vals``: host mirror of committed slot values at the last fold
+      point (rebuild source when the device table is invalidated);
+    - ``table``/``key_tab``: the device-resident value/key tables; the
+      value table is DONATED through each dispatch so the
+      window-to-window handoff aliases HBM instead of copying;
+    - ``common``: per-contract keys observed in every lane so far (the
+      premap heuristic — e.g. the swap pool's two reserve slots — that
+      lets steady-state windows run in ONE dispatch; keys outside the
+      premap surface as F_MISS escapes and are resolved by a bounded
+      re-dispatch loop, the window-level miss-and-rerun idiom).
+    """
+
+    COMMON_CAP = 8  # premapped common keys per contract
+
+    def __init__(self, fork: str,
+                 storage_resolver: Callable[[bytes, bytes], int],
+                 max_attempts: int = 6):
+        self.fork = fork
+        self.resolver = storage_resolver
+        self.max_attempts = max_attempts
+        self.slot_gid: Dict[Tuple[bytes, bytes], int] = {}
+        self.gid_keys: List[Tuple[bytes, bytes]] = []
+        self.vals: List[int] = []
+        # contract -> {key32: None} (dict-as-ordered-set: deterministic
+        # iteration, unlike a set)
+        self.common: Dict[bytes, Dict[bytes, None]] = {}
+        self.table = None
+        self.key_tab = None
+        self.table_cap = 0
+        self._synced = 0          # gids present in the device tables
+        self._stale = True        # device table != mirror: full rebuild
+
+    # ------------------------------------------------------------ state
+    def reset(self) -> None:
+        """Drop every mapping and device buffer (another execution path
+        rewrote storage: mirror values can no longer be trusted)."""
+        self.slot_gid.clear()
+        self.gid_keys = []
+        self.vals = []
+        self.common.clear()
+        self.table = None
+        self.key_tab = None
+        self.table_cap = 0
+        self._synced = 0
+        self._stale = True
+
+    def invalidate(self) -> None:
+        """Device table no longer matches the committed state (a dirty
+        window left partial writes in it); the next issue() rebuilds it
+        from the host mirror."""
+        self._stale = True
+
+    def commit_block(self,
+                     writes: Dict[Tuple[bytes, bytes], int]) -> None:
+        """Fold one committed block's storage writes into the host
+        mirror (device-committed blocks already carry them in the
+        resident table; legacy-path blocks require invalidate())."""
+        for (contract, key), v in writes.items():
+            g = self.slot_gid.get((contract, key))
+            if g is None:
+                # map it with the known committed value so future
+                # windows can premap without a trie read
+                g = len(self.vals)
+                self.slot_gid[(contract, key)] = g
+                self.gid_keys.append((contract, key))
+                self.vals.append(v)
+            else:
+                self.vals[g] = v
+
+    def _gid(self, contract: bytes, key: bytes) -> int:
+        g = self.slot_gid.get((contract, key))
+        if g is None:
+            g = len(self.vals)
+            self.slot_gid[(contract, key)] = g
+            self.gid_keys.append((contract, key))
+            self.vals.append(self.resolver(contract, key))
+        return g
+
+    # ------------------------------------------------------------- shape
+    def _occ_params(self, items, premaps):
+        feats = set()
+        max_code = 64
+        max_data = 64
+        max_lanes = 1
+        max_slots = 4
+        unmapped = 0  # premap keys that will claim gids during packing
+        for (_env, specs), block_pre in zip(items, premaps):
+            max_lanes = max(max_lanes, len(specs))
+            for t, pre in zip(specs, block_pre):
+                info = T.scan_code(t.code, self.fork)
+                if not info.eligible:
+                    raise ValueError(
+                        f"TxSpec code not device-eligible: {info.reason}")
+                feats |= set(info.features)
+                max_code = max(max_code, len(t.code))
+                max_data = max(max_data, len(t.calldata))
+                max_slots = max(max_slots, len(pre) + 8)
+                for k in pre:
+                    if (t.address, k) not in self.slot_gid:
+                        unmapped += 1
+        p = M.MachineParams(
+            fork=self.fork,
+            batch=_pow2(max_lanes, 8),
+            code_cap=_pow2(max_code, 256),
+            data_cap=_pow2(max_data, 128),
+            scache_cap=_pow2(max_slots, 8),
+            features=frozenset(feats))
+        occ = M.OccParams(
+            blocks=_pow2(len(items), 1),
+            table_cap=_pow2(len(self.vals) + unmapped + 1, 64),
+            rounds=p.batch + 1)
+        return p, occ
+
+    def _device_tables(self, G: int):
+        n = len(self.vals)
+        if self.table is None or self.table_cap != G or self._stale:
+            tv = np.zeros((G, u256.LIMBS), dtype=np.int32)
+            tk = np.zeros((G, u256.LIMBS), dtype=np.int32)
+            for g in range(n):
+                tv[g] = word16(self.vals[g])
+                tk[g] = word16(int.from_bytes(self.gid_keys[g][1],
+                                              "big"))
+            self.table = jnp.asarray(tv)
+            self.key_tab = jnp.asarray(tk)
+            self.table_cap = G
+            self._synced = n
+            self._stale = False
+        elif self._synced < n:
+            # append newly mapped rows; already-synced rows are live on
+            # device (committed by the kernel itself)
+            idx = np.arange(self._synced, n, dtype=np.int32)
+            tv = np.stack([word16(self.vals[g]) for g in idx])
+            tk = np.stack([word16(int.from_bytes(self.gid_keys[g][1],
+                                                 "big")) for g in idx])
+            jidx = jnp.asarray(idx)
+            self.table = self.table.at[jidx].set(jnp.asarray(tv))
+            self.key_tab = self.key_tab.at[jidx].set(jnp.asarray(tk))
+            self._synced = n
+        return self.table, self.key_tab
+
+    # ------------------------------------------------------------- issue
+    def issue(self, items, discovered=None, attempt: int = 1) -> dict:
+        """Pack + dispatch one window; returns a handle for complete().
+
+        items: [(BlockEnv, [TxSpec, ...]), ...] in chain order.
+        The dispatch is ASYNC (jax queues it): callers overlap host
+        trie folding of the previous window with this one's execution
+        and only block in complete()'s fetch.
+        """
+        if discovered is None:
+            discovered = [[{} for _t in specs] for _env, specs in items]
+        premaps = []
+        for (_env, specs), disc in zip(items, discovered):
+            block_pre = []
+            for li, t in enumerate(specs):
+                keys: Dict[bytes, None] = {}
+                for k in self.common.get(t.address, ()):
+                    keys[k] = None
+                for k in t.storage:
+                    keys[k] = None
+                for k in disc[li]:
+                    keys[k] = None
+                block_pre.append(list(keys))
+            premaps.append(block_pre)
+        p, occ = self._occ_params(items, premaps)
+        W, L, S, G = occ.blocks, p.batch, p.scache_cap, occ.table_cap
+
+        code = np.zeros((W, L, p.code_cap + 33), dtype=np.int32)
+        code_len = np.zeros((W, L), dtype=np.int32)
+        jdest = np.zeros((W, L, p.code_cap), dtype=np.int32)
+        calldata = np.zeros((W, L, p.data_cap), dtype=np.int32)
+        data_len = np.zeros((W, L), dtype=np.int32)
+        start_gas = np.zeros((W, L), dtype=np.int32)
+        active = np.zeros((W, L), dtype=bool)
+        sgid = np.full((W, L, S), G, dtype=np.int32)
+        words = {k: np.zeros((W, L, u256.LIMBS), dtype=np.int32)
+                 for k in ("callvalue", "caller_w", "address_w",
+                           "origin_w", "gasprice_w")}
+        timestamp = np.zeros((W,), dtype=np.int32)
+        number = np.zeros((W,), dtype=np.int32)
+        gaslimit = np.zeros((W,), dtype=np.int32)
+        coinbase_w = np.zeros((W, u256.LIMBS), dtype=np.int32)
+        basefee_w = np.zeros((W, u256.LIMBS), dtype=np.int32)
+        chain_id = 0
+        for bi, ((env, specs), block_pre) in enumerate(
+                zip(items, premaps)):
+            timestamp[bi] = env.timestamp
+            number[bi] = env.number
+            gaslimit[bi] = min(env.gas_limit, (1 << 31) - 1)
+            coinbase_w[bi] = word16(addr_word(env.coinbase))
+            basefee_w[bi] = word16(env.base_fee)
+            chain_id = env.chain_id
+            for li, t in enumerate(specs):
+                cb = np.frombuffer(t.code, dtype=np.uint8)
+                code[bi, li, :len(cb)] = cb
+                code_len[bi, li] = len(cb)
+                info = T.scan_code(t.code, self.fork)
+                for d in info.jumpdests:
+                    if d < p.code_cap:
+                        jdest[bi, li, d] = 1
+                db = np.frombuffer(t.calldata, dtype=np.uint8)
+                calldata[bi, li, :len(db)] = db
+                data_len[bi, li] = len(db)
+                start_gas[bi, li] = t.gas
+                active[bi, li] = True
+                words["callvalue"][bi, li] = word16(t.value)
+                words["caller_w"][bi, li] = word16(addr_word(t.caller))
+                words["address_w"][bi, li] = word16(addr_word(t.address))
+                words["origin_w"][bi, li] = word16(addr_word(t.origin))
+                words["gasprice_w"][bi, li] = word16(t.gas_price)
+                for j, key in enumerate(block_pre[li]):
+                    sgid[bi, li, j] = self._gid(t.address, key)
+        table, key_tab = self._device_tables(G)
+        inputs = dict(
+            code=jnp.asarray(code), jdest=jnp.asarray(jdest),
+            code_len=jnp.asarray(code_len),
+            calldata=jnp.asarray(calldata),
+            data_len=jnp.asarray(data_len),
+            start_gas=jnp.asarray(start_gas),
+            active=jnp.asarray(active), sgid=jnp.asarray(sgid),
+            callvalue=jnp.asarray(words["callvalue"]),
+            caller_w=jnp.asarray(words["caller_w"]),
+            address_w=jnp.asarray(words["address_w"]),
+            origin_w=jnp.asarray(words["origin_w"]),
+            gasprice_w=jnp.asarray(words["gasprice_w"]),
+            timestamp=jnp.asarray(timestamp),
+            number=jnp.asarray(number),
+            gaslimit=jnp.asarray(gaslimit),
+            coinbase_w=jnp.asarray(coinbase_w),
+            basefee_w=jnp.asarray(basefee_w),
+            chainid_w=jnp.asarray(word16(chain_id)),
+        )
+        fn = M.get_occ_machine(p, occ)
+        _count_dispatch()
+        out = fn(table, key_tab, inputs)
+        # the input table was donated into the dispatch; the output
+        # handle (post-window committed state) replaces it
+        self.table = out["table"]
+        return dict(out=out, items=items, discovered=discovered, p=p,
+                    occ=occ, premaps=premaps, attempt=attempt)
+
+    # ---------------------------------------------------------- complete
+    def complete(self, handle: dict) -> WindowResult:
+        """Fetch a window's results; resolve any storage keys that
+        escaped the premap and re-dispatch (bounded attempts) until the
+        window needs no further key resolution."""
+        while True:
+            p = handle["p"]
+            L = p.batch
+            packed = np.asarray(handle["out"]["packed"])
+            pw = packed.shape[2] - 4
+            pout = PackedOut(
+                packed[:, :, :pw].reshape(-1, pw), p)
+            extra = packed[:, :, pw:]
+            missing = False
+            for bi, (_env, specs) in enumerate(handle["items"]):
+                for li, t in enumerate(specs):
+                    if not extra[bi, li, 1]:
+                        continue  # escaped lanes only carry misses
+                    disc = handle["discovered"][bi][li]
+                    for key in miss_keys(pout, bi * L + li):
+                        if (t.address, key) not in self.slot_gid:
+                            self._gid(t.address, key)
+                        if key not in disc:
+                            disc[key] = None
+                            missing = True
+            if missing and handle["attempt"] < self.max_attempts:
+                # re-run the WHOLE window from the host mirror (the
+                # failed attempt's device table holds partial commits)
+                self._stale = True
+                handle = self.issue(handle["items"],
+                                    handle["discovered"],
+                                    attempt=handle["attempt"] + 1)
+                continue
+            break
+        results, committed, escape, clean, rounds = [], [], [], [], []
+        for bi, (_env, specs) in enumerate(handle["items"]):
+            nl = len(specs)
+            res = [result_from_row(pout, bi * L + li)
+                   for li in range(nl)]
+            com = extra[bi, :nl, 0].astype(bool)
+            esc = (extra[bi, :nl, 1] | extra[bi, :nl, 2]).astype(bool)
+            results.append(res)
+            committed.append(com)
+            escape.append(esc)
+            clean.append(bool(com.all()) if nl else True)
+            rounds.append(int(extra[bi, 0, 3]) if nl else 0)
+        self._update_common(handle, pout, clean)
+        return WindowResult(results=results, committed=committed,
+                            escape=escape, clean=clean, rounds=rounds,
+                            attempts=handle["attempt"])
+
+    def _update_common(self, handle, pout: PackedOut,
+                       clean: List[bool]) -> None:
+        """Narrow each contract's premap heuristic to the keys EVERY
+        lane touched (the shared-slot contention shape: e.g. a swap
+        pool's reserves) so the next window premaps them up front."""
+        L = handle["p"].batch
+        for bi, (_env, specs) in enumerate(handle["items"]):
+            if not clean[bi]:
+                continue
+            for li, t in enumerate(specs):
+                row = bi * L + li
+                touched: Dict[bytes, None] = {}
+                for j in range(int(pout.scnt[row])):
+                    fl = int(pout.sflag[row, j])
+                    if fl & (M.F_READ | M.F_WRITTEN):
+                        touched[_key_bytes(pout.skey[row, j])] = None
+                cur = self.common.get(t.address)
+                if cur is None:
+                    keep = list(touched)[:self.COMMON_CAP]
+                    self.common[t.address] = dict.fromkeys(keep)
+                else:
+                    self.common[t.address] = {
+                        k: None for k in cur if k in touched}

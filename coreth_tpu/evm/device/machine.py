@@ -34,7 +34,7 @@ machine replaces for device-resident transactions.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, FrozenSet
+from typing import Dict, FrozenSet, Tuple
 
 import numpy as np
 import jax
@@ -78,6 +78,16 @@ class MachineParams:
 
     @property
     def refunds(self) -> bool:
+        """Whether the EIP-3529 refund LADDER is compiled into the
+        SSTORE family (AP3+ jump tables carry the reduced schedule).
+
+        The resulting per-lane refund counter is DIAGNOSTIC-ONLY under
+        Avalanche semantics: gas refunds were removed at ApricotPhase1
+        (reference state_transition.go:449), so consumers must never
+        subtract TxResult.refund from gas_used — machine_block's
+        account sweep correctly ignores it.  The counter exists so the
+        differential tests can pin the ladder against the host
+        interpreter's accounting, nothing else."""
         return self.fork != "ap2"  # AP2 = 2929 pricing, refunds off
 
 
@@ -160,17 +170,14 @@ _FIELDS = ("pc", "gas", "status", "sp", "refund", "steps", "stack",
            "log_dlen", "log_cnt", "host_reason")
 
 
-def build_machine(params: MachineParams):
-    """Trace-ready step machine for `params`; returns run(inputs)->dict.
-
-    inputs (device arrays, B = params.batch):
-      code (B, code_cap+33) int32 (zero-padded); jdest (B, code_cap);
-      calldata (B, data_cap); data_len (B,); start_gas (B,);
-      callvalue/caller_w/address_w/origin_w/gasprice_w (B, 16);
-      active (B,) bool; skey/sval/sorig (B, S, 16); sflag (B, S);
-      scnt (B,); timestamp/number/gaslimit scalars int32;
-      coinbase_w/chainid_w/basefee_w (16,).
-    """
+def _build_exec(params: MachineParams):
+    """Core lane executor shared by the single-shot machine
+    (build_machine) and the device-resident OCC kernel
+    (build_occ_machine): exec_lanes(inputs, storage, active) runs every
+    active lane to completion (one inner while_loop over steps) and
+    returns the final state dict.  `storage` is the initial
+    (skey, sval, sorig, sflag, scnt) cache tuple so the OCC kernel can
+    re-seed lanes between rounds without host round-trips."""
     p = params
     ot = T.op_tables(p.fork)
     CONST = jnp.asarray(ot.const_gas)
@@ -182,7 +189,7 @@ def build_machine(params: MachineParams):
     refunds = p.refunds
     rows = jnp.arange(B)
 
-    def run(inputs):
+    def exec_lanes(inputs, storage, active):
         code = inputs["code"]
         jdest = inputs["jdest"]
         calldata = inputs["calldata"]
@@ -811,20 +818,20 @@ def build_machine(params: MachineParams):
             return jnp.any(st["status"] == RUN) \
                 & (st["steps"] < p.max_steps)
 
+        skey0, sval0, sorig0, sflag0, scnt0 = storage
         init = dict(
             pc=jnp.zeros((B,), dtype=jnp.int32),
             gas=inputs["start_gas"].astype(jnp.int32),
-            status=jnp.where(inputs["active"], RUN, SKIP).astype(
-                jnp.int32),
+            status=jnp.where(active, RUN, SKIP).astype(jnp.int32),
             sp=jnp.zeros((B,), dtype=jnp.int32),
             refund=jnp.zeros((B,), dtype=jnp.int32),
             steps=jnp.int32(0),
             stack=jnp.zeros((B, p.stack_cap, LIMBS), dtype=jnp.int32),
             mem=jnp.zeros((B, p.mem_cap), dtype=jnp.int32),
             msize=jnp.zeros((B,), dtype=jnp.int32),
-            skey=inputs["skey"], sval=inputs["sval"],
-            sorig=inputs["sorig"], sflag=inputs["sflag"],
-            scnt=inputs["scnt"],
+            skey=skey0, sval=sval0,
+            sorig=sorig0, sflag=sflag0,
+            scnt=scnt0,
             tkey=jnp.zeros((B, TC, LIMBS), dtype=jnp.int32),
             tval=jnp.zeros((B, TC, LIMBS), dtype=jnp.int32),
             tcnt=jnp.zeros((B,), dtype=jnp.int32),
@@ -847,19 +854,47 @@ def build_machine(params: MachineParams):
         # every error consumes all gas (interpreter.go: any err but
         # ErrExecutionReverted burns the remaining gas)
         st["gas"] = jnp.where(st["status"] == ERR, 0, st["gas"])
-        # ONE packed int32 output row per lane: over the tunneled
-        # runtime every separate device->host array transfer pays a
-        # full sync (~0.2s), so the adapter downloads this single
-        # tensor instead of ~12 arrays (measured 2.4s -> 0.2s)
-        st["packed"] = jnp.concatenate([
-            st["status"][:, None], st["gas"][:, None],
-            st["refund"][:, None], st["host_reason"][:, None],
-            st["scnt"][:, None], st["sflag"],
-            st["skey"].reshape(B, -1), st["sval"].reshape(B, -1),
-            st["sorig"].reshape(B, -1), st["log_nt"],
-            st["log_dlen"], st["log_cnt"][:, None],
-            st["log_top"].reshape(B, -1),
-            st["log_data"].reshape(B, -1)], axis=1)
+        return st
+
+    return exec_lanes
+
+
+def pack_result(B: int, st: dict):
+    """ONE packed int32 output row per lane: over the tunneled
+    runtime every separate device->host array transfer pays a
+    full sync (~0.2s), so the adapter downloads this single
+    tensor instead of ~12 arrays (measured 2.4s -> 0.2s)."""
+    return jnp.concatenate([
+        st["status"][:, None], st["gas"][:, None],
+        st["refund"][:, None], st["host_reason"][:, None],
+        st["scnt"][:, None], st["sflag"],
+        st["skey"].reshape(B, -1), st["sval"].reshape(B, -1),
+        st["sorig"].reshape(B, -1), st["log_nt"],
+        st["log_dlen"], st["log_cnt"][:, None],
+        st["log_top"].reshape(B, -1),
+        st["log_data"].reshape(B, -1)], axis=1)
+
+
+def build_machine(params: MachineParams):
+    """Trace-ready step machine for `params`; returns run(inputs)->dict.
+
+    inputs (device arrays, B = params.batch):
+      code (B, code_cap+33) int32 (zero-padded); jdest (B, code_cap);
+      calldata (B, data_cap); data_len (B,); start_gas (B,);
+      callvalue/caller_w/address_w/origin_w/gasprice_w (B, 16);
+      active (B,) bool; skey/sval/sorig (B, S, 16); sflag (B, S);
+      scnt (B,); timestamp/number/gaslimit scalars int32;
+      coinbase_w/chainid_w/basefee_w (16,).
+    """
+    exec_lanes = _build_exec(params)
+
+    def run(inputs):
+        st = exec_lanes(
+            inputs,
+            (inputs["skey"], inputs["sval"], inputs["sorig"],
+             inputs["sflag"], inputs["scnt"]),
+            inputs["active"])
+        st["packed"] = pack_result(params.batch, st)
         return st
 
     return run
@@ -875,4 +910,210 @@ def get_machine(params: MachineParams):
     if fn is None:
         fn = jax.jit(build_machine(params))
         _MACHINES[params] = fn
+    return fn
+
+
+# --------------------------------------------------------------- OCC
+# Device-resident optimistic concurrency: the Block-STM round loop that
+# replay/machine_block.py used to run on the host (one dispatch + one
+# tunnel round-trip per round) moves INSIDE the jitted program.  Lanes
+# carry their read/write sets as fixed-capacity slot-index/value
+# arrays against a global slot-value table resident in HBM; validation
+# (observed reads vs the committed prefix's writes) and the
+# re-execution mask are computed on device, and one dispatch covers a
+# WINDOW of machine blocks (outer lax.scan carries the table across
+# blocks).  The dispatch returns only the final per-lane results plus
+# a conflict/escape mask for the rare host-escape txs.
+
+@dataclass(frozen=True)
+class OccParams:
+    """Shape of one fused OCC dispatch (bucketed by the adapter)."""
+    blocks: int        # W — machine blocks per dispatch (scan length)
+    table_cap: int     # G — global slot-table rows
+    rounds: int        # per-block OCC round cap (>= lanes converges)
+
+
+# per-lane result fields the OCC loop carries between rounds
+_OCC_RES = ("status", "gas", "refund", "host_reason", "scnt", "sflag",
+            "skey", "sval", "sorig", "log_top", "log_nt", "log_data",
+            "log_dlen", "log_cnt")
+
+
+def build_occ_machine(params: MachineParams, occ: OccParams):
+    """Fused multi-block OCC kernel; returns
+    occ_run(table, key_tab, blocks_in) -> dict.
+
+    table   (G, 16) int32 — committed slot values (donated: the caller
+            feeds the previous dispatch's output table back in).
+    key_tab (G, 16) int32 — slot-key words per table row (host-managed,
+            append-only; rows past the mapped count are zero).
+    blocks_in: per-block stacked inputs, leading axis W:
+      the exec inputs of build_machine (code, jdest, code_len,
+      calldata, data_len, start_gas, active, callvalue, caller_w,
+      address_w, origin_w, gasprice_w) each (W, B, ...); per-block
+      scalars timestamp/number/gaslimit (W,) and coinbase_w/basefee_w
+      (W, 16); plus sgid (W, B, S) int32 — the premapped global slot
+      id of each lane-cache entry (>= G marks an unused entry).
+      chainid_w (16,) is shared across the window.
+
+    Returns {"table": (G,16), "packed": (W,B,PW+4)}: per-lane machine
+    results in the pack_result layout plus 4 trailing columns —
+    committed / escape / pending / rounds.  Committed lanes validated
+    against the exact sequential prefix; escape lanes need host
+    attention (HOST status or a storage key outside the premap);
+    pending lanes mean the round cap was hit (only reachable alongside
+    escapes).  Blocks after the first dirty block computed against a
+    speculative table — the adapter discards them.
+    """
+    p = params
+    exec_lanes = _build_exec(p)
+    B, S = p.batch, p.scache_cap
+    G, R = occ.table_cap, occ.rounds
+    _EXEC_KEYS = ("code", "jdest", "code_len", "calldata", "data_len",
+                  "start_gas", "callvalue", "caller_w", "address_w",
+                  "origin_w", "gasprice_w", "timestamp", "number",
+                  "gaslimit", "coinbase_w", "basefee_w")
+
+    def occ_run(table, key_tab, blocks_in):
+        chainid_w = blocks_in["chainid_w"]
+
+        def block_body(tbl, binp):
+            exec_in = {k: binp[k] for k in _EXEC_KEYS}
+            exec_in["chainid_w"] = chainid_w
+            sgid = binp["sgid"]                      # (B, S)
+            active0 = binp["active"]                 # (B,)
+            premapped = sgid < G                     # (B, S)
+            nkeys = jnp.sum(premapped.astype(jnp.int32), axis=1)
+            # entry keys gathered from the key table (OOB -> zeros)
+            skey0 = key_tab.at[sgid].get(mode="fill", fill_value=0)
+            skey0 = jnp.where(premapped[..., None], skey0, 0)
+            sflag0 = jnp.where(premapped, F_VALID, 0).astype(jnp.int32)
+
+            def gather(t2, gids):
+                v = t2.at[gids].get(mode="fill", fill_value=0)
+                return jnp.where((gids < G)[..., None], v, 0)
+
+            res0 = dict(
+                status=jnp.full((B,), SKIP, dtype=jnp.int32),
+                gas=jnp.zeros((B,), dtype=jnp.int32),
+                refund=jnp.zeros((B,), dtype=jnp.int32),
+                host_reason=jnp.zeros((B,), dtype=jnp.int32),
+                scnt=jnp.zeros((B,), dtype=jnp.int32),
+                sflag=jnp.zeros((B, S), dtype=jnp.int32),
+                skey=jnp.zeros((B, S, LIMBS), dtype=jnp.int32),
+                sval=jnp.zeros((B, S, LIMBS), dtype=jnp.int32),
+                sorig=jnp.zeros((B, S, LIMBS), dtype=jnp.int32),
+                log_top=jnp.zeros((B, p.log_cap, 4, LIMBS),
+                                  dtype=jnp.int32),
+                log_nt=jnp.zeros((B, p.log_cap), dtype=jnp.int32),
+                log_data=jnp.zeros((B, p.log_cap, p.log_data_cap),
+                                   dtype=jnp.int32),
+                log_dlen=jnp.zeros((B, p.log_cap), dtype=jnp.int32),
+                log_cnt=jnp.zeros((B,), dtype=jnp.int32),
+            )
+            carry0 = (
+                jnp.int32(0),                        # round
+                active0,                             # pending
+                gather(tbl, sgid),                   # seeds (B, S, 16)
+                res0,
+                jnp.zeros((B,), dtype=bool),         # committed
+                jnp.zeros((B,), dtype=bool),         # escape
+                tbl,                                 # table after valid
+            )
+
+            def occ_cond(c):
+                rnd, pending, _seeds, _res, _ok, escape, _t = c
+                # any escape dirties the block: the host takes over, so
+                # burning more device rounds on it is pure waste
+                return (rnd < R) & jnp.any(pending) & ~jnp.any(escape)
+
+            def occ_body(c):
+                rnd, pending, seeds, res, _ok, _esc, _t = c
+                st = exec_lanes(
+                    exec_in, (skey0, seeds, seeds, sflag0, nkeys),
+                    pending)
+                res = {
+                    f: jnp.where(
+                        pending.reshape((B,) + (1,) * (res[f].ndim - 1)),
+                        st[f], res[f])
+                    for f in _OCC_RES}
+
+                # sequential validation sweep ON DEVICE: walk lanes in
+                # tx order against the block-start table, committing
+                # writes of lanes whose observed reads match the state
+                # produced by the ok lanes before them (the same
+                # semantics as the old host sweep, machine_block.py)
+                entry = jnp.arange(S)[None, :] < res["scnt"][:, None]
+                missed = jnp.any(entry & ((res["sflag"] & F_MISS) != 0),
+                                 axis=1)
+                hosty = (res["status"] == HOST) | missed
+                skip = res["status"] == SKIP
+
+                def val_body(j, vc):
+                    t2, ok, pend2, seeds2, esc = vc
+                    cur = gather(t2, sgid[j])        # (S, 16)
+                    readf = entry[j] & ((res["sflag"][j] & F_READ) != 0) \
+                        & premapped[j]
+                    match = jnp.all(res["sorig"][j] == cur, axis=-1)
+                    reads_ok = jnp.all(~readf | match)
+                    valid = ~skip[j] & ~hosty[j] & reads_ok
+                    wr = entry[j] & ((res["sflag"][j] & F_WRITTEN) != 0) \
+                        & premapped[j] & valid & (res["status"][j] == STOP)
+                    gids_w = jnp.where(wr, sgid[j], G)
+                    t2 = t2.at[gids_w].set(res["sval"][j], mode="drop")
+                    repend = ~skip[j] & ~hosty[j] & ~reads_ok
+                    # pending lanes re-execute against the prefix state
+                    # at their position — `cur` before lane j's writes,
+                    # exactly the host sweep's dict(state) snapshot
+                    seeds2 = seeds2.at[j].set(
+                        jnp.where(repend, cur, seeds2[j]))
+                    ok = ok.at[j].set(valid)
+                    pend2 = pend2.at[j].set(repend)
+                    esc = esc.at[j].set(hosty[j] & active0[j])
+                    return (t2, ok, pend2, seeds2, esc)
+
+                t2, ok, pend2, seeds2, esc = jax.lax.fori_loop(
+                    0, B, val_body,
+                    (tbl, jnp.zeros((B,), dtype=bool),
+                     jnp.zeros((B,), dtype=bool), seeds,
+                     jnp.zeros((B,), dtype=bool)))
+                return (rnd + 1, pend2, seeds2, res, ok, esc, t2)
+
+            rnd, pending, _seeds, res, committed, escape, tbl_f = \
+                jax.lax.while_loop(occ_cond, occ_body, carry0)
+            # committed/escape/pending/rounds ride as 4 extra packed
+            # columns so the host fetches ONE tensor per window
+            extra = jnp.stack(
+                [committed.astype(jnp.int32),
+                 escape.astype(jnp.int32),
+                 pending.astype(jnp.int32),
+                 jnp.broadcast_to(rnd, (B,))], axis=1)
+            out = jnp.concatenate([pack_result(B, res), extra], axis=1)
+            # tbl_f = block-start table + committed lanes' writes in tx
+            # order; a dirty block taints every later block's base, but
+            # the adapter discards results from the first dirty block on
+            return tbl_f, out
+
+        tbl_final, packed = jax.lax.scan(block_body, table, {
+            k: v for k, v in blocks_in.items() if k != "chainid_w"})
+        return dict(table=tbl_final, packed=packed)
+
+    return occ_run
+
+
+_OCC_MACHINES: Dict[Tuple[MachineParams, OccParams], object] = {}
+
+
+def get_occ_machine(params: MachineParams, occ: OccParams):
+    """Jitted OCC kernel memoized by (machine, occ) params.  The table
+    argument is donated on real accelerators so the window-to-window
+    table handoff aliases HBM instead of copying (CPU ignores donation
+    and would warn, so it is skipped there)."""
+    key = (params, occ)
+    fn = _OCC_MACHINES.get(key)
+    if fn is None:
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        fn = jax.jit(build_occ_machine(params, occ),
+                     donate_argnums=donate)
+        _OCC_MACHINES[key] = fn
     return fn

@@ -18,6 +18,7 @@ array.
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 
 import jax
@@ -29,6 +30,19 @@ except ImportError:  # older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from coreth_tpu.ops import u256
+
+# `check_vma` landed well after the shard_map API stabilized; the
+# installed JAX may predate it (ROADMAP open item: 3 tier-1 failures on
+# older runtimes).  Passing it unconditionally would TypeError at
+# module import, so feature-detect once and drop the kwarg when absent.
+_SHARD_MAP_KWARGS = frozenset(
+    inspect.signature(shard_map).parameters)
+
+
+def _shard_map(fn, **kwargs):
+    if "check_vma" not in _SHARD_MAP_KWARGS:
+        kwargs.pop("check_vma", None)
+    return shard_map(fn, **kwargs)
 
 
 def make_mesh(devices=None, axis: str = "dp") -> Mesh:
@@ -156,7 +170,7 @@ def sharded_recover(mesh: Mesh):
             x_bytes.astype(jnp.uint8), parity.astype(jnp.int32),
             u1w.astype(jnp.int32), u2w.astype(jnp.int32))
 
-    sharded = shard_map(
+    sharded = _shard_map(
         step, mesh=mesh,
         in_specs=(PS("dp", None), PS("dp"), PS("dp", None),
                   PS("dp", None)),

@@ -599,6 +599,11 @@ class ReplayEngine:
         # per address is hoisted out of that loop
         self._vg_cache: Dict[tuple, dict] = {}
         self._addr_slot: Dict[Tuple[bytes, bytes], int] = {}
+        # bumped whenever a non-machine path rewrites contract storage
+        # (token fast path fold, host fallback) — the machine executor's
+        # window runner drops its device-resident slot table when it
+        # observes a bump (its mirror can no longer be trusted)
+        self.storage_epoch = 0
 
     # ---------------------------------------------------------------- index
     def _account(self, addr: bytes) -> int:
@@ -1314,6 +1319,7 @@ class ReplayEngine:
         # fold touched storage slots into their contract tries, rehash,
         # and pick up the new storage roots before the account fold
         if touched_slots:
+            self.storage_epoch += 1
             slot_vals = u256.to_ints(
                 fetched[t_pad:t_pad + len(touched_slots), :16])
             changed = {}
@@ -1411,7 +1417,67 @@ class ReplayEngine:
         self.stats.t_classify += time.monotonic() - t0
         if plans is None:
             return False
-        return mx.execute(block, plans) is not None
+        return mx.execute_run([(block, plans)]) == 1
+
+    def _machine_run(self, blocks: List[Block], i: int,
+                     ensure=None) -> int:
+        """Handle blocks the transfer classifier rejected, starting at
+        `i`: collect CONSECUTIVE machine-eligible blocks into one run
+        and execute them as fused device OCC windows
+        (machine_block.execute_run — one dispatch covers a whole window
+        of blocks), else the exact host path.  Returns how many blocks
+        were processed (>= 1).
+
+        Classifying ahead is safe: machine blocks cannot deploy code or
+        set multicoin flags, which is all classify() reads — but a host
+        FALLBACK block can, so execute_run stops its run at the first
+        block it escalates and the remainder re-classifies here fresh.
+        """
+        if not bool(int(os.environ.get("CORETH_MACHINE", "1"))):
+            self._fallback(blocks[i])
+            return 1
+        mx = self._machine_executor()
+        # legacy mode consumes exactly one block per execute_run call:
+        # collecting a LOOKAHEAD run would re-classify the same blocks
+        # on every call (O(N*LOOKAHEAD)) and skew the A/B's t_classify
+        lookahead = mx.LOOKAHEAD if bool(int(os.environ.get(
+            "CORETH_DEVICE_OCC", "1"))) else 1
+        items = []
+        fork = None
+        j = i
+        while j < len(blocks) and len(items) < lookahead:
+            if ensure is not None:
+                ensure(j)
+            t0 = time.monotonic()
+            # machine eligibility is a SUPERSET of the transfer/token
+            # fast path (a token transfer call is also machine
+            # bytecode): blocks past the first stay with the cheaper
+            # fast path when it can take them — stop the run there
+            # (block i itself is only here because it was rejected).
+            # The boundary block IS classified again by the outer loop:
+            # the batch built here would be stale by then (classify
+            # simulates token slot values against current state, and
+            # the machine blocks before j move that state)
+            if j > i and self._classify(blocks[j]) is not None:
+                self.stats.t_classify += time.monotonic() - t0
+                break
+            plans = mx.classify(blocks[j])
+            self.stats.t_classify += time.monotonic() - t0
+            if plans is None or (fork is not None
+                                 and mx._fork != fork):
+                break
+            fork = mx._fork
+            items.append((blocks[j], plans))
+            j += 1
+        if not items:
+            self._fallback(blocks[i])
+            return 1
+        mx._fork = fork
+        consumed = mx.execute_run(items)
+        if consumed == 0:
+            self._fallback(blocks[i])
+            consumed = 1
+        return consumed
 
     def replay_block(self, block: Block) -> bytes:
         """Process one block synchronously (tests; replay() windows)."""
@@ -1487,11 +1553,9 @@ class ReplayEngine:
                 continue
             if hit_fallback:
                 # pending retired, nothing speculative in flight: run
-                # the general step machine if the block is eligible,
-                # else the exact host path
-                if not self._try_machine(blocks[i]):
-                    self._fallback(blocks[i])
-                i += 1
+                # consecutive machine-eligible blocks as fused device
+                # OCC windows, else the exact host path
+                i += self._machine_run(blocks, i, ensure=pipe.ensure)
         return self.root
 
     def _fallback(self, block: Block) -> bytes:
@@ -1531,6 +1595,7 @@ class ReplayEngine:
         # batched scatter via the staging buffer)
         from coreth_tpu import rlp as _rlp
         self._slot_overlay.clear()
+        self.storage_epoch += 1
         if self._native:
             # apply the fallback's account changes incrementally to the
             # resident C++ trie and verify it lands on the same root

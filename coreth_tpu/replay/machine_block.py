@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Tuple
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as DT
 from coreth_tpu.evm.device.adapter import (
-    BlockEnv, MachineRunner, TxSpec,
+    BlockEnv, MachineRunner, MachineWindowRunner, TxSpec,
 )
 from coreth_tpu.params import protocol as P
 from coreth_tpu.processor.state_transition import (
@@ -66,13 +66,40 @@ class TxPlan:
 
 class MachineBlockExecutor:
     """Owns classification + execution of machine blocks for one
-    ReplayEngine (shares its tries and DeviceState mirrors)."""
+    ReplayEngine (shares its tries and DeviceState mirrors).
+
+    Two execution paths:
+    - ``execute_run`` (default): WINDOWS of consecutive machine blocks
+      fuse into single device dispatches — the OCC round loop,
+      validation, and cross-block state folding run inside the jitted
+      program (adapter.MachineWindowRunner), so the full-conflict swap
+      shape pays O(1) tunnel round-trips per block instead of O(txs).
+    - ``execute`` (legacy; CORETH_DEVICE_OCC=0, and the fallback for
+      blocks the fused kernel marks dirty): the round-5 host round
+      loop — one dispatch per OCC round plus the sequential
+      conflict-suffix host interpreter.
+    """
 
     def __init__(self, engine):
         self.e = engine
+        # read per-executor (not import time) so tests and callers can
+        # retune via env between engine constructions, like the other
+        # CORETH_* toggles this module consults at call time
+        # machine blocks fused into one device dispatch
+        self.WINDOW = int(os.environ.get("CORETH_MACHINE_WINDOW", "8"))
+        # how many blocks ahead _machine_run classifies for one run
+        self.LOOKAHEAD = int(
+            os.environ.get("CORETH_MACHINE_LOOKAHEAD", "32"))
         self.rounds = 0            # OCC re-execution rounds (stats)
         self.blocks = 0
         self.host_txs = 0          # conflict-suffix txs resolved on host
+        self.windows = 0           # fused OCC windows completed
+        self.window_attempts = 0   # dispatches those windows took
+        self.dirty_blocks = 0      # blocks the fused path escalated
+        self.last_writes: Dict[Tuple[bytes, bytes], int] = {}
+        self._runner: Optional[MachineWindowRunner] = None
+        self._runner_fork: Optional[str] = None
+        self._runner_epoch = -1
 
     # ------------------------------------------------------------ classify
     def classify(self, block: Block) -> Optional[List[TxPlan]]:
@@ -325,8 +352,17 @@ class MachineBlockExecutor:
             e.stats.t_device += time.monotonic() - t0
             return None  # conflict storm: host path takes the block
         e.stats.t_device += time.monotonic() - t0
+        return self._finish_block(block, plans, results)
 
-        # ---------------- account sweep + receipts (host, O(txs))
+    # ---------------------------------------------------- finish (shared)
+    def _finish_block(self, block: Block, plans: List[TxPlan],
+                      results: Dict[int, object]) -> bytes:
+        """Account sweep + receipts + trie fold + root check for one
+        block whose per-call-tx results are final (device-committed by
+        the fused OCC kernel, or converged by the legacy host loop).
+        Host work is O(txs), not O(gas)."""
+        from coreth_tpu.replay.engine import ReplayError
+        e = self.e
         t1 = time.monotonic()
         accounts: Dict[bytes, List[int]] = {}  # addr -> [bal, nonce]
 
@@ -393,6 +429,7 @@ class MachineBlockExecutor:
                 block.transactions, receipts, None)
 
         # ---------------- fold storage + accounts into the tries
+        self.last_writes = writes_final
         contracts: Dict[bytes, object] = {}
         for (contract, key), v in writes_final.items():
             st = e._storage_trie(contract)
@@ -445,3 +482,127 @@ class MachineBlockExecutor:
         e.stats.blocks_device += 1
         e.stats.txs += len(block.transactions)
         return root
+
+    # ------------------------------------------------- fused OCC windows
+    def _window_runner(self) -> MachineWindowRunner:
+        """The persistent fused-OCC runner; rebuilt when the fork
+        changes or another execution path (host fallback, token fast
+        path) rewrote storage since the last machine window — the
+        runner's host mirror and device table can then no longer be
+        trusted (engine.storage_epoch tracks those writes)."""
+        e = self.e
+        if (self._runner is None or self._runner_fork != self._fork
+                or self._runner_epoch != e.storage_epoch):
+            self._runner = MachineWindowRunner(
+                self._fork, self._base_value)
+            self._runner_fork = self._fork
+        self._runner_epoch = e.storage_epoch
+        return self._runner
+
+    def _window_items(self, chunk):
+        """(BlockEnv, [TxSpec]) pairs for the call lanes of a chunk."""
+        e = self.e
+        out = []
+        for block, plans in chunk:
+            env = BlockEnv(
+                coinbase=block.header.coinbase, timestamp=block.time,
+                number=block.number, gas_limit=block.header.gas_limit,
+                chain_id=e.config.chain_id,
+                base_fee=block.base_fee or 0)
+            specs = [TxSpec(
+                code=pl.code, calldata=pl.data,
+                gas=pl.gas_limit - pl.intrinsic, value=pl.value,
+                caller=pl.sender, address=pl.to, origin=pl.sender,
+                gas_price=pl.price) for pl in plans
+                if pl.kind == "call"]
+            out.append((env, specs))
+        return out
+
+    def execute_run(self, items) -> int:
+        """Execute a run of consecutive machine blocks through the
+        fused device-resident OCC kernel; returns how many blocks of
+        `items` were fully processed (machine or internal host
+        fallback).  0 means the FIRST block could not be handled here
+        and the caller must route it to the engine's host path.
+
+        Blocks chunk into WINDOW-sized fused dispatches.  The next
+        chunk is dispatched BEFORE the previous chunk's tries fold
+        (the device table carries committed state across dispatches
+        with no host round-trip), so host trie folding of window N
+        overlaps device execution of window N+1 — the _SenderPipeline
+        overlap pattern extended to the execute phase.  A dirty block
+        (host-escape lane or an OCC round-cap hit) re-runs through the
+        legacy per-block path; the run then stops so the engine can
+        re-classify against the repaired state.
+        """
+        e = self.e
+        if not bool(int(os.environ.get("CORETH_DEVICE_OCC", "1"))):
+            block, plans = items[0]
+            return 1 if self.execute(block, plans) is not None else 0
+        runner = self._window_runner()
+        chunks = [items[k:k + self.WINDOW]
+                  for k in range(0, len(items), self.WINDOW)]
+        consumed = 0
+        ci = 0
+        t0 = time.monotonic()
+        inflight = runner.issue(self._window_items(chunks[0]))
+        e.stats.t_device += time.monotonic() - t0
+        while ci < len(chunks):
+            chunk = chunks[ci]
+            t0 = time.monotonic()
+            wres = runner.complete(inflight)
+            e.stats.t_device += time.monotonic() - t0
+            inflight = None
+            self.windows += 1
+            self.window_attempts += wres.attempts
+            # pipeline: issue the NEXT chunk before folding this one —
+            # its base state is the device-resident table, so the
+            # dispatch needs nothing from the folds below.  The
+            # runner's HOST MIRROR must still learn this chunk's
+            # committed writes FIRST: if the next chunk's premap grows
+            # the table past its pow2 cap, issue() rebuilds the device
+            # table from the mirror, and a mirror lagging one chunk
+            # would resurrect pre-chunk values (root mismatch).  The
+            # trie folds below stay deferred — only the cheap dict
+            # update moves ahead of the dispatch.
+            if ci + 1 < len(chunks) and all(wres.clean):
+                for k, (_block, plans) in enumerate(chunk):
+                    calls = [pl for pl in plans if pl.kind == "call"]
+                    writes: Dict[Tuple[bytes, bytes], int] = {}
+                    for pl, res in zip(calls, wres.results[k]):
+                        if res.status == M.STOP:
+                            for key, v in res.writes.items():
+                                writes[(pl.to, key)] = v
+                    runner.commit_block(writes)
+                t0 = time.monotonic()
+                inflight = runner.issue(
+                    self._window_items(chunks[ci + 1]))
+                e.stats.t_device += time.monotonic() - t0
+            for k, (block, plans) in enumerate(chunk):
+                if wres.clean[k]:
+                    call_idx = [i for i, pl in enumerate(plans)
+                                if pl.kind == "call"]
+                    results = {i: wres.results[k][n]
+                               for n, i in enumerate(call_idx)}
+                    self.rounds += max(0, wres.rounds[k] - 1)
+                    # _finish_block also advances blocks/stats/root
+                    self._finish_block(block, plans, results)
+                    runner.commit_block(self.last_writes)
+                    consumed += 1
+                    continue
+                # dirty: partial commits may sit in the device table,
+                # and every later block of the window ran against a
+                # speculative base — escalate THIS block to the legacy
+                # path and hand the rest back for re-classification
+                self.dirty_blocks += 1
+                runner.invalidate()
+                root = self.execute(block, plans)
+                if root is None:
+                    if consumed == 0:
+                        return 0  # caller owns the first block's fate
+                    e._fallback(block)
+                else:
+                    runner.commit_block(self.last_writes)
+                return consumed + 1
+            ci += 1
+        return consumed

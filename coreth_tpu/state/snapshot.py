@@ -47,12 +47,33 @@ class DiskLayer:
         self.gen_marker: Optional[bytes] = None  # None = complete
         self._fallback = None                    # (node_db, state_root)
         # keys written by flatten() while the generator runs: the
-        # generator must not clobber them with older trie values
+        # generator must not clobber them with older trie values.
+        # Three granularities (a single account-level set would make
+        # _apply_generated skip un-flattened storage slots entirely,
+        # turning later reads into authoritative zeros — the round-5
+        # state-root-divergence bug):
+        # - _gen_overrides: account RLPs written by flatten; the
+        #   generator skips the account RLP but still merges trie
+        #   storage slots that are not individually overridden;
+        # - _gen_slot_overrides: (addr_hash, slot_hash) pairs written
+        #   (or deleted) by flatten; only those slots are skipped;
+        # - _gen_storage_blocked: destructed / deleted accounts — the
+        #   pre-destruct trie storage is dead wholesale, so the
+        #   generator must not merge ANY of it (re-created content
+        #   arrives via flatten and the slot overrides).
         self._gen_overrides: set = set()
+        self._gen_slot_overrides: set = set()
+        self._gen_storage_blocked: set = set()
 
     def _covered(self, addr_hash: bytes) -> bool:
         return self.gen_marker is None or addr_hash < self.gen_marker \
-            or addr_hash in self._gen_overrides
+            or addr_hash in self._gen_overrides \
+            or addr_hash in self._gen_storage_blocked
+
+    def _slot_covered(self, addr_hash: bytes, slot_hash: bytes) -> bool:
+        return self.gen_marker is None or addr_hash < self.gen_marker \
+            or addr_hash in self._gen_storage_blocked \
+            or (addr_hash, slot_hash) in self._gen_slot_overrides
 
     def _trie_account(self, addr_hash: bytes) -> Optional[bytes]:
         from coreth_tpu.mpt.trie import Trie
@@ -66,7 +87,13 @@ class DiskLayer:
 
     def storage_slot(self, addr_hash: bytes,
                      slot_hash: bytes) -> Optional[bytes]:
-        if not self._covered(addr_hash):
+        # slot-granular coverage: an account whose RLP was flattened
+        # mid-generation may still have most of its storage only in
+        # the rebuild trie — a slot neither generated nor individually
+        # overridden must fall through (its trie value is still
+        # current: any change would have come through flatten and
+        # landed an override)
+        if not self._slot_covered(addr_hash, slot_hash):
             from coreth_tpu.mpt.trie import Trie
             from coreth_tpu.types import StateAccount
             raw = self._trie_account(addr_hash)
@@ -192,21 +219,28 @@ class Tree:
                 for ah in diff.destructs:
                     self.disk.storage.pop(ah, None)
                     if generating:
+                        # the pre-destruct trie storage is dead in its
+                        # entirety — block the whole account's fill
                         self.disk._gen_overrides.add(ah)
+                        self.disk._gen_storage_blocked.add(ah)
                 for ah, v in diff.accounts.items():
                     if generating:
                         # flattened values are NEWER than whatever the
                         # generator would read from the rebuild-root
-                        # trie; mark so it skips these accounts
+                        # trie; mark so it skips these account RLPs —
+                        # storage stays slot-granular (below) so the
+                        # generator still merges un-flattened slots
                         self.disk._gen_overrides.add(ah)
                     if v == DELETED:
                         self.disk.accounts.pop(ah, None)
                         self.disk.storage.pop(ah, None)
+                        if generating:
+                            self.disk._gen_storage_blocked.add(ah)
                     else:
                         self.disk.accounts[ah] = v
                 for (ah, sh), v in diff.storage.items():
                     if generating:
-                        self.disk._gen_overrides.add(ah)
+                        self.disk._gen_slot_overrides.add((ah, sh))
                     if v == DELETED:
                         sub = self.disk.storage.get(ah)
                         if sub is not None:
@@ -277,6 +311,8 @@ class Tree:
                 disk.gen_marker = None
                 disk._fallback = None
                 disk._gen_overrides = set()
+                disk._gen_slot_overrides = set()
+                disk._gen_storage_blocked = set()
 
         t = threading.Thread(target=worker, daemon=True,
                              name="snapshot-generator")
@@ -293,14 +329,24 @@ class Tree:
             return
         with self._lock:
             for addr_hash, raw in items:
-                if addr_hash in disk._gen_overrides:
-                    continue  # flatten landed newer data
-                disk.accounts[addr_hash] = raw
+                blocked = addr_hash in disk._gen_storage_blocked
+                if not blocked and addr_hash not in disk._gen_overrides:
+                    disk.accounts[addr_hash] = raw
+                if blocked:
+                    continue  # destructed: the whole trie copy is dead
+                # merge trie storage even when the account RLP was
+                # overridden by flatten — only individually overridden
+                # slots carry newer data; skipping the whole account
+                # would turn the un-flattened slots into authoritative
+                # zeros once the marker passes (round-5 advisor bug)
                 acct = StateAccount.from_rlp(raw)
                 if acct.root != EMPTY_ROOT_HASH:
                     st = Trie(root_hash=acct.root, db=db.node_db)
                     sub = disk.storage.setdefault(addr_hash, {})
                     for slot_hash, v in leaves(st):
+                        if (addr_hash, slot_hash) \
+                                in disk._gen_slot_overrides:
+                            continue  # flatten landed newer data
                         sub[slot_hash] = v
             disk.gen_marker = items[-1][0] + b"\x01"
 

@@ -99,11 +99,30 @@ def test_swap_contention_block():
     assert mx.rounds > 0  # conflicts actually exercised the retry path
 
 
-def test_deep_conflict_chain_host_suffix():
-    """A conflict chain deeper than the device OCC round budget
-    resolves its suffix sequentially on the host interpreter — per tx,
-    not per block: the conflict-free device prefix is kept and the
-    block never reaches the engine's whole-block fallback."""
+def test_deep_conflict_chain_stays_on_device():
+    """With the device-resident OCC loop, a conflict chain as deep as
+    the whole block converges INSIDE one dispatch — no host
+    conflict-suffix, no whole-block fallback."""
+    def gen(i, nonces):
+        return [tx(k, nonces, POOL, swap_calldata(100 + 31 * i + k))
+                for k in range(8)]
+
+    eng = run_machine_chain(2, gen)
+    mx = eng._machine
+    assert mx.blocks == 2
+    assert mx.host_txs == 0            # the rounds ran on device
+    assert mx.dirty_blocks == 0
+    assert eng.stats.blocks_fallback == 0
+
+
+def test_deep_conflict_chain_host_suffix_legacy(monkeypatch):
+    """The legacy host round loop (CORETH_DEVICE_OCC=0) still resolves
+    a conflict chain deeper than its device round budget sequentially
+    on the host interpreter — per tx, not per block: the conflict-free
+    device prefix is kept and the block never reaches the engine's
+    whole-block fallback."""
+    monkeypatch.setenv("CORETH_DEVICE_OCC", "0")
+
     def gen(i, nonces):
         return [tx(k, nonces, POOL, swap_calldata(100 + 31 * i + k))
                 for k in range(8)]

@@ -1,0 +1,295 @@
+"""Device-resident OCC equivalence suite.
+
+The fused kernel (machine.build_occ_machine via
+adapter.MachineWindowRunner) moves the Block-STM round loop, read-set
+validation, and cross-block state folding inside one dispatch per
+window of machine blocks.  These tests pin:
+
+- bit-identical receipts/roots vs the legacy host round loop
+  (CORETH_DEVICE_OCC=0) on transfer, erc20-via-machine, swap
+  (full-conflict), and mixed shapes — both paths validate every block
+  against the host-generated headers (receipt root, bloom, gas, state
+  root), so a passing replay IS bit-equivalence, and the final roots
+  are compared directly on top;
+- the conflict-suffix host-escape path (a lane the machine cannot
+  execute escalates cleanly without corrupting neighbors);
+- the tentpole dispatch-count model: device dispatches per machine
+  block on the full-conflict swap shape drop from O(txs) (one per OCC
+  round) to O(1) (>= 10x measured on the adapter's counter).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import Genesis, GenesisAccount
+from coreth_tpu.chain.chain_makers import generate_chain
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.evm.device import adapter as ADP
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.replay import ReplayEngine
+from coreth_tpu.replay.machine_block import MachineBlockExecutor
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+from coreth_tpu.workloads.erc20 import (
+    token_genesis_account, transfer_calldata,
+)
+from coreth_tpu.workloads.swap import (
+    pool_genesis_account, swap_calldata,
+)
+
+GWEI = 10**9
+KEYS = [0x3000 + i for i in range(8)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+POOL = b"\x74" * 20
+TOKEN = b"\x75" * 20
+# eligible bytecode that ESCAPES the machine at runtime: MSTORE at
+# offset 5000 exceeds mem_cap -> HOST lane (capacity, not correctness)
+ESCAPER = b"\x76" * 20
+ESCAPER_CODE = bytes.fromhex("600061138852" + "00")
+
+
+def _alloc(extra=None):
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[POOL] = pool_genesis_account(10**15, 10**15)
+    alloc[TOKEN] = token_genesis_account({a: 10**21 for a in ADDRS})
+    if extra:
+        alloc.update(extra)
+    return alloc
+
+
+def _build_chain(n_blocks, gen_txs, extra=None):
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc=_alloc(extra))
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    def gen(i, bg):
+        for t in gen_txs(i, nonces):
+            bg.add_tx(t)
+
+    blocks, _ = generate_chain(CFG, gblock, db, n_blocks, gen, gap=2)
+    return gblock, blocks
+
+
+def _tx(k, nonces, to, data=b"", gas=200_000, value=0):
+    t = sign_tx(DynamicFeeTx(
+        chain_id_=CFG.chain_id, nonce=nonces[k], gas_tip_cap_=GWEI,
+        gas_fee_cap_=300 * GWEI, gas=gas, to=to, value=value,
+        data=data), KEYS[k], CFG.chain_id)
+    nonces[k] += 1
+    return t
+
+
+def _replay(gblock, blocks, extra=None):
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc=_alloc(extra))
+    db = Database()
+    g = genesis.to_block(db)
+    assert g.root == gblock.root
+    eng = ReplayEngine(CFG, db, g.root, parent_header=g.header,
+                       window=4)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    return eng
+
+
+def _equiv(n_blocks, gen_factory, extra=None, expect_fallbacks=0):
+    """Replay the same chain through the fused device-resident OCC
+    path and the legacy host round loop; both must land the exact
+    header roots (the per-block receipt/bloom/gas/state checks inside
+    the executors make success bit-equivalence)."""
+    gblock, blocks = _build_chain(n_blocks, gen_factory(), extra)
+    fused = _replay(gblock, blocks, extra)
+    os.environ["CORETH_DEVICE_OCC"] = "0"
+    try:
+        legacy = _replay(gblock, blocks, extra)
+    finally:
+        del os.environ["CORETH_DEVICE_OCC"]
+    assert fused.root == legacy.root == blocks[-1].root
+    assert fused.stats.blocks_fallback == expect_fallbacks
+    assert legacy.stats.blocks_fallback == expect_fallbacks
+    return fused, legacy
+
+
+def test_occ_equiv_transfer_shape():
+    """Plain transfers mixed with one contract call ride the machine
+    path (EOA txs become host-swept transfers)."""
+    def gen_factory():
+        def gen(i, nonces):
+            return [
+                _tx(0, nonces, POOL, swap_calldata(400 + i)),
+                _tx(1, nonces, bytes([0x41]) * 20, gas=21_000,
+                    value=1234 + i),
+                _tx(2, nonces, bytes([0x42]) * 20, gas=21_000,
+                    value=99),
+            ]
+        return gen
+
+    fused, _legacy = _equiv(3, gen_factory)
+    assert fused._machine.blocks == 3
+
+
+def test_occ_equiv_erc20_machine_shape(monkeypatch):
+    """The token workload forced through the general machine (no
+    fast-path classification): per-lane disjoint balance slots, keys
+    discovered via the window-level miss-and-rerun."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+
+    def gen_factory():
+        def gen(i, nonces):
+            return [_tx(k, nonces, TOKEN,
+                        transfer_calldata(ADDRS[(k + 1) % 8], 5 + k))
+                    for k in range(6)]
+        return gen
+
+    fused, _legacy = _equiv(3, gen_factory)
+    assert fused._machine.blocks == 3
+    assert fused._machine.host_txs == 0
+
+
+def test_occ_equiv_swap_full_conflict(monkeypatch):
+    """Every tx conflicts through the pool's two reserve slots — the
+    fully serial chain.  The fused path converges entirely on device
+    (no host conflict-suffix) across multiple pipelined windows."""
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen_factory():
+        def gen(i, nonces):
+            return [_tx(k, nonces, POOL,
+                        swap_calldata(1000 + 17 * i + k))
+                    for k in range(6)]
+        return gen
+
+    fused, legacy = _equiv(5, gen_factory)
+    assert fused._machine.blocks == 5
+    assert fused._machine.host_txs == 0     # rounds stayed on device
+    assert fused._machine.windows >= 3      # multi-window pipelining
+    assert legacy._machine.host_txs > 0     # legacy needed the host
+
+
+def test_occ_equiv_mixed_shape():
+    """Swaps + token calls + transfers interleaved in one block."""
+    def gen_factory():
+        def gen(i, nonces):
+            return [
+                _tx(0, nonces, POOL, swap_calldata(500 + i)),
+                _tx(1, nonces, TOKEN,
+                    transfer_calldata(b"\x45" * 20, 77)),
+                _tx(2, nonces, bytes([0x46]) * 20, gas=21_000,
+                    value=5),
+                _tx(3, nonces, POOL, swap_calldata(900 + i)),
+            ]
+        return gen
+
+    _equiv(3, gen_factory)
+
+
+def test_occ_host_escape_conflict_suffix():
+    """A lane the machine cannot run (memory past mem_cap -> HOST
+    escape) dirties its block: the fused path escalates that block to
+    the host, neighbors stay exact, and the chain root still lands."""
+    extra = {ESCAPER: GenesisAccount(balance=0, nonce=1,
+                                     code=ESCAPER_CODE)}
+
+    def gen(i, nonces):
+        if i == 1:
+            return [_tx(0, nonces, POOL, swap_calldata(321)),
+                    _tx(1, nonces, ESCAPER, gas=100_000)]
+        return [_tx(k, nonces, POOL, swap_calldata(100 + 13 * i + k))
+                for k in range(4)]
+
+    gblock, blocks = _build_chain(3, gen, extra)
+    eng = _replay(gblock, blocks, extra)
+    # block 1 fell to the exact host path; blocks 0 and 2 stayed device
+    assert eng.stats.blocks_fallback == 1
+    assert eng._machine.blocks == 2
+
+
+def test_occ_dispatch_count_reduction(monkeypatch):
+    """THE tentpole metric: on a fully conflicting swap block the
+    legacy host loop pays one dispatch per OCC round (O(txs)); the
+    device-resident loop pays O(1) dispatches per window.  Assert the
+    >= 10x reduction via the adapter's dispatch counter."""
+    n_txs = 24
+
+    def gen(i, nonces):
+        return [_tx(k % len(KEYS), nonces, POOL,
+                    swap_calldata(777 + k))
+                for k in range(n_txs)]
+
+    gblock, blocks = _build_chain(1, gen)
+
+    # legacy host round loop, forced to resolve every conflict with
+    # device rounds (the round-5 O(txs) dispatch model)
+    monkeypatch.setenv("CORETH_DEVICE_OCC", "0")
+    monkeypatch.setenv("CORETH_OCC_DEVICE_ROUNDS", str(n_txs + 8))
+    d0 = ADP.DISPATCH_COUNT
+    legacy = _replay(gblock, blocks)
+    legacy_disp = ADP.DISPATCH_COUNT - d0
+    assert legacy.stats.blocks_fallback == 0
+
+    monkeypatch.delenv("CORETH_DEVICE_OCC")
+    monkeypatch.delenv("CORETH_OCC_DEVICE_ROUNDS")
+    d0 = ADP.DISPATCH_COUNT
+    fused = _replay(gblock, blocks)
+    fused_disp = ADP.DISPATCH_COUNT - d0
+    assert fused.stats.blocks_fallback == 0
+    assert fused.root == legacy.root
+
+    assert legacy_disp >= n_txs          # one dispatch per round
+    assert fused_disp * 10 <= legacy_disp
+    # steady state: discovery attempt + final attempt per window
+    assert fused_disp <= 3
+
+
+def test_occ_table_growth_across_pipelined_windows(monkeypatch):
+    """Fresh storage slots every block push the global table across
+    its pow2 floor (64 -> 128) while windows pipeline (window N+1
+    issues before window N's tries fold).  Both _device_tables paths
+    (append for newly mapped rows, full rebuild on a cap change) must
+    keep the committed values: senders' balance slots are rewritten in
+    EVERY block, so a mirror/table lagging even one window diverges
+    the state root."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen(i, nonces):
+        # 8 reused sender balance slots + 8 fresh recipient slots per
+        # block: ~72 mapped gids by block 8, past the 64-row floor
+        return [_tx(k, nonces, TOKEN,
+                    transfer_calldata(
+                        bytes([0x60 + i]) + bytes([k]) * 19, 3 + k))
+                for k in range(8)]
+
+    gblock, blocks = _build_chain(8, gen)
+    eng = _replay(gblock, blocks)
+    mx = eng._machine
+    assert mx.blocks == 8
+    assert mx.dirty_blocks == 0
+    assert mx.windows >= 4                       # pipelining engaged
+    runner = mx._runner
+    assert runner is not None
+    assert runner.table_cap >= 128               # the cap DID grow
+
+
+def test_occ_ineligible_spec_raises():
+    """MachineRunner.run refuses ineligible code outright: scan_code
+    gives it empty jumpdests, so silent acceptance would turn a taken
+    JUMP into a bogus bad_jump ERR instead of a HOST escape."""
+    from coreth_tpu.evm.device.adapter import (
+        BlockEnv, MachineRunner, TxSpec,
+    )
+    env = BlockEnv(coinbase=b"\x00" * 20, timestamp=1, number=1,
+                   gas_limit=8_000_000, chain_id=CFG.chain_id)
+    runner = MachineRunner("durango", env, lambda a, k: 0)
+    bad = TxSpec(code=bytes.fromhex("475b00"),  # SELFBALANCE (host-only)
+                 calldata=b"", gas=50_000, value=0,
+                 caller=ADDRS[0], address=b"\x99" * 20,
+                 origin=ADDRS[0], gas_price=GWEI)
+    with pytest.raises(ValueError, match="not device-eligible"):
+        runner.run([bad])

@@ -226,6 +226,58 @@ def test_flatten_during_generation_wins():
     assert tree.disk.accounts[ah] == newer
 
 
+def test_flatten_one_slot_mid_generation_keeps_others():
+    """Round-5 advisor HIGH bug: flattening ONE storage slot of a
+    contract mid-generation must not make the generator skip the
+    contract's OTHER slots — they used to read back as authoritative
+    zeros once the marker passed (state-root divergence on reopen).
+    Overrides are tracked per (addr_hash, slot_hash) now; trie-read
+    slots not individually overridden merge in."""
+    from coreth_tpu.mpt.iterator import leaves
+    from coreth_tpu.mpt.trie import Trie
+    db, root = build_state()
+    tree = Tree(root, GENESIS_HASH)
+    disk = tree.disk
+    disk.gen_marker = b""              # generator running, nothing covered
+    disk._fallback = (db.node_db, root)
+    ah = keccak256(TOKEN)
+    # a block processed + accepted while the generator runs: rewrites
+    # exactly one balance slot of the token
+    sa = StateDB(root, db, snap=tree.snapshot(GENESIS_HASH))
+    sa.set_state(TOKEN, balance_slot(ADDRS[0]),
+                 (777).to_bytes(32, "big"))
+    sa.finalise(True)
+    root_a = sa.intermediate_root(True)
+    sa.commit(True)
+    acc, sto, des = diff_from_statedb(sa)
+    tree.update(b"\xA1" * 32, GENESIS_HASH, root_a, acc, sto, des)
+    tree.flatten(b"\xA1" * 32)
+    from coreth_tpu.state.statedb import normalize_state_key
+    assert (ah, keccak256(normalize_state_key(
+        balance_slot(ADDRS[0])))) in disk._gen_slot_overrides
+    # the generator now reaches the token account (rebuild-root trie)
+    items = [(h, raw)
+             for h, raw in leaves(Trie(root_hash=root, db=db.node_db))
+             if h == ah]
+    tree._apply_generated(db, disk, items)
+    with tree._lock:                   # generation completes
+        disk.gen_marker = None
+        disk._fallback = None
+        disk._gen_overrides = set()
+        disk._gen_slot_overrides = set()
+        disk._gen_storage_blocked = set()
+    fast = StateDB(root_a, db, snap=disk)
+    # the flattened slot kept its newer value over the stale trie read
+    assert fast.get_state(TOKEN, balance_slot(ADDRS[0])) == \
+        (777).to_bytes(32, "big")
+    # ...and every OTHER slot survived generation (the regression)
+    plain = StateDB(root_a, db)
+    for a in ADDRS[1:]:
+        want = plain.get_state(TOKEN, balance_slot(a))
+        assert want != b"\x00" * 32
+        assert fast.get_state(TOKEN, balance_slot(a)) == want
+
+
 def test_chain_reopen_background_generation():
     """A KV-backed chain reopened after accepts regenerates its
     snapshot in the background and serves identical state."""
