@@ -497,6 +497,13 @@ def run_tpu(genesis, wire_blocks, txs_per_block, machine_stats=None):
             machine_stats.update(
                 occ_rounds=mx.rounds,
                 host_txs=mx.host_txs,
+                # which executor served host-side txs: native_txs ran
+                # on the compiled backend (evm/hostexec — serial
+                # short-circuit blocks + natively-served conflict
+                # suffix), host_txs - suffix natives on the Python
+                # interpreter
+                native_txs=mx.native_txs,
+                serial_blocks=mx.serial_blocks,
                 machine_blocks=mx.blocks,
                 dirty_blocks=mx.dirty_blocks,
                 occ_windows=mx.windows,
@@ -575,17 +582,22 @@ def run_mixed():
         if _deadline_tight():
             break
     tpu_runs, stats = [], None
+    from coreth_tpu.evm import hostexec as _hx
     for _ in range(REPS):
         fresh = [Block.decode(w) for w in wire]
         eng, _g = MX.replay_engine(genesis, MIXED_BLOCKS, keys[0],
                                    window=int(os.environ.get(
                                        "BENCH_WINDOW", "128")))
+        _hx.reset_counters()
         t0 = time.monotonic()
         eng.replay(fresh)
         dt = time.monotonic() - t0
         assert eng.root == want_root
         tpu_runs.append(txs / dt)
         stats = eng.stats.row()
+        # which executor served the host-fallback blocks' txs
+        # (evm/hostexec bridge counters for this rep)
+        stats["host_exec"] = _hx.counters()
         if _deadline_tight():
             break
     if os.environ.get("BENCH_VERBOSE"):
@@ -717,6 +729,7 @@ def main():
             mixed_py, mixed_tpu, mixed_stats = run_mixed()
             result.update({
                 "mixed_txs_s": round(_median(mixed_tpu), 1),
+                "mixed_host_exec": mixed_stats.pop("host_exec", {}),
                 "mixed_vs_py_host": round(
                     _median(mixed_tpu) / _median(mixed_py), 2),
                 "mixed_fallback_fraction": round(

@@ -10,6 +10,7 @@ lifecycle the block builder drives.
 from __future__ import annotations
 
 import heapq
+from fractions import Fraction
 from typing import Dict, List, Optional, Set, Tuple
 
 from coreth_tpu.atomic.tx import AtomicTxError, Tx
@@ -30,8 +31,8 @@ class AtomicMempool:
         self.max_size = max_size
         self.verify = verify
         self._txs: Dict[bytes, Tx] = {}
-        self._price: Dict[bytes, float] = {}
-        self._heap: List[Tuple[float, bytes]] = []  # (-price, id)
+        self._price: Dict[bytes, Fraction] = {}
+        self._heap: List[Tuple[Fraction, bytes]] = []  # (-price, id)
         self._utxo_spenders: Dict[bytes, bytes] = {}  # input -> tx id
         self._issued: Set[bytes] = set()
 
@@ -49,10 +50,16 @@ class AtomicMempool:
         return self._txs.get(tx_id)
 
     # ----------------------------------------------------------------- add
-    def _gas_price(self, tx: Tx) -> float:
+    def _gas_price(self, tx: Tx) -> Fraction:
+        """Burned AVAX per gas as an EXACT rational (integer
+        arithmetic): float division here could order two txs whose
+        true fee ratios differ below 2^-53 relative precision
+        inconsistently across hosts — the fee-ordering determinism gap
+        ROADMAP flagged.  Fraction keeps comparisons exact while
+        staying heap- and negate-compatible."""
         gas = tx.unsigned.gas_used(True, len(tx.encode()))
         burned = tx.unsigned.burned(self.ctx.avax_asset_id)
-        return burned / max(gas, 1)
+        return Fraction(burned, max(gas, 1))
 
     def add_tx(self, tx: Tx) -> None:
         """AddTx (:173): verify, resolve UTXO conflicts by price, cap
@@ -84,7 +91,7 @@ class AtomicMempool:
         for inp in tx.unsigned.input_utxos():
             self._utxo_spenders[inp] = tx_id
 
-    def _evict_cheapest(self, floor: float) -> None:
+    def _evict_cheapest(self, floor: Fraction) -> None:
         victim = None
         worst = floor
         for tx_id, p in self._price.items():

@@ -29,13 +29,37 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """True when any C++ source is newer than the built library."""
+    try:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        for fn in os.listdir(_NATIVE_DIR):
+            if fn.endswith(".cc") or fn == "Makefile":
+                if os.path.getmtime(
+                        os.path.join(_NATIVE_DIR, fn)) > lib_mtime:
+                    return True
+    except OSError:
+        return False
+    return False
+
+
 def load():
-    """Load (building if necessary) the native library, or return None."""
+    """Load the native library, or return None.
+
+    Builds when the .so is missing, and REBUILDS when any source file
+    is newer than it (a prebuilt library must not mask source edits).
+    If the rebuild fails (no C++ toolchain), the existing prebuilt .so
+    still loads — callers probe per-symbol (hasattr) for ABI surfaces
+    newer than the prebuilt, so features degrade one by one instead of
+    all-or-nothing."""
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and not _build():
-        return None
+    if not os.path.exists(_LIB_PATH):
+        if not _build():
+            return None
+    elif _stale():
+        _build()  # best effort: fall back to the prebuilt on failure
     lib = ctypes.CDLL(_LIB_PATH)
     lib.coreth_keccak256.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]

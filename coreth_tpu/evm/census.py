@@ -1,0 +1,96 @@
+"""Shared opcode census for bytecode eligibility decisions.
+
+ONE walker (PUSH-data-skipping, the core/vm/analysis.go codeBitmap
+walk) feeds every backend's eligibility question, so the device
+machine's classifier (evm/device/tables.scan_code), the native host
+executor (evm/hostexec/eligibility), and the coverage-assertion tests
+all see the same opcode multiset for a given bytecode — a contract
+cannot silently outgrow one backend's opcode set without the shared
+census (and its tests) noticing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+
+def iter_ops(code: bytes) -> Iterator[int]:
+    """Yield executed-position opcodes, skipping PUSH immediates."""
+    i = 0
+    n = len(code)
+    while i < n:
+        op = code[i]
+        yield op
+        i += op - 0x5F + 1 if 0x60 <= op <= 0x7F else 1
+
+
+_CENSUS_CACHE: Dict[bytes, Dict[int, int]] = {}
+
+
+def opcode_census(code: bytes) -> Dict[int, int]:
+    """Opcode -> occurrence count over the executed positions of
+    `code` (memoized by code hash)."""
+    from coreth_tpu.crypto import keccak256
+    key = keccak256(code)
+    cached = _CENSUS_CACHE.get(key)
+    if cached is not None:
+        return cached
+    counts: Dict[int, int] = {}
+    for op in iter_ops(code):
+        counts[op] = counts.get(op, 0) + 1
+    _CENSUS_CACHE[key] = counts
+    return counts
+
+
+_STATIC_KEYS_CACHE: Dict[bytes, Optional[Tuple[Tuple[bytes, ...],
+                                               Tuple[bytes, ...]]]] = {}
+
+
+def static_storage_keys(
+        code: bytes) -> Optional[Tuple[Tuple[bytes, ...],
+                                       Tuple[bytes, ...]]]:
+    """(read_keys, write_keys) when EVERY SLOAD/SSTORE in `code` takes
+    a PUSH-constant key, else None (a computed key — e.g. the keccak
+    mapping slots of the token — makes the sets statically unknowable).
+
+    This is the scheduler's provably-serial detector input: a contract
+    whose storage footprint is a fixed constant-key set (the swap
+    pool's reserve slots 0/1) gives every calling tx the SAME
+    read/write sets, so any two txs into it conflict and a block of
+    them is a serial chain — no point paying device OCC rounds.
+
+    Conservative by construction: keys are the *potential* footprint
+    (branches may skip ops), and any non-constant key disables the
+    answer entirely.  Memoized by code hash like opcode_census — the
+    scheduler consults this per block of every machine run.
+    """
+    from coreth_tpu.crypto import keccak256
+    cache_key = keccak256(code)
+    if cache_key in _STATIC_KEYS_CACHE:
+        return _STATIC_KEYS_CACHE[cache_key]
+    reads = []
+    writes = []
+    prev_push: Optional[bytes] = None
+    i = 0
+    n = len(code)
+    while i < n:
+        op = code[i]
+        if 0x60 <= op <= 0x7F:
+            size = op - 0x5F
+            prev_push = bytes(code[i + 1:i + 1 + size]).rjust(32, b"\x00")
+            i += size + 1
+            continue
+        if op == 0x5F:  # PUSH0
+            prev_push = b"\x00" * 32
+            i += 1
+            continue
+        if op in (0x54, 0x55):
+            if prev_push is None:
+                _STATIC_KEYS_CACHE[cache_key] = None
+                return None
+            (reads if op == 0x54 else writes).append(prev_push)
+        prev_push = None
+        i += 1
+    out = (tuple(reads), tuple(writes))
+    _STATIC_KEYS_CACHE[cache_key] = out
+    return out

@@ -135,6 +135,7 @@ def scan_code(code: bytes, fork: str,
     long replays.
     """
     from coreth_tpu.crypto import keccak256
+    from coreth_tpu.evm.census import opcode_census
     key = (keccak256(code), fork)
     cached = _SCAN_CACHE.get(key)
     if cached is not None:
@@ -145,15 +146,8 @@ def scan_code(code: bytes, fork: str,
         return info
     supported = op_tables(fork).supported  # 0 = undefined per fork
     feats = set()
-    i = 0
-    n = len(code)
     info = None
-    while i < n:
-        op = code[i]
-        if 0x60 <= op <= 0x7F:
-            i += op - 0x5F + 1
-        else:
-            i += 1
+    for op in sorted(opcode_census(code)):
         if supported[op] == 0:
             continue  # undefined: INVALID at runtime, device handles
         if supported[op] == 2:

@@ -171,6 +171,15 @@ class EVM:
                 p, caller, addr, addr, input_, gas, value, False, snapshot)
             tracer.capture_end(ret, gas - gas_left, err)
             return ret, gas_left, err
+        if self.depth == 0 and p is None:
+            # compiled host executor for root frames (evm/hostexec):
+            # returns None for anything outside the native opcode set,
+            # and the interpreter below remains the exact fallback
+            from coreth_tpu.evm.hostexec import try_call
+            native = try_call(self, caller, addr, input_, gas, value,
+                              snapshot)
+            if native is not None:
+                return native
         return self._execute(p, caller, addr, addr, input_, gas, value,
                              False, snapshot)
 

@@ -1,0 +1,34 @@
+"""Native host execution backend (the compiled tx executor).
+
+`native/evm.cc`'s hostexec session executes full transactions against
+a StateDB-backed host interface — storage and callee code resolve
+through Python callbacks — and returns gas, status, logs, return data,
+and the cross-contract write set.  It serves the replay engine's host
+escape paths (ReplayEngine._fallback through the Processor, the OCC
+conflict suffix in replay/machine_block, and the serial-block
+short-circuit) at the compiled sequential rate instead of the
+interpreted-Python rate, with bit-identical receipts and roots.
+
+Selection: ``CORETH_HOST_EXEC=native`` (default — used when the native
+library is available and the bytecode fits the compiled opcode set) or
+``py`` (force the Python interpreter everywhere).  Every ineligible or
+runtime-escaping tx falls back to the Python interpreter per tx; the
+interpreter also stays on as the differential oracle
+(``CORETH_HOST_EXEC_CHECK=1`` cross-checks every native result against
+it — tests/test_hostexec.py).
+"""
+
+from __future__ import annotations
+
+from coreth_tpu.evm.hostexec.bridge import (  # noqa: F401
+    counters, reset_counters, try_call,
+)
+from coreth_tpu.evm.hostexec.eligibility import (  # noqa: F401
+    native_eligible, native_optable,
+)
+
+
+def available() -> bool:
+    """True when the native library exports the hostexec session ABI."""
+    from coreth_tpu.evm.hostexec.backend import load_hostexec
+    return load_hostexec() is not None

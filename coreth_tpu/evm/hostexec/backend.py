@@ -1,0 +1,257 @@
+"""ctypes boundary to the native hostexec session (native/evm.cc).
+
+One ``HostExecBackend`` wraps one C++ session: registered contract
+codes, a committed-storage cache fed by a Python resolver callback,
+and per-call outputs (status/gas/refund/logs/writes/return data).
+The session is deliberately dumb about state ownership — the caller
+decides when cached storage is stale (``clear_storage``) and when a
+call's writes become the next call's committed base (``commit``), so
+the same wrapper serves both the StateDB bridge (fresh view per tx)
+and the serial-block short-circuit (sequential carry per block).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Dict, List, Optional, Tuple
+
+from coreth_tpu.evm.device import machine as M
+from coreth_tpu.evm.hostexec.eligibility import (
+    REFUND_FORKS, native_optable,
+)
+
+_FETCH_SLOT = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8))
+_FETCH_CODE = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(ctypes.c_uint8))
+
+_lib = None
+_lib_probed = False
+
+
+def load_hostexec():
+    """The native library iff it exports the hostexec ABI (an older
+    prebuilt .so without the symbols -> None; callers fall back)."""
+    global _lib, _lib_probed
+    if _lib_probed:
+        return _lib
+    _lib_probed = True
+    from coreth_tpu.crypto import native
+    lib = native.load()
+    if lib is None or not hasattr(lib, "coreth_hostexec_new"):
+        return None
+    lib.coreth_hostexec_new.argtypes = [
+        ctypes.c_uint64, _FETCH_SLOT, _FETCH_CODE, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.coreth_hostexec_new.restype = ctypes.c_void_p
+    lib.coreth_hostexec_free.argtypes = [ctypes.c_void_p]
+    lib.coreth_hostexec_env.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_char_p]
+    lib.coreth_hostexec_set_code.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint32]
+    lib.coreth_hostexec_clear_storage.argtypes = [ctypes.c_void_p]
+    lib.coreth_hostexec_reset.argtypes = [ctypes.c_void_p]
+    lib.coreth_hostexec_seed_slot.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p]
+    lib.coreth_hostexec_warm_addr.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p]
+    lib.coreth_hostexec_warm_slot.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.coreth_hostexec_call.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint32, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.coreth_hostexec_call.restype = ctypes.c_int
+    lib.coreth_hostexec_out_writes.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p]
+    lib.coreth_hostexec_out_logs.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p]
+    lib.coreth_hostexec_out_ret.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p]
+    lib.coreth_hostexec_commit.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class NativeCallResult:
+    """One native tx execution: machine-coded status + writeback set."""
+
+    __slots__ = ("status", "gas_left", "refund", "writes", "logs",
+                 "ret", "host_reason")
+
+    def __init__(self, status: int, gas_left: int, refund: int,
+                 writes: Dict[Tuple[bytes, bytes], bytes],
+                 logs: List[Tuple[bytes, List[bytes], bytes]],
+                 ret: bytes, host_reason: int):
+        self.status = status          # M.STOP / M.REVERT / M.ERR / M.HOST
+        self.gas_left = gas_left
+        self.refund = refund
+        self.writes = writes          # (contract, masked key) -> value32
+        self.logs = logs              # (address, topics, data), in order
+        self.ret = ret
+        self.host_reason = host_reason
+
+    @property
+    def needs_host(self) -> bool:
+        return self.status == M.HOST
+
+
+# C++ status codes -> machine status codes (they match by design; the
+# assertion is cheap insurance against either side drifting)
+assert (M.STOP, M.REVERT, M.ERR, M.HOST) == (1, 2, 3, 4)
+
+
+class HostExecBackend:
+    """One native session bound to resolver callbacks.
+
+    slot_resolver(contract20, masked_key32) -> 32-byte committed value.
+    code_resolver(addr20) -> runtime bytecode, b"" for a known EOA, or
+    None when the host interpreter must take the tx (precompile target,
+    existing-but-empty account, ineligible callee bytecode).
+    """
+
+    def __init__(self, fork: str, chain_id: int,
+                 slot_resolver: Callable[[bytes, bytes], bytes],
+                 code_resolver: Callable[[bytes], Optional[bytes]]):
+        lib = load_hostexec()
+        if lib is None:
+            raise RuntimeError("hostexec native ABI unavailable")
+        self._lib = lib
+        self.fork = fork
+        self._registered: Dict[bytes, bytes] = {}
+
+        def _fetch(addr_p, key_p, out_p):
+            try:
+                v = slot_resolver(bytes(addr_p[:20]), bytes(key_p[:32]))
+                for i in range(32):
+                    out_p[i] = v[i]
+                return 1
+            except Exception:  # noqa: BLE001 — a raise would corrupt the C stack; zero value keeps semantics (missing slot)
+                return 0
+
+        def _code(addr_p):
+            addr = bytes(addr_p[:20])
+            try:
+                code = code_resolver(addr)
+            except Exception:  # noqa: BLE001 — resolver failure routes the tx to the host interpreter
+                return -1
+            if code is None:
+                return -1
+            if not code:
+                return 0
+            self.set_code(addr, code)
+            return 1
+
+        # the CFUNCTYPE trampolines must outlive the session
+        self._fetch_cb = _FETCH_SLOT(_fetch)
+        self._code_cb = _FETCH_CODE(_code)
+        self._h = lib.coreth_hostexec_new(
+            chain_id, self._fetch_cb, self._code_cb,
+            native_optable(fork), 1 if fork in REFUND_FORKS else 0)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.coreth_hostexec_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown may have dropped ctypes already
+            pass
+
+    # ------------------------------------------------------------ state
+    def set_env(self, coinbase: bytes, timestamp: int, number: int,
+                gas_limit: int, base_fee: int,
+                difficulty: int = 1) -> None:
+        self._lib.coreth_hostexec_env(
+            self._h, coinbase, timestamp, number, gas_limit,
+            difficulty, (base_fee or 0).to_bytes(32, "big"))
+
+    def set_code(self, addr: bytes, code: bytes) -> None:
+        if self._registered.get(addr) == code:
+            return
+        self._lib.coreth_hostexec_set_code(self._h, addr, code,
+                                           len(code))
+        self._registered[addr] = code
+
+    def clear_storage(self) -> None:
+        """Drop the committed-slot cache (underlying state moved)."""
+        self._lib.coreth_hostexec_clear_storage(self._h)
+
+    def reset_contracts(self) -> None:
+        """Drop codes, EOA/contract kinds AND storage: per-tx hygiene
+        for the StateDB bridge, where a mid-block deploy can change
+        what an address resolves to between txs."""
+        self._lib.coreth_hostexec_reset(self._h)
+        self._registered.clear()
+
+    def seed_slot(self, contract: bytes, key: bytes,
+                  value: bytes) -> None:
+        """Install a committed value (OCC prefix overlay)."""
+        self._lib.coreth_hostexec_seed_slot(self._h, contract, key,
+                                            value)
+
+    def commit(self) -> None:
+        """Fold the last call's writes into the committed cache."""
+        self._lib.coreth_hostexec_commit(self._h)
+
+    # ------------------------------------------------------------- call
+    def call(self, caller: bytes, to: bytes, value: int,
+             gas_price: int, data: bytes, gas: int,
+             warm_addrs=(), warm_slots=()) -> NativeCallResult:
+        lib = self._lib
+        for a in warm_addrs:
+            lib.coreth_hostexec_warm_addr(self._h, a)
+        for a, k in warm_slots:
+            lib.coreth_hostexec_warm_slot(self._h, a, k)
+        out = (ctypes.c_int64 * 7)()
+        status = lib.coreth_hostexec_call(
+            self._h, caller, to, value.to_bytes(32, "big"),
+            gas_price.to_bytes(32, "big"), data, len(data), gas, out)
+        n_writes, n_logs = int(out[2]), int(out[3])
+        log_data_total, ret_len = int(out[4]), int(out[5])
+        writes: Dict[Tuple[bytes, bytes], bytes] = {}
+        if n_writes:
+            wa = ctypes.create_string_buffer(20 * n_writes)
+            wk = ctypes.create_string_buffer(32 * n_writes)
+            wv = ctypes.create_string_buffer(32 * n_writes)
+            lib.coreth_hostexec_out_writes(self._h, wa, wk, wv)
+            for i in range(n_writes):
+                writes[(wa.raw[20 * i:20 * i + 20],
+                        wk.raw[32 * i:32 * i + 32])] = \
+                    wv.raw[32 * i:32 * i + 32]
+        logs: List[Tuple[bytes, List[bytes], bytes]] = []
+        if n_logs:
+            la = ctypes.create_string_buffer(20 * n_logs)
+            lnt = (ctypes.c_int32 * n_logs)()
+            lt = ctypes.create_string_buffer(4 * 32 * n_logs)
+            ld = (ctypes.c_int32 * n_logs)()
+            blob = ctypes.create_string_buffer(max(1, log_data_total))
+            lib.coreth_hostexec_out_logs(self._h, la, lnt, lt, ld, blob)
+            off = 0
+            for i in range(n_logs):
+                topics = [lt.raw[(4 * i + j) * 32:(4 * i + j) * 32 + 32]
+                          for j in range(int(lnt[i]))]
+                dn = int(ld[i])
+                logs.append((la.raw[20 * i:20 * i + 20], topics,
+                             blob.raw[off:off + dn]))
+                off += dn
+        ret = b""
+        if ret_len:
+            rb = ctypes.create_string_buffer(ret_len)
+            lib.coreth_hostexec_out_ret(self._h, rb)
+            ret = rb.raw
+        return NativeCallResult(
+            status=status, gas_left=int(out[0]), refund=int(out[1]),
+            writes=writes, logs=logs, ret=ret,
+            host_reason=int(out[6]))

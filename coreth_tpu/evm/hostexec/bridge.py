@@ -1,0 +1,206 @@
+"""StateDB bridge: route depth-0 EVM calls through the native engine.
+
+``try_call`` is invoked by EVM.call for root frames (evm.py).  When the
+target bytecode fits the compiled opcode set, the tx executes in C++
+against the live StateDB (storage/code resolved through callbacks) and
+the results — storage writes, logs, return data, gas — are journaled
+back through the normal StateDB mutators, so receipts, roots, and
+revert semantics are bit-identical to the interpreted path.  Any
+ineligibility (host-only opcode, precompile callee, value-carrying
+subcall, tracer attached) returns None and the caller proceeds on the
+Python interpreter — per-tx fallback, never a wrong answer.
+
+This single seam serves every host execution site: the ReplayEngine's
+``_fallback`` (through Processor/apply_message), the OCC conflict
+suffix (replay/machine_block._host_resolve builds EVM.call directly),
+and eth_call-style RPC paths.
+
+``CORETH_HOST_EXEC_CHECK=1`` keeps the Python interpreter in the loop
+as a differential oracle: every native result is re-derived on a
+StateDB copy and compared (status, gas, return data, writes, logs,
+refund) before being accepted.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from coreth_tpu.evm import vmerrs
+from coreth_tpu.evm.device import machine as M
+from coreth_tpu.evm.device.tables import fork_key
+from coreth_tpu.evm.hostexec.eligibility import native_eligible
+
+# which executor served depth-0 calls (bench.py reports these)
+_COUNTERS: Dict[str, int] = {}
+
+
+def counters() -> Dict[str, int]:
+    return dict(_COUNTERS)
+
+
+def reset_counters() -> None:
+    _COUNTERS.clear()
+
+
+def _bump(key: str) -> None:
+    _COUNTERS[key] = _COUNTERS.get(key, 0) + 1
+
+
+def _mode() -> str:
+    # read per call (not import time) so tests and benches can retune
+    # between engine constructions, like the other CORETH_* toggles
+    return os.environ.get("CORETH_HOST_EXEC", "native")
+
+
+def _backend_for(evm, fork: str):
+    """Session cached on the EVM object (one fork per EVM instance);
+    False is the 'probed, unavailable' sentinel."""
+    be = getattr(evm, "_hostexec_backend", None)
+    if be is not None:
+        return be or None
+    from coreth_tpu.evm.hostexec.backend import (
+        HostExecBackend, load_hostexec,
+    )
+    if load_hostexec() is None:
+        evm._hostexec_backend = False
+        return None
+
+    def slot_resolver(contract: bytes, key: bytes) -> bytes:
+        # pre-tx view: current == committed at tx start (earlier txs
+        # of the block were finalised into pending_storage)
+        return evm.statedb.get_state(contract, key)
+
+    def code_resolver(addr: bytes) -> Optional[bytes]:
+        if evm.precompile(addr) is not None:
+            return None  # precompile callees run on the host only
+        db = evm.statedb
+        code = db.get_code(addr)
+        if code:
+            ok, _ = native_eligible(code, fork)
+            return code if ok else None
+        if db.exist(addr) and db.empty(addr):
+            # calling an existing-but-empty account touches it into
+            # EIP-158 deletion — StateDB journal semantics the native
+            # engine does not model
+            return None
+        return b""
+
+    be = HostExecBackend(fork, evm.chain_id, slot_resolver,
+                         code_resolver)
+    evm._hostexec_backend = be
+    return be
+
+
+def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
+             value: int, snapshot: int):
+    """Native execution of one root call; None -> interpreter path."""
+    if _mode() != "native":
+        return None
+    fork = fork_key(evm.rules)
+    if fork is None:
+        return None
+    if gas >= (1 << 62):
+        return None  # int64 ABI headroom (eth_call-style giant gas)
+    statedb = evm.statedb
+    code = statedb.get_code(addr)
+    if not code:
+        return None
+    eligible, _reason = native_eligible(code, fork)
+    if not eligible:
+        _bump("py_ineligible")
+        return None
+    be = _backend_for(evm, fork)
+    if be is None:
+        return None
+    ctx = evm.block_ctx
+    # full per-tx reset (codes + kinds + storage): the StateDB moved
+    # since the last tx, and an interpreter-path CREATE in between may
+    # have changed what a cached callee address resolves to
+    be.reset_contracts()
+    be.set_env(ctx.coinbase, ctx.time, ctx.number, ctx.gas_limit,
+               ctx.base_fee or 0, ctx.difficulty)
+    be.set_code(addr, code)
+    res = be.call(
+        caller, addr, value, evm.tx_ctx.gas_price, input_, gas,
+        warm_addrs=sorted(statedb.access_list_addresses),
+        warm_slots=sorted(statedb.access_list_slots))
+    if res.needs_host:
+        _bump("host_escapes")
+        return None
+    if os.environ.get("CORETH_HOST_EXEC_CHECK"):
+        _differential_check(evm, caller, addr, input_, gas, value, res)
+    if res.status == M.ERR:
+        # the outcome (all gas burned, status-0 receipt) is already
+        # proven equal, but callers pin the exact error TAXONOMY
+        # (ErrInvalidOpCode vs ErrOutOfGas vs ErrInvalidJump...) that
+        # only the interpreter derives — re-run the dead tx there.
+        # Error txs are rare and bounded by their own burned gas.
+        _bump("err_fallbacks")
+        return None
+    _bump("native_calls")
+    if res.status == M.STOP:
+        for (contract, key), v in res.writes.items():
+            statedb.set_state(contract, key, v)
+        from coreth_tpu.types.receipt import Log
+        for log_addr, topics, data in res.logs:
+            statedb.add_log(Log(address=log_addr, topics=list(topics),
+                                data=data,
+                                block_number=ctx.number))
+        if res.refund > 0:
+            statedb.add_refund(res.refund)
+        elif res.refund < 0:
+            statedb.sub_refund(-res.refund)
+        return res.ret, res.gas_left, None
+    # REVERT: the payload + surviving gas carry all the information
+    # the caller needs; no interpreter re-run required
+    statedb.revert_to_snapshot(snapshot)
+    err = vmerrs.ErrExecutionReverted()
+    err.data = res.ret
+    return res.ret, res.gas_left, err
+
+
+def _differential_check(evm, caller, addr, input_, gas, value,
+                        res) -> None:
+    """Re-derive the call on the Python interpreter over a StateDB copy
+    and assert equality — the differential-oracle mode of the docstring
+    (raises on the first divergence; test/debug only)."""
+    from coreth_tpu.evm.evm import EVM
+    copy = evm.statedb.copy()
+    evm2 = EVM(evm.block_ctx, evm.tx_ctx, copy, evm.chain_config,
+               evm.config)
+    snap2 = copy.snapshot()
+    n_logs0 = len(copy.logs)
+    refund0 = copy.refund
+    ret2, gas2, err2 = evm2._execute(
+        None, caller, addr, addr, input_, gas, value, False, snap2)
+    if err2 is None:
+        status2 = M.STOP
+    elif isinstance(err2, vmerrs.ErrExecutionReverted):
+        status2 = M.REVERT
+    else:
+        status2 = M.ERR
+    if (res.status, res.gas_left) != (status2, gas2):
+        raise AssertionError(
+            f"hostexec divergence: native (status={res.status}, "
+            f"gas={res.gas_left}) != py (status={status2}, gas={gas2})")
+    if res.status != M.ERR and res.ret != ret2:
+        raise AssertionError("hostexec divergence: return data")
+    if res.status == M.STOP:
+        for (contract, key), v in res.writes.items():
+            got = copy.get_state(contract, key)
+            if got != v:
+                raise AssertionError(
+                    f"hostexec divergence: write {key.hex()}: "
+                    f"native {v.hex()} != py {got.hex()}")
+        py_logs = copy.logs[n_logs0:]
+        if len(py_logs) != len(res.logs):
+            raise AssertionError("hostexec divergence: log count")
+        for lg, (la, topics, data) in zip(py_logs, res.logs):
+            if (bytes(lg.address), [bytes(t) for t in lg.topics],
+                    bytes(lg.data)) != (la, topics, data):
+                raise AssertionError("hostexec divergence: log body")
+        if copy.refund - refund0 != res.refund:
+            raise AssertionError(
+                f"hostexec divergence: refund native {res.refund} != "
+                f"py {copy.refund - refund0}")

@@ -93,6 +93,8 @@ class MachineBlockExecutor:
         self.rounds = 0            # OCC re-execution rounds (stats)
         self.blocks = 0
         self.host_txs = 0          # conflict-suffix txs resolved on host
+        self.native_txs = 0        # host-side txs served by evm/hostexec
+        self.serial_blocks = 0     # blocks the serial short-circuit took
         self.windows = 0           # fused OCC windows completed
         self.window_attempts = 0   # dispatches those windows took
         self.dirty_blocks = 0      # blocks the fused path escalated
@@ -180,9 +182,11 @@ class MachineBlockExecutor:
         from coreth_tpu.evm.device.adapter import TxResult
         from coreth_tpu.evm.evm import (
             EVM, BlockContext, Config, TxContext)
+        from coreth_tpu.evm.hostexec import counters as hx_counters
         from coreth_tpu import vmerrs
         from coreth_tpu.state import StateDB
         e = self.e
+        hx0 = hx_counters().get("native_calls", 0)
         rules = e.config.rules(block.number, block.time)
         e.commit()  # persist engine tries so the scratch db can read
         scratch = StateDB(e.root, e.db)
@@ -190,6 +194,10 @@ class MachineBlockExecutor:
             coinbase=block.header.coinbase, number=block.number,
             time=block.time, gas_limit=block.header.gas_limit,
             base_fee=block.base_fee)
+        # ONE EVM for the whole suffix (reset per tx): the hostexec
+        # bridge caches its native session on the EVM object, so a
+        # deep conflict chain pays one session setup, not one per tx
+        evm = EVM(block_ctx, TxContext(), scratch, e.config, Config())
         boosted = set()
         for i in call_idx:
             pl = plans[i]
@@ -210,9 +218,8 @@ class MachineBlockExecutor:
                 boosted.add(pl.sender)
             scratch.prepare(rules, pl.sender, block.header.coinbase,
                             pl.to, list(rules.active_precompiles), [])
-            evm = EVM(block_ctx,
-                      TxContext(origin=pl.sender, gas_price=pl.price),
-                      scratch, e.config, Config())
+            evm.reset(TxContext(origin=pl.sender, gas_price=pl.price),
+                      scratch)
             n_logs = len(scratch.logs)
             ret, gas_left, err = evm.call(
                 pl.sender, pl.to, pl.data,
@@ -241,6 +248,9 @@ class MachineBlockExecutor:
                 status=status, gas_left=gas_left, refund=0, logs=logs,
                 reads={}, writes=writes)
             self.host_txs += 1
+        # which executor actually served the suffix: EVM.call routes
+        # eligible txs through the native backend (evm/hostexec bridge)
+        self.native_txs += hx_counters().get("native_calls", 0) - hx0
 
     # ------------------------------------------------------------- storage
     def _base_value(self, contract: bytes, key: bytes) -> int:
@@ -483,6 +493,116 @@ class MachineBlockExecutor:
         e.stats.txs += len(block.transactions)
         return root
 
+    # -------------------------------------------- serial short-circuit
+    def _serial_eligible(self, plans: List[TxPlan]) -> bool:
+        """Provably-serial machine block: >=2 call txs, ONE shared
+        contract, and a statically-known (PUSH-constant) storage
+        footprint with writes — any two txs then conflict through the
+        same keys (the swap shape), so device OCC would degrade to one
+        lane per round anyway.  Such blocks dispatch straight to the
+        sequential native executor; blocks with computed keys (the
+        token's keccak mapping slots) keep their real independence and
+        stay on device OCC."""
+        if not bool(int(os.environ.get(
+                "CORETH_SERIAL_SHORTCIRCUIT", "1"))):
+            return False
+        if os.environ.get("CORETH_HOST_EXEC", "native") != "native":
+            return False
+        calls = [pl for pl in plans if pl.kind == "call"]
+        if len(calls) < 2:
+            return False
+        target = calls[0].to
+        for pl in calls[1:]:
+            if pl.to != target:
+                return False
+        from coreth_tpu.evm.census import static_storage_keys
+        keys = static_storage_keys(calls[0].code)
+        if keys is None or not keys[1]:
+            return False  # computed or write-free footprint
+        from coreth_tpu.evm.hostexec.eligibility import native_eligible
+        ok, _reason = native_eligible(calls[0].code, self._fork)
+        if not ok:
+            return False
+        from coreth_tpu.evm.hostexec.backend import load_hostexec
+        return load_hostexec() is not None
+
+    def _execute_serial_run(self, items) -> int:
+        """Sequentially execute a run of provably-serial blocks through
+        the native host executor (no device rounds at all); returns
+        blocks consumed.  A native escape (a CALL into unknown code,
+        say) demotes THAT block to the legacy OCC path and the run
+        continues; consensus failures raise like every other path."""
+        from coreth_tpu.evm.device.adapter import TxResult
+        from coreth_tpu.evm.hostexec.backend import HostExecBackend
+        from coreth_tpu.evm.hostexec.eligibility import (
+            COINBASE_WARM_FORKS,
+        )
+        e = self.e
+
+        def resolver(contract: bytes, key: bytes) -> bytes:
+            return self._base_value(contract, key).to_bytes(32, "big")
+
+        def code_resolver(_addr: bytes):
+            # any dynamic callee routes the tx (and block) off the
+            # serial path — the detector only proved the ROOT contract
+            return None
+
+        be = HostExecBackend(self._fork, e.config.chain_id, resolver,
+                             code_resolver)
+        warm_coinbase = self._fork in COINBASE_WARM_FORKS  # EIP-3651
+        consumed = 0
+        try:
+            for block, plans in items:
+                t0 = time.monotonic()
+                be.set_env(block.header.coinbase, block.time,
+                           block.number, block.header.gas_limit,
+                           block.base_fee or 0)
+                results: Dict[int, object] = {}
+                escaped = False
+                for i, pl in enumerate(plans):
+                    if pl.kind != "call":
+                        continue
+                    be.set_code(pl.to, pl.code)
+                    warm = [pl.sender, pl.to]
+                    if warm_coinbase:
+                        warm.append(block.header.coinbase)
+                    res = be.call(pl.sender, pl.to, pl.value, pl.price,
+                                  pl.data, pl.gas_limit - pl.intrinsic,
+                                  warm_addrs=warm)
+                    if res.needs_host or any(
+                            c != pl.to for c, _k in res.writes):
+                        escaped = True
+                        break
+                    if res.status == M.STOP:
+                        be.commit()  # sequential carry within the block
+                    results[i] = TxResult(
+                        status=res.status, gas_left=res.gas_left,
+                        refund=res.refund,
+                        logs=[(topics, data)
+                              for _a, topics, data in res.logs],
+                        reads={},  # exact by construction
+                        writes={k: int.from_bytes(v, "big")
+                                for (_c, k), v in res.writes.items()})
+                e.stats.t_device += time.monotonic() - t0
+                if escaped:
+                    root = self.execute(block, plans)
+                    if root is None:
+                        return consumed
+                    be.clear_storage()  # execute() moved the tries
+                else:
+                    n_calls = len(results)
+                    self._finish_block(block, plans, results)
+                    self.serial_blocks += 1
+                    self.native_txs += n_calls
+                consumed += 1
+        finally:
+            be.close()
+            if self._runner is not None:
+                # the window runner's mirror/table never saw these
+                # writes; epoch bump forces its rebuild on next use
+                e.storage_epoch += 1
+        return consumed
+
     # ------------------------------------------------- fused OCC windows
     def _window_runner(self) -> MachineWindowRunner:
         """The persistent fused-OCC runner; rebuilt when the fork
@@ -536,6 +656,20 @@ class MachineBlockExecutor:
         re-classify against the repaired state.
         """
         e = self.e
+        # serial-block short-circuit: provably-serial blocks skip the
+        # device entirely (before ANY round is dispatched) and run on
+        # the sequential native executor at the compiled floor
+        if self._serial_eligible(items[0][1]):
+            k = 1
+            while k < len(items) and self._serial_eligible(items[k][1]):
+                k += 1
+            return self._execute_serial_run(items[:k])
+        # ... and a serial block mid-run ends this window batch so the
+        # NEXT execute_run call gives it the short-circuit
+        for n in range(1, len(items)):
+            if self._serial_eligible(items[n][1]):
+                items = items[:n]
+                break
         if not bool(int(os.environ.get("CORETH_DEVICE_OCC", "1"))):
             block, plans = items[0]
             return 1 if self.execute(block, plans) is not None else 0
@@ -565,6 +699,7 @@ class MachineBlockExecutor:
             # would resurrect pre-chunk values (root mismatch).  The
             # trie folds below stay deferred — only the cheap dict
             # update moves ahead of the dispatch.
+            pre_committed = False
             if ci + 1 < len(chunks) and all(wres.clean):
                 for k, (_block, plans) in enumerate(chunk):
                     calls = [pl for pl in plans if pl.kind == "call"]
@@ -574,6 +709,7 @@ class MachineBlockExecutor:
                             for key, v in res.writes.items():
                                 writes[(pl.to, key)] = v
                     runner.commit_block(writes)
+                pre_committed = True
                 t0 = time.monotonic()
                 inflight = runner.issue(
                     self._window_items(chunks[ci + 1]))
@@ -587,7 +723,10 @@ class MachineBlockExecutor:
                     self.rounds += max(0, wres.rounds[k] - 1)
                     # _finish_block also advances blocks/stats/root
                     self._finish_block(block, plans, results)
-                    runner.commit_block(self.last_writes)
+                    if not pre_committed:
+                        # mirror already learned this chunk's writes
+                        # ahead of the pipelined issue() above
+                        runner.commit_block(self.last_writes)
                     consumed += 1
                     continue
                 # dirty: partial commits may sit in the device table,

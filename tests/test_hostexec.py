@@ -1,0 +1,381 @@
+"""Native host execution backend (evm/hostexec): eligibility census,
+StateDB-bridge parity against the Python oracle, and the scheduler's
+serial-block short-circuit.
+
+The Python interpreter is the differential oracle throughout:
+CORETH_HOST_EXEC_CHECK=1 makes the bridge re-derive every native
+result on a StateDB copy and raise on the first divergence, so a
+passing run here IS a statement of bit-identical receipts/roots over
+the exercised shapes."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.evm import hostexec
+from coreth_tpu.evm.census import (
+    opcode_census, static_storage_keys,
+)
+from coreth_tpu.evm.hostexec.eligibility import (
+    native_eligible, native_opcodes, native_optable,
+)
+
+pytestmark = pytest.mark.skipif(
+    not hostexec.available(),
+    reason="hostexec native ABI unavailable (no C++ toolchain and no "
+           "prebuilt libcoreth_native.so with the session symbols)")
+
+
+# ------------------------------------------------------------- census
+
+def test_census_walker_skips_push_data():
+    # PUSH2 carries 0x54 0x55 as DATA; only PUSH2 and STOP execute
+    code = bytes([0x61, 0x54, 0x55, 0x00])
+    assert opcode_census(code) == {0x61: 1, 0x00: 1}
+
+
+def test_static_storage_keys_constant_footprint():
+    from coreth_tpu.workloads.swap import POOL_RUNTIME
+    keys = static_storage_keys(POOL_RUNTIME)
+    assert keys is not None
+    reads, writes = keys
+    zero = (0).to_bytes(32, "big")
+    one = (1).to_bytes(32, "big")
+    assert set(reads) == {zero, one}
+    assert set(writes) == {zero, one}
+
+
+def test_static_storage_keys_computed_keys_unknown():
+    from coreth_tpu.workloads.erc20 import TOKEN_RUNTIME
+    # the token's balance slots are keccak-derived -> not static
+    assert static_storage_keys(TOKEN_RUNTIME) is None
+
+
+def test_workload_contracts_native_coverage():
+    """Coverage assertion: every bench/workload contract must stay
+    inside BOTH backends' opcode sets — fails loudly the day a
+    workload silently outgrows the native (or device) engine."""
+    from coreth_tpu.evm.device.tables import scan_code
+    from coreth_tpu.workloads.erc20 import TOKEN_RUNTIME
+    from coreth_tpu.workloads.swap import POOL_RUNTIME
+    for name, code in (("erc20", TOKEN_RUNTIME),
+                       ("swap", POOL_RUNTIME)):
+        ok, reason = native_eligible(code, "durango")
+        assert ok, f"{name} outgrew the native opcode set: {reason}"
+        info = scan_code(code, "durango")
+        assert info.eligible, f"{name} outgrew the device set: " \
+                              f"{info.reason}"
+        # and the census agrees with the per-fork table classification
+        table = native_optable("durango")
+        for op in opcode_census(code):
+            assert table[op] != 2, f"{name} uses host-only 0x{op:02x}"
+
+
+def test_native_optable_fork_gating():
+    assert native_optable("durango")[0x5F] == 1     # PUSH0 native
+    assert native_optable("ap3")[0x5F] == 0         # ... undefined pre
+    assert native_optable("ap2")[0x48] == 0         # BASEFEE undefined
+    assert native_optable("ap3")[0x48] == 1
+    for fork in ("ap2", "ap3", "durango", "cancun"):
+        t = native_optable(fork)
+        assert t[0xF0] == 2     # CREATE defined, host-only
+        assert t[0x31] == 2     # BALANCE defined, host-only
+        assert t[0xF1] == 1     # CALL native
+        for op in sorted(native_opcodes(fork)):
+            assert t[op] in (0, 1)  # native set never marked host-only
+
+
+def test_balance_opcode_statically_ineligible():
+    ok, reason = native_eligible(bytes([0x30, 0x31, 0x00]), "durango")
+    assert not ok and "0x31" in reason
+
+
+def test_fork_undefined_opcode_errs_natively():
+    """An opcode the ENGINE compiles but the session's FORK does not
+    define (PUSH0 pre-durango) must INVALID-err exactly like the
+    interpreter — not execute.  Regression: the dispatch gate must
+    consult the per-fork optable before the switch."""
+    from coreth_tpu.evm.device import machine as M
+    from coreth_tpu.evm.hostexec.backend import HostExecBackend
+    # PUSH0 PUSH1 1 SSTORE: stores VALUE 0 at KEY 1 (key is the top
+    # pop) — a cold no-op write under durango
+    code = bytes([0x5F, 0x60, 0x01, 0x55, 0x00])
+    addr = b"\x41" * 20
+    for fork, want_status, want_gas in (
+            ("ap2", M.ERR, 0),
+            # durango defines PUSH0: 2+3 pushes, 2100 cold + 100 noop
+            ("durango", M.STOP, 90_000 - (2 + 3 + 2100 + 100))):
+        be = HostExecBackend(fork, 43112,
+                             lambda _a, _k: b"\x00" * 32,
+                             lambda _a: None)
+        be.set_env(b"\xba" * 20, 1, 1, 8_000_000, 0)
+        be.set_code(addr, code)
+        res = be.call(b"\x0a" * 20, addr, 0, 0, b"", 90_000,
+                      warm_addrs=[addr])
+        assert res.status == want_status, (fork, res.status)
+        assert res.gas_left == want_gas, (fork, res.gas_left)
+        be.close()
+
+
+def test_bridge_resolves_callee_fresh_per_tx():
+    """A mid-block deploy between two native txs must be visible to
+    the second one: the session's callee code/kind cache is reset per
+    tx (regression — a cached EOA verdict for an address that gained
+    code would execute a trivially-successful subcall instead of the
+    code)."""
+    from coreth_tpu.evm import EVM, BlockContext, TxContext
+    from coreth_tpu.mpt import EMPTY_ROOT
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database, StateDB
+    sender, a, b = b"\x0a" * 20, b"\x41" * 20, b"\x42" * 20
+    # A: CALL B (forward 0xffff), store the subcall's RETURNDATASIZE
+    code_a = (bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+                     0x60, 0x00, 0x73]) + b
+              + bytes([0x61, 0xFF, 0xFF, 0xF1, 0x50,
+                       0x3D, 0x60, 0x01, 0x55, 0x00]))
+    code_b = bytes([0x60, 0x2A, 0x60, 0x00, 0x52,
+                    0x60, 0x20, 0x60, 0x00, 0xF3])  # returns 32 bytes
+    db = StateDB(EMPTY_ROOT, Database())
+    db.set_code(a, code_a)
+    db.add_balance(sender, 10**20)
+    db.finalise(True)
+    db.intermediate_root(True)
+    rules = CFG.rules(1, 1)
+    ctx = BlockContext(coinbase=b"\xba" * 20, gas_limit=8_000_000,
+                       number=1, time=1, base_fee=25 * 10**9)
+    evm = EVM(ctx, TxContext(origin=sender, gas_price=25 * 10**9), db,
+              CFG)
+    key1 = (1).to_bytes(32, "big")
+
+    def one_tx():
+        db.prepare(rules, sender, ctx.coinbase, a,
+                   list(rules.active_precompiles), [])
+        _, _, err = evm.call(sender, a, b"", 200_000, 0)
+        assert err is None
+        db.finalise(True)
+
+    one_tx()                      # B is an EOA: returndatasize == 0
+    assert db.get_state(a, key1) == b"\x00" * 32
+    db.set_code(b, code_b)        # "mid-block deploy"
+    one_tx()                      # B now returns 32 bytes
+    assert int.from_bytes(db.get_state(a, key1), "big") == 32
+
+
+# ------------------------------------------- corpus through the bridge
+
+def test_statetests_corpus_native_bit_identical(monkeypatch):
+    """The full self-pinned corpus under the native backend, with the
+    differential oracle armed: every eligible tx executes in C++ and
+    must produce the exact fixture root + logs hash; ineligible ones
+    fall back per tx."""
+    monkeypatch.setenv("CORETH_HOST_EXEC", "native")
+    monkeypatch.setenv("CORETH_HOST_EXEC_CHECK", "1")
+    from coreth_tpu.tests_harness import run_corpus
+    hostexec.reset_counters()
+    corpus = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "statetests")
+    results = run_corpus(corpus)
+    assert results
+    bad = [r for r in results if not r.ok]
+    assert not bad, "\n".join(f"{r.name}: {r.detail}" for r in bad)
+    served = hostexec.counters()
+    assert served.get("native_calls", 0) > 0, served
+
+
+def test_host_exec_py_restores_interpreter(monkeypatch):
+    monkeypatch.setenv("CORETH_HOST_EXEC", "py")
+    from coreth_tpu.tests_harness import run_corpus
+    hostexec.reset_counters()
+    corpus = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "statetests")
+    results = run_corpus(corpus)
+    assert all(r.ok for r in results)
+    assert hostexec.counters().get("native_calls", 0) == 0
+
+
+# ------------------------------------------- serial-block short-circuit
+
+def _swap_chain(n_blocks, txs_per_block):
+    from coreth_tpu.chain import Genesis, GenesisAccount
+    from coreth_tpu.chain.chain_makers import generate_chain
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    from coreth_tpu.workloads.swap import (
+        pool_genesis_account, swap_calldata,
+    )
+    keys = [0x6100 + i for i in range(txs_per_block)]
+    addrs = [priv_to_address(k) for k in keys]
+    pool = b"\x70" * 20
+    alloc = {a: GenesisAccount(balance=10**24) for a in addrs}
+    alloc[pool] = pool_genesis_account(10**15, 10**15)
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(keys)
+
+    def gen(i, bg):
+        for k in range(txs_per_block):
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=10**9, gas_fee_cap_=300 * 10**9,
+                gas=200_000, to=pool, value=0,
+                data=swap_calldata(1000 + 13 * i + k)), keys[k],
+                CFG.chain_id))
+            nonces[k] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, db, n_blocks, gen, gap=2)
+    return genesis, gblock, blocks
+
+
+def _engine_for(genesis, gblock):
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.state import Database
+    db = Database()
+    g = genesis.to_block(db)
+    assert g.root == gblock.root
+    return ReplayEngine(genesis.config, db, g.root,
+                        parent_header=g.header, window=4)
+
+
+def test_serial_short_circuit_swap_blocks(monkeypatch):
+    """A run of swap blocks (single shared contract, PUSH-constant
+    write set) must dispatch straight to the native executor: ZERO OCC
+    rounds, zero device dispatches for those blocks, bit-identical
+    roots — the acceptance shape of the subsystem."""
+    monkeypatch.setenv("CORETH_HOST_EXEC", "native")
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "1")
+    from coreth_tpu.evm.device import adapter
+    genesis, gblock, blocks = _swap_chain(3, 5)
+    eng = _engine_for(genesis, gblock)
+    d0 = adapter.DISPATCH_COUNT
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 0
+    mx = eng._machine
+    assert mx.serial_blocks == 3
+    assert mx.rounds == 0                  # no OCC rounds at all
+    assert mx.native_txs == 3 * 5
+    assert mx.host_txs == 0
+    assert adapter.DISPATCH_COUNT == d0    # device never dispatched
+
+
+def test_serial_short_circuit_disabled_by_py_mode(monkeypatch):
+    """CORETH_HOST_EXEC=py restores the old path end to end: the swap
+    blocks ride device OCC again (rounds accrue), same roots."""
+    monkeypatch.setenv("CORETH_HOST_EXEC", "py")
+    genesis, gblock, blocks = _swap_chain(2, 5)
+    eng = _engine_for(genesis, gblock)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    mx = eng._machine
+    assert mx.serial_blocks == 0
+    assert mx.native_txs == 0
+    assert mx.blocks == 2                  # machine path took them
+
+
+def test_serial_and_token_blocks_interleave(monkeypatch):
+    """Serial pool blocks short-circuit natively while keccak-keyed
+    token blocks (computed write sets -> real independence) stay OFF
+    the serial path — the detector must not over-trigger."""
+    monkeypatch.setenv("CORETH_HOST_EXEC", "native")
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    from coreth_tpu.chain import Genesis, GenesisAccount
+    from coreth_tpu.chain.chain_makers import generate_chain
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    from coreth_tpu.workloads.erc20 import (
+        token_genesis_account, transfer_calldata,
+    )
+    from coreth_tpu.workloads.swap import (
+        pool_genesis_account, swap_calldata,
+    )
+    keys = [0x6200 + i for i in range(4)]
+    addrs = [priv_to_address(k) for k in keys]
+    pool, token = b"\x70" * 20, b"\x71" * 20
+    alloc = {a: GenesisAccount(balance=10**24) for a in addrs}
+    alloc[pool] = pool_genesis_account(10**15, 10**15)
+    alloc[token] = token_genesis_account({a: 10**21 for a in addrs})
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(keys)
+
+    def gen(i, bg):
+        for k in range(4):
+            if i % 2 == 0:
+                data, to = swap_calldata(500 + 11 * i + k), pool
+            else:
+                data, to = transfer_calldata(
+                    addrs[(k + 1) % 4], 10 + k), token
+            bg.add_tx(sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=10**9, gas_fee_cap_=300 * 10**9,
+                gas=200_000, to=to, value=0, data=data), keys[k],
+                CFG.chain_id))
+            nonces[k] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, db, 4, gen, gap=2)
+    eng = _engine_for(genesis, gblock)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 0
+    mx = eng._machine
+    assert mx.serial_blocks == 2           # the two swap blocks only
+    assert mx.blocks == 4
+
+
+# ----------------------------------------- fallback path served natively
+
+def test_engine_fallback_served_by_native_executor(monkeypatch):
+    """A block the machine classifier rejects (value-carrying contract
+    call) takes ReplayEngine._fallback — and the Processor's depth-0
+    EVM calls inside it are served by the native executor, with the
+    differential oracle armed."""
+    monkeypatch.setenv("CORETH_HOST_EXEC", "native")
+    monkeypatch.setenv("CORETH_HOST_EXEC_CHECK", "1")
+    from coreth_tpu.chain import Genesis, GenesisAccount
+    from coreth_tpu.chain.chain_makers import generate_chain
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+    from coreth_tpu.workloads.swap import (
+        pool_genesis_account, swap_calldata,
+    )
+    key = 0x6300
+    addr = priv_to_address(key)
+    pool = b"\x70" * 20
+    alloc = {addr: GenesisAccount(balance=10**24),
+             pool: pool_genesis_account(10**15, 10**15)}
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonce = [0]
+
+    def gen(i, bg):
+        # an access list makes the block unclassifiable for BOTH fast
+        # paths (classify rejects tx.access_list) -> host fallback;
+        # the bridge seeds the pre-warmed slots into the native session
+        bg.add_tx(sign_tx(DynamicFeeTx(
+            chain_id_=CFG.chain_id, nonce=nonce[0],
+            gas_tip_cap_=10**9, gas_fee_cap_=300 * 10**9,
+            gas=200_000, to=pool, value=0,
+            data=swap_calldata(123 + i),
+            al=[(pool, [(0).to_bytes(32, "big")])]), key,
+            CFG.chain_id))
+        nonce[0] += 1
+
+    blocks, _ = generate_chain(CFG, gblock, db, 2, gen, gap=2)
+    eng = _engine_for(genesis, gblock)
+    hostexec.reset_counters()
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 2
+    assert hostexec.counters().get("native_calls", 0) == 2

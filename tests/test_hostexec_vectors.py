@@ -1,0 +1,257 @@
+"""Hand-derived vectors for the edges the native EVM now owns:
+CALL gas forwarding with memory expansion (EIP-150/2929), the
+EIP-2200/3529 SSTORE refund ladder, and RETURNDATACOPY bounds.
+
+Every vector runs through the REAL production seam (EVM.call with the
+hostexec bridge active) with the differential oracle armed
+(CORETH_HOST_EXEC_CHECK=1: any native-vs-interpreter divergence in
+status/gas/writes/logs/refund raises inside the bridge) — AND asserts
+hand-computed gas/refund values, so a bug shared by both engines
+cannot hide behind their agreement.
+
+Gas arithmetic references: gas.py make_gas_call_eip2929 (cold 2500
+deducted before the 63/64 split), memory_gas_cost (3/word +
+words^2/512), make_gas_sstore_eip2929 (the 3529 ladder with
+clears-refund 4800)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.evm import hostexec
+
+pytestmark = pytest.mark.skipif(
+    not hostexec.available(),
+    reason="hostexec native ABI unavailable")
+
+SENDER = b"\x0A" * 20
+A = b"\x41" * 20
+B = b"\x42" * 20
+GAS = 200_000
+
+
+@pytest.fixture(autouse=True)
+def _native_checked(monkeypatch):
+    monkeypatch.setenv("CORETH_HOST_EXEC", "native")
+    monkeypatch.setenv("CORETH_HOST_EXEC_CHECK", "1")
+
+
+def run_vector(code_a, code_b=None, data=b"", gas=GAS, storage=None,
+               expect="native_calls"):
+    """Execute calldata against contract A (B optionally deployed)
+    through EVM.call; returns (gas_left, err, statedb).
+
+    expect: which bridge counter this vector must land on —
+    "native_calls" (native served it) or "err_fallbacks" (native
+    proved the ERR outcome, then the interpreter re-derived the exact
+    error class; with CHECK=1 armed the gas/status parity was asserted
+    before the fallback)."""
+    from coreth_tpu.evm import EVM, BlockContext, TxContext
+    from coreth_tpu.mpt import EMPTY_ROOT
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database, StateDB
+    db = StateDB(EMPTY_ROOT, Database())
+    db.set_code(A, code_a)
+    if code_b:
+        db.set_code(B, code_b)
+    for key, val in (storage or {}).items():
+        db.set_state(A, key, val)
+    db.add_balance(SENDER, 10**20)
+    db.finalise(True)
+    db.intermediate_root(True)
+    rules = CFG.rules(1, 1)
+    ctx = BlockContext(coinbase=b"\xba" * 20, gas_limit=8_000_000,
+                       number=1, time=1, base_fee=25 * 10**9)
+    db.prepare(rules, SENDER, ctx.coinbase, A,
+               list(rules.active_precompiles), [])
+    evm = EVM(ctx, TxContext(origin=SENDER, gas_price=25 * 10**9), db,
+              CFG)
+    hostexec.reset_counters()
+    ret, gas_left, err = evm.call(SENDER, A, data, gas, 0)
+    assert hostexec.counters().get(expect, 0) == 1, \
+        f"vector expected {expect}, got {hostexec.counters()}"
+    return ret, gas_left, err, db
+
+
+PUSH20_B = bytes([0x73]) + B
+# B: mstore(0, 0x2a); return mem[0:32]
+CODE_B_RET32 = bytes([0x60, 0x2A, 0x60, 0x00, 0x52,
+                      0x60, 0x20, 0x60, 0x00, 0xF3])
+# gas B consumes: 4 PUSH1 (12) + MSTORE (3 + 1 word mem = 3) + RETURN
+B_RET32_USED = 12 + 3 + 3
+# args for CALL(gas=0xFFFF, B, value 0, in 0:0, out 0x40:0x20),
+# pushed deepest-first: out_size out_off in_size in_off value addr gas
+CALLB_FFFF = (bytes([0x60, 0x20, 0x60, 0x40, 0x60, 0x00, 0x60, 0x00,
+                     0x60, 0x00]) + PUSH20_B
+              + bytes([0x61, 0xFF, 0xFF, 0xF1]))
+
+
+def test_call_gas_forwarding_with_memory_expansion():
+    """CALL whose out-region expands A's memory to 3 words: charge is
+    7 pushes + 100 (warm const) + 2500 (cold B) + 9 (3 fresh words)
+    + child usage; requested 0xFFFF < cap so exactly 0xFFFF forwards
+    and the unused child gas returns."""
+    code_a = CALLB_FFFF + bytes([0x00])
+    _, gas_left, err, _ = run_vector(code_a, CODE_B_RET32)
+    assert err is None
+    used = 7 * 3 + 100 + 2500 + 9 + B_RET32_USED
+    assert gas_left == GAS - used
+
+
+def test_call_63_64_cap():
+    """Requested child gas above the cap forwards floor(63/64 · avail)
+    instead; the child's unused gas still returns, so total usage is
+    identical to the exact-request case minus the memory term (no out
+    region here)."""
+    code_a = (bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+                     0x60, 0x00]) + PUSH20_B
+              + bytes([0x62, 0xFF, 0xFF, 0xFF, 0xF1, 0x00]))
+    _, gas_left, err, _ = run_vector(code_a, CODE_B_RET32)
+    assert err is None
+    used = 7 * 3 + 100 + 2500 + B_RET32_USED
+    assert gas_left == GAS - used
+
+
+def test_second_call_same_target_is_warm():
+    """The first CALL pays the 2929 cold-account surcharge; the second
+    to the same address must not."""
+    code_a = CALLB_FFFF + bytes([0x50]) + CALLB_FFFF + bytes([0x50, 0x00])
+    _, gas_left, err, _ = run_vector(code_a, CODE_B_RET32)
+    assert err is None
+    first = 7 * 3 + 100 + 2500 + 9 + B_RET32_USED
+    second = 7 * 3 + 100 + 0 + 0 + B_RET32_USED  # warm, mem amortized
+    pops = 2 * 2
+    assert gas_left == GAS - first - second - pops
+
+
+def test_call_to_cold_eoa():
+    """A value-0 CALL to a nonexistent account: cold surcharge + full
+    child-gas return, no new-account charge (value == 0)."""
+    code_a = (bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+                     0x60, 0x00, 0x73]) + b"\x99" * 20
+              + bytes([0x61, 0xFF, 0xFF, 0xF1, 0x00]))
+    _, gas_left, err, _ = run_vector(code_a)
+    assert err is None
+    assert gas_left == GAS - (7 * 3 + 100 + 2500)
+
+
+def test_nested_revert_isolation():
+    """B SSTOREs then REVERTs: its write must vanish, A's success flag
+    (0) and RETURNDATASIZE (32) must land in A's storage, and B's
+    consumed gas stays consumed."""
+    code_b = bytes([0x60, 0x01, 0x60, 0x05, 0x55,        # SSTORE(5,1)
+                    0x60, 0x2A, 0x60, 0x00, 0x52,
+                    0x60, 0x20, 0x60, 0x00, 0xFD])       # REVERT 32B
+    code_a = (bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+                     0x60, 0x00]) + PUSH20_B
+              + bytes([0x61, 0xFF, 0xFF, 0xF1])
+              + bytes([0x60, 0x02, 0x55])                # SSTORE(2, ok)
+              + bytes([0x3D, 0x60, 0x03, 0x55, 0x00]))   # SSTORE(3, rds)
+    _, gas_left, err, db = run_vector(code_a, code_b)
+    assert err is None
+    assert db.get_state(B, (5).to_bytes(32, "big")) == b"\x00" * 32
+    assert db.get_state(A, (2).to_bytes(32, "big")) == b"\x00" * 32
+    assert int.from_bytes(db.get_state(A, (3).to_bytes(32, "big")),
+                          "big") == 32
+
+
+def test_returndatacopy_exact_bounds():
+    """Copying exactly the full 32-byte return data succeeds and the
+    copied word round-trips through MLOAD into storage."""
+    code_a = (CALLB_FFFF + bytes([0x50])
+              + bytes([0x60, 0x20, 0x60, 0x00, 0x60, 0x60, 0x3E])
+              + bytes([0x60, 0x60, 0x51, 0x60, 0x01, 0x55, 0x00]))
+    _, _, err, db = run_vector(code_a, CODE_B_RET32)
+    assert err is None
+    assert int.from_bytes(db.get_state(A, (1).to_bytes(32, "big")),
+                          "big") == 0x2A
+
+
+def test_returndatacopy_out_of_bounds_consumes_all_gas():
+    """src+len one past the return data is a hard VM error (EIP-211):
+    whole frame's gas gone, status-0 outcome.  Native proves the ERR
+    (CHECK asserts gas parity) and the interpreter supplies the exact
+    error class on the fallback."""
+    from coreth_tpu.evm import vmerrs
+    code_a = (CALLB_FFFF + bytes([0x50])
+              + bytes([0x60, 0x21, 0x60, 0x00, 0x60, 0x60, 0x3E,
+                       0x00]))
+    _, gas_left, err, _ = run_vector(code_a, CODE_B_RET32,
+                                     expect="err_fallbacks")
+    assert gas_left == 0
+    assert isinstance(err, vmerrs.ErrReturnDataOutOfBounds)
+
+
+def test_returndatasize_zero_before_any_call():
+    code_a = bytes([0x3D, 0x60, 0x01, 0x55, 0x00])  # SSTORE(1, rds)
+    _, _, err, db = run_vector(code_a)
+    assert err is None
+    assert db.get_state(A, (1).to_bytes(32, "big")) == b"\x00" * 32
+
+
+def test_staticcall_write_protection():
+    """STATICCALL into an SSTOREing callee: the CHILD frame dies (its
+    forwarded gas is consumed) but the parent continues with 0
+    pushed."""
+    code_b = bytes([0x60, 0x01, 0x60, 0x05, 0x55, 0x00])
+    code_a = (bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00])
+              + PUSH20_B + bytes([0x61, 0xFF, 0xFF, 0xFA])
+              + bytes([0x60, 0x02, 0x55, 0x00]))         # SSTORE(2, ok)
+    _, gas_left, err, db = run_vector(code_a, code_b)
+    assert err is None
+    assert db.get_state(A, (2).to_bytes(32, "big")) == b"\x00" * 32
+    assert db.get_state(B, (5).to_bytes(32, "big")) == b"\x00" * 32
+    # parent's own cost + the entire forwarded 0xFFFF burned + the
+    # trailing SSTORE(2, 0): cold slot (2100) + noop write (100)
+    used = 6 * 3 + 100 + 2500 + 0xFFFF + 3 + 2100 + 100
+    assert gas_left == GAS - used
+
+
+def test_sstore_refund_ladder_set_then_clear():
+    """Fresh slot set then cleared in ONE tx: 2100+20000 then dirty
+    write-back-to-original — refund must be exactly 19900 (EIP-3529
+    SET - WARM_READ), tracked identically by both engines."""
+    code_a = bytes([0x60, 0x01, 0x60, 0x05, 0x55,        # SSTORE(5,1)
+                    0x60, 0x00, 0x60, 0x05, 0x55, 0x00])  # SSTORE(5,0)
+    _, gas_left, err, db = run_vector(code_a)
+    assert err is None
+    assert db.refund == 19900
+    assert gas_left == GAS - (4 * 3 + 2100 + 20000 + 100)
+
+
+def test_sstore_refund_ladder_clear_existing():
+    """Clearing a pre-existing nonzero slot: cost 2100 + 2900, refund
+    += 4800 (the clears schedule)."""
+    pre = {(5).to_bytes(32, "big"): (7).to_bytes(32, "big")}
+    code_a = bytes([0x60, 0x00, 0x60, 0x05, 0x55, 0x00])
+    _, gas_left, err, db = run_vector(code_a, storage=pre)
+    assert err is None
+    assert db.refund == 4800
+    assert gas_left == GAS - (2 * 3 + 2100 + 2900)
+
+
+def test_sstore_refund_ladder_reset_then_restore():
+    """v -> 0 -> v across two SSTOREs: +4800 on the clear, then the
+    dirty restore takes it back (-4800) and grants RESET - COLD - WARM
+    (+2800): net 2800."""
+    pre = {(5).to_bytes(32, "big"): (7).to_bytes(32, "big")}
+    code_a = bytes([0x60, 0x00, 0x60, 0x05, 0x55,
+                    0x60, 0x07, 0x60, 0x05, 0x55, 0x00])
+    _, gas_left, err, db = run_vector(code_a, storage=pre)
+    assert err is None
+    assert db.refund == 2800
+    assert gas_left == GAS - (4 * 3 + 2100 + 2900 + 100)
+
+
+def test_sstore_sentry():
+    """SSTORE with gas <= 2300 remaining OOGs (EIP-2200 reentrancy
+    sentry) even though the charge itself would fit."""
+    from coreth_tpu.evm import vmerrs
+    code_a = bytes([0x60, 0x01, 0x60, 0x05, 0x55, 0x00])
+    _, gas_left, err, _ = run_vector(code_a, gas=2300 + 2 * 3,
+                                     expect="err_fallbacks")
+    assert gas_left == 0
+    assert isinstance(err, vmerrs.ErrOutOfGas)

@@ -180,6 +180,32 @@ def test_minitoml_parser():
     ("def f(d, enc):\n    return enc.encode(d.keys())\n", ["DET006"]),
     ("def f(xs):\n    return keccak256(set(xs))\n", ["DET006"]),
     ("def f(xs):\n    return sha256(set(xs))\n", ["DET006"]),
+    # DET007: true division of provably-int operands
+    ("X = 3 / 2\n", ["DET007"]),
+    ("def f(xs):\n    return len(xs) / 4\n", ["DET007"]),
+    ("def f(xs):\n    n = len(xs)\n    return n / 2\n", ["DET007"]),
+    ("def f(x):\n    return int(x) / (1 + len(x))\n", ["DET007"]),
+    # augmented /= evicts the name from the int trace (it rebinds to a
+    # float) BEFORE judgment — conservatively exempt, not flagged
+    ("def f(x):\n    y = 5\n    y /= 2\n    return y\n", []),
+    ("def f(x):\n    y = 5\n    y /= x.field\n    return y\n", []),
+    # DET007 negatives: type-unknown operands stay exempt (the
+    # Fq/bn256 field classes overload / legitimately)
+    ("def f(a, b):\n    return a / b\n", []),          # params unknown
+    ("def g1(x1, y1):\n    m = (x1 * x1 * 3) / (y1 * 2)\n", []),
+    ("def f(tx):\n    return tx.burned() / max(tx.gas, 1)\n", []),
+    # a nested function's int binding must NOT leak into the enclosing
+    # scope's same-named (unknown) parameter
+    ("def outer(n):\n    def helper(q):\n        n = len(q)\n"
+     "        return n\n    return n / 2\n", []),
+    # sum/abs/pow over unknown elements are not provably int (a sum of
+    # Fq field values is exactly the carve-out)
+    ("def mean(xs):\n    return sum(xs) / 4\n", []),
+    ("def f(x):\n    return abs(x) / 2\n", []),
+    ("def f(xs):\n    n = len(xs)\n    n = xs.w\n    return n / 2\n",
+     []),                                             # rebound: evicted
+    ("X = 3 // 2\n", []),
+    ("def f(xs):\n    return Fraction(len(xs), 4)\n", []),
     # negatives
     ("def f(x):\n    return shard_map(set(x))\n", []),  # sha* != hashing
     ("def f(x):\n    return shape({1, 2})\n", []),

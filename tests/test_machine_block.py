@@ -85,10 +85,14 @@ def run_machine_chain(n_blocks, gen_txs, expect_fallbacks=0):
     return eng
 
 
-def test_swap_contention_block():
+def test_swap_contention_block(monkeypatch):
     """A block of swaps is a fully serial conflict chain: the OCC
     scheduler must converge by re-executing only conflicting txs and
-    land on the exact host root."""
+    land on the exact host root.  (Short-circuit pinned OFF: this test
+    exercises the OCC retry machinery itself; the serial-dispatch
+    default is covered by tests/test_hostexec.py.)"""
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+
     def gen(i, nonces):
         return [tx(k, nonces, POOL, swap_calldata(1000 + 7 * i + k))
                 for k in range(6)]
@@ -99,10 +103,13 @@ def test_swap_contention_block():
     assert mx.rounds > 0  # conflicts actually exercised the retry path
 
 
-def test_deep_conflict_chain_stays_on_device():
+def test_deep_conflict_chain_stays_on_device(monkeypatch):
     """With the device-resident OCC loop, a conflict chain as deep as
     the whole block converges INSIDE one dispatch — no host
-    conflict-suffix, no whole-block fallback."""
+    conflict-suffix, no whole-block fallback.  (Serial short-circuit
+    pinned OFF: the device-resident round loop is the subject here.)"""
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+
     def gen(i, nonces):
         return [tx(k, nonces, POOL, swap_calldata(100 + 31 * i + k))
                 for k in range(8)]
@@ -122,6 +129,7 @@ def test_deep_conflict_chain_host_suffix_legacy(monkeypatch):
     device prefix is kept and the block never reaches the engine's
     whole-block fallback."""
     monkeypatch.setenv("CORETH_DEVICE_OCC", "0")
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
 
     def gen(i, nonces):
         return [tx(k, nonces, POOL, swap_calldata(100 + 31 * i + k))

@@ -157,6 +157,7 @@ def test_occ_equiv_swap_full_conflict(monkeypatch):
     fully serial chain.  The fused path converges entirely on device
     (no host conflict-suffix) across multiple pipelined windows."""
     monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
 
     def gen_factory():
         def gen(i, nonces):
@@ -214,7 +215,10 @@ def test_occ_dispatch_count_reduction(monkeypatch):
     """THE tentpole metric: on a fully conflicting swap block the
     legacy host loop pays one dispatch per OCC round (O(txs)); the
     device-resident loop pays O(1) dispatches per window.  Assert the
-    >= 10x reduction via the adapter's dispatch counter."""
+    >= 10x reduction via the adapter's dispatch counter.  (Serial
+    short-circuit pinned OFF — it would give BOTH paths zero device
+    dispatches and void the comparison.)"""
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
     n_txs = 24
 
     def gen(i, nonces):

@@ -16,6 +16,16 @@ are scanned for:
           unordered; wrap in ``sorted(...)``
 - DET006  unordered collection (``set``, ``.keys()``) passed straight
           to a hashing/encoding call
+- DET007  true division (``/``) where NEITHER operand can be a
+          field-class value — the result is a float, and
+          float-ordered consensus data (e.g. fee ordering) diverges
+          across hosts.  Type-unknown operands stay exempt: the
+          Fq/bn256 field classes overload ``/`` legitimately (modular
+          inverse), and the pass only flags divisions whose operands
+          it can PROVE are plain ints (literals, ``int()``/``len()``
+          results, arithmetic over those, and names bound only to
+          such values in the same scope).  Use ``//``, a scaled
+          integer, or ``fractions.Fraction`` instead.
 """
 
 from __future__ import annotations
@@ -56,12 +66,130 @@ def _is_unordered(node: ast.AST) -> bool:
     return False
 
 
+# builtins whose result is a plain int REGARDLESS of argument types —
+# the burden-of-proof bar: sum()/abs()/pow() over floats or field
+# elements are not ints, so they stay type-unknown (exempt)
+_INT_FUNCS = {"int", "len", "ord"}
+# operators that keep int-ness when both sides are ints
+_INT_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod,
+               ast.Pow, ast.LShift, ast.RShift, ast.BitOr, ast.BitXor,
+               ast.BitAnd)
+
+
+def _walk_scope(scope: ast.AST):
+    """ast.walk that does NOT descend into nested function/class
+    scopes — their bindings are their own (a name assigned in a
+    closure must not mark the enclosing scope's same-named binding)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_int_names(func_node: ast.AST) -> set:
+    """Names bound ONLY to provably-int expressions within one
+    function scope (single-assignment trace; any non-int or unknown
+    rebinding evicts the name)."""
+    candidates: dict = {}
+    for node in _walk_scope(func_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            ok = _is_int_expr(node.value, frozenset())
+            if name in candidates:
+                candidates[name] = candidates[name] and ok
+            else:
+                candidates[name] = ok
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                and isinstance(getattr(node, "target", None), ast.Name):
+            candidates[node.target.id] = False
+        elif isinstance(node, (ast.For, ast.AsyncFor)) \
+                and isinstance(node.target, ast.Name):
+            candidates[node.target.id] = False
+    return {n for n, ok in candidates.items() if ok}
+
+
+def _is_int_expr(node: ast.AST, int_names: frozenset) -> bool:
+    """True when `node` provably evaluates to a plain int — the
+    DET007 burden of proof.  Anything unknown returns False (exempt),
+    which is the Fq carve-out: field values always flow through
+    attributes, calls, or parameters this cannot prove."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, int) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, ast.Name):
+        return node.id in int_names
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _INT_FUNCS
+    if isinstance(node, ast.BinOp) and isinstance(node.op, _INT_BINOPS):
+        return (_is_int_expr(node.left, int_names)
+                and _is_int_expr(node.right, int_names))
+    if isinstance(node, ast.UnaryOp) \
+            and isinstance(node.op, (ast.USub, ast.UAdd, ast.Invert)):
+        return _is_int_expr(node.operand, int_names)
+    return False
+
+
+class _DivisionVisitor(ast.NodeVisitor):
+    """DET007: each ``/`` is judged in its NEAREST enclosing function
+    scope (name-to-int tracing is per scope)."""
+
+    def __init__(self, src: Source, findings: List[Finding]):
+        self.src = src
+        self.findings = findings
+        self.stack: List[ast.AST] = [src.tree]
+        self.names: dict = {}
+
+    def _int_names(self, scope: ast.AST) -> frozenset:
+        cached = self.names.get(scope)
+        if cached is None:
+            cached = frozenset(_collect_int_names(scope))
+            self.names[scope] = cached
+        return cached
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check(self, node, left, right):
+        int_names = self._int_names(self.stack[-1])
+        if _is_int_expr(left, int_names) \
+                and _is_int_expr(right, int_names):
+            self.findings.append(Finding(
+                self.src.path, node.lineno, "DET007",
+                "float-producing true division of integer operands "
+                "in consensus package — use //, a scaled integer, or "
+                "fractions.Fraction", "int-division"))
+
+    def visit_BinOp(self, node):
+        if isinstance(node.op, ast.Div):
+            self._check(node, node.left, node.right)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.op, ast.Div):
+            self._check(node, node.target, node.value)
+        self.generic_visit(node)
+
+
+def _check_division(src: Source, findings: List[Finding]) -> None:
+    _DivisionVisitor(src, findings).visit(src.tree)
+
+
 def check_determinism(sources: List[Source], config) -> List[Finding]:
     packages = set(config.determinism_packages)
     findings = []
     for src in sources:
         if src.package not in packages:
             continue
+        _check_division(src, findings)
         # module names (incl. aliases) bound to entropy modules
         entropy_aliases, os_aliases = set(), set()
         for node in ast.walk(src.tree):
