@@ -170,6 +170,7 @@ _FIELDS = ("pc", "gas", "status", "sp", "refund", "steps", "stack",
            "log_dlen", "log_cnt", "host_reason")
 
 
+# corethlint: jit-factory — exec_lanes runs inside the jitted kernels
 def _build_exec(params: MachineParams):
     """Core lane executor shared by the single-shot machine
     (build_machine) and the device-resident OCC kernel

@@ -55,6 +55,8 @@ def load_hostexec():
         ctypes.c_uint32]
     lib.coreth_hostexec_clear_storage.argtypes = [ctypes.c_void_p]
     lib.coreth_hostexec_reset.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "coreth_hostexec_reset_kinds"):
+        lib.coreth_hostexec_reset_kinds.argtypes = [ctypes.c_void_p]
     lib.coreth_hostexec_seed_slot.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p]
@@ -194,6 +196,18 @@ class HostExecBackend:
         what an address resolves to between txs."""
         self._lib.coreth_hostexec_reset(self._h)
         self._registered.clear()
+
+    def reset_eoa_kinds(self) -> None:
+        """Drop ONLY cached EOA verdicts (per-tx hygiene on the
+        cross-tx reuse path): existence/emptiness transitions happen
+        through pure balance moves the bridge's storage_gen check
+        cannot see, so EOA callees re-resolve every tx while contract
+        code/storage caches survive.  Falls back to the full reset on
+        a prebuilt .so without the symbol."""
+        if hasattr(self._lib, "coreth_hostexec_reset_kinds"):
+            self._lib.coreth_hostexec_reset_kinds(self._h)
+        else:
+            self.reset_contracts()
 
     def seed_slot(self, contract: bytes, key: bytes,
                   value: bytes) -> None:

@@ -114,10 +114,25 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
     if be is None:
         return None
     ctx = evm.block_ctx
-    # full per-tx reset (codes + kinds + storage): the StateDB moved
-    # since the last tx, and an interpreter-path CREATE in between may
-    # have changed what a cached callee address resolves to
-    be.reset_contracts()
+    # Cross-tx cache reuse: resolved (contract, slot) values and
+    # code/kind verdicts survive from the previous native tx of the
+    # SAME StateDB as long as nothing outside this bridge mutated it
+    # (statedb.storage_gen counts storage writes, deploys, reverts,
+    # suicides).  Any foreign mutation — an interpreter-path tx, a
+    # mid-block CREATE — forces the full reset the old per-tx hygiene
+    # always paid.
+    seen = getattr(evm, "_hostexec_seen", None)
+    if (seen is not None and seen[0] is statedb
+            and seen[1] == statedb.storage_gen):
+        # EOA verdicts still re-resolve per tx: account existence/
+        # emptiness can move through pure balance transfers, which
+        # storage_gen does not count — a stale kind would skip the
+        # code_resolver's EIP-158 exist-and-empty host guard
+        be.reset_eoa_kinds()
+        _bump("storage_cache_reuse")
+    else:
+        be.reset_contracts()
+    evm._hostexec_seen = None  # re-armed only on a clean hand-back
     be.set_env(ctx.coinbase, ctx.time, ctx.number, ctx.gas_limit,
                ctx.base_fee or 0, ctx.difficulty)
     be.set_code(addr, code)
@@ -151,10 +166,19 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
             statedb.add_refund(res.refund)
         elif res.refund < 0:
             statedb.sub_refund(-res.refund)
+        # fold this call's writes into the session's committed cache
+        # and record the StateDB generation they correspond to — the
+        # next tx of this block reuses the cache iff it still matches
+        be.commit()
+        evm._hostexec_seen = (statedb, statedb.storage_gen)
         return res.ret, res.gas_left, None
     # REVERT: the payload + surviving gas carry all the information
-    # the caller needs; no interpreter re-run required
+    # the caller needs; no interpreter re-run required.  The session's
+    # committed cache never saw the discarded overlay, and the journal
+    # revert restores exactly the entry state, so the cache stays
+    # valid for the next tx.
     statedb.revert_to_snapshot(snapshot)
+    evm._hostexec_seen = (statedb, statedb.storage_gen)
     err = vmerrs.ErrExecutionReverted()
     err.data = res.ret
     return res.ret, res.gas_left, err

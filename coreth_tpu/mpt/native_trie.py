@@ -1,21 +1,35 @@
-"""C++-backed secure trie for the replay engine's hot fold.
+"""C++-backed secure trie — the replay engine's commit-path backend.
 
 The role of the reference's compiled trie machinery (trie/ + hasher.go
-run as native Go): per-block account/storage folds walk and rehash the
-MPT in C++ (native/baseline.cc trie handle API) instead of Python —
-measured ~4.5x faster at bench scale, which is the difference between
-losing and beating the compiled sequential baseline on the trie phase.
+run as native Go): account/storage folds walk and rehash the MPT in
+C++ (native/baseline.cc trie handle API) instead of Python — measured
+~4.5x faster at bench scale, which is the difference between losing
+and beating the compiled sequential baseline on the trie phase.
 
-Interface mirrors the python SecureTrie surface the engine uses (get/
-update/delete/hash) plus commit_into(node_db) which exports the hashed
-nodes for interop with python tries/StateDBs.  Bit-identical roots are
-pinned against the python implementation by tests.
+Backend selection (``backend()``): ``CORETH_TRIE=native`` demands the
+C++ trie (raises if the library is unavailable), ``CORETH_TRIE=py``
+forces the pure-Python ``mpt.trie`` path (with the measured
+``mpt.rehash`` device batched-keccak policy); unset picks native when
+the library loads.  ``CORETH_NATIVE_TRIE=0`` remains the legacy
+kill-switch for the auto default.
+
+Each contract's storage trie is its own native handle — a
+per-contract session kept alive across commit windows — and the
+window-batched fold-and-root calls (``fold_storage``,
+``fold_accounts_root``) commit a whole deduped window in one ctypes
+crossing per trie.  Interface mirrors the python SecureTrie surface
+the engine uses (get/update/delete/hash) plus commit_into(node_db)
+which exports the hashed nodes for interop with python tries/StateDBs.
+Bit-identical roots are pinned against the python implementation by
+tests, and ``CORETH_TRIE_CHECK=1`` (``CheckedSecureTrie``) keeps the
+Python trie in the loop as a differential oracle that re-derives every
+root.
 """
 
 from __future__ import annotations
 
 import ctypes
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.crypto import native as _native
@@ -27,6 +41,27 @@ def available() -> bool:
     if os.environ.get("CORETH_NATIVE_TRIE", "1") == "0":
         return False
     return _native.load() is not None
+
+
+def backend() -> str:
+    """The selected trie backend: 'native' or 'py' (CORETH_TRIE)."""
+    import os
+    env = os.environ.get("CORETH_TRIE", "")
+    if env in ("py", "python"):
+        return "py"
+    if env == "native":
+        if _native.load() is None:
+            raise RuntimeError(
+                "CORETH_TRIE=native but the native library is "
+                "unavailable (no toolchain and no prebuilt .so)")
+        return "native"
+    if env:
+        raise ValueError(f"CORETH_TRIE={env!r}: expected 'native' or 'py'")
+    return "native" if available() else "py"
+
+
+class TrieOracleError(AssertionError):
+    """CORETH_TRIE_CHECK divergence: native and Python roots differ."""
 
 
 class NativeSecureTrie:
@@ -63,6 +98,22 @@ class NativeSecureTrie:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_uint64]
         lib.coreth_trie_fold_accounts.restype = None
+        # window-commit ABI (PR 4); probe per symbol so an older
+        # prebuilt .so degrades to the loop fallbacks below
+        if hasattr(lib, "coreth_trie_fold_storage"):
+            lib.coreth_trie_delete.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p]
+            lib.coreth_trie_delete.restype = None
+            lib.coreth_trie_fold_storage.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_uint64, ctypes.c_char_p]
+            lib.coreth_trie_fold_storage.restype = None
+            lib.coreth_trie_fold_accounts_root.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_uint64, ctypes.c_char_p]
+            lib.coreth_trie_fold_accounts_root.restype = None
         lib._trie_decls = True
 
     def __del__(self):
@@ -81,7 +132,13 @@ class NativeSecureTrie:
         self.update_hashed(keccak256(key), value)
 
     def delete(self, key: bytes) -> None:
-        self.update_hashed(keccak256(key), b"")
+        self.delete_hashed(keccak256(key))
+
+    def delete_hashed(self, key32: bytes) -> None:
+        if hasattr(self._lib, "coreth_trie_delete"):
+            self._lib.coreth_trie_delete(self.h, key32)
+        else:  # prebuilt-.so degradation: len-0 batch entry deletes
+            self.update_hashed(key32, b"")
 
     # ----------------------------------------------------------- hashed ops
     def get_hashed(self, key32: bytes) -> Optional[bytes]:
@@ -140,6 +197,49 @@ class NativeSecureTrie:
             self.h, keys32, balances32, arr, roots32, code_hashes32,
             mc, deletes, n)
 
+    # --------------------------------------------- window fold-and-root
+    def fold_storage(self, keys32: bytes, vals32: bytes,
+                     n: int) -> bytes:
+        """Fold a deduped window of storage writes (pre-hashed keys,
+        raw 32-byte BE values, zero => delete) and return the new
+        storage root — ONE ctypes crossing per contract per window."""
+        if hasattr(self._lib, "coreth_trie_fold_storage"):
+            out = ctypes.create_string_buffer(32)
+            self._lib.coreth_trie_fold_storage(self.h, keys32, vals32,
+                                               n, out)
+            return out.raw
+        # prebuilt-.so degradation: batched update (len 0 deletes)
+        from coreth_tpu import rlp
+        lens: List[int] = []
+        blob = bytearray()
+        for i in range(n):
+            v = vals32[32 * i:32 * i + 32].lstrip(b"\x00")
+            if not v:
+                lens.append(0)
+                continue
+            enc = rlp.encode(v)
+            lens.append(len(enc))
+            blob += enc
+        self.update_batch_hashed(keys32, bytes(blob), lens)
+        return self.hash()
+
+    def fold_accounts_root(self, keys32: bytes, balances32: bytes,
+                           nonces, roots32: bytes,
+                           code_hashes32: bytes, mc: bytes,
+                           deletes: bytes) -> bytes:
+        """Account fold + rehash in one crossing; returns the root."""
+        n = len(deletes)
+        if hasattr(self._lib, "coreth_trie_fold_accounts_root"):
+            arr = (ctypes.c_uint64 * n)(*nonces)
+            out = ctypes.create_string_buffer(32)
+            self._lib.coreth_trie_fold_accounts_root(
+                self.h, keys32, balances32, arr, roots32,
+                code_hashes32, mc, deletes, n, out)
+            return out.raw
+        self.fold_accounts(keys32, balances32, nonces, roots32,
+                           code_hashes32, mc, deletes)
+        return self.hash()
+
     # ------------------------------------------------------------- seeding
     @classmethod
     def from_python_trie(cls, trie) -> "NativeSecureTrie":
@@ -149,3 +249,108 @@ class NativeSecureTrie:
         for nibs, value in trie.items():
             out.update_hashed(nibbles_to_key(nibs), value)
         return out
+
+
+class CheckedSecureTrie:
+    """CORETH_TRIE_CHECK=1 differential oracle.
+
+    Wraps a native trie and its Python ``SecureTrie`` twin: every
+    mutation (including the window-batched folds) applies to BOTH, and
+    every root derivation re-derives the root on the Python trie and
+    raises ``TrieOracleError`` on the first divergence.  Debug/test
+    mode — the twin costs the full Python fold this pipeline exists to
+    avoid.
+    """
+
+    def __init__(self, py_trie):
+        self.py = py_trie
+        self.native = NativeSecureTrie.from_python_trie(py_trie)
+        self._check(seed=True)
+
+    # Trie.update on the twin writes by PRE-HASHED key (SecureTrie
+    # would re-keccak); imported lazily to keep module import light.
+    def _py_update_hashed(self, key32: bytes, value: bytes) -> None:
+        from coreth_tpu.mpt.trie import Trie
+        Trie.update(self.py, key32, value)
+
+    def _check(self, seed: bool = False) -> bytes:
+        n = self.native.hash()
+        p = self.py.hash()
+        if n != p:
+            raise TrieOracleError(
+                f"trie oracle divergence{' at seed' if seed else ''}: "
+                f"native {n.hex()} != py {p.hex()}")
+        return n
+
+    # ------------------------------------------------------ secure ops
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.native.get(key)
+
+    def update(self, key: bytes, value: bytes) -> None:
+        self.native.update(key, value)
+        self.py.update(key, value)
+
+    def delete(self, key: bytes) -> None:
+        self.native.delete(key)
+        self.py.delete(key)
+
+    def hash(self) -> bytes:
+        return self._check()
+
+    def commit_into(self, node_db: Dict[bytes, bytes]) -> bytes:
+        root = self.native.commit_into(node_db)
+        py_root = self.py.commit()
+        if root != py_root:
+            raise TrieOracleError(
+                f"trie oracle divergence at commit: native "
+                f"{root.hex()} != py {py_root.hex()}")
+        return root
+
+    # ----------------------------------------------- window fold-and-root
+    def fold_storage(self, keys32: bytes, vals32: bytes,
+                     n: int) -> bytes:
+        from coreth_tpu import rlp
+        root = self.native.fold_storage(keys32, vals32, n)
+        for i in range(n):
+            key32 = keys32[32 * i:32 * i + 32]
+            v = vals32[32 * i:32 * i + 32].lstrip(b"\x00")
+            self._py_update_hashed(key32, rlp.encode(v) if v else b"")
+        py_root = self.py.hash()
+        if root != py_root:
+            raise TrieOracleError(
+                f"storage fold divergence: native {root.hex()} != "
+                f"py {py_root.hex()}")
+        return root
+
+    def fold_accounts(self, keys32: bytes, balances32: bytes, nonces,
+                      roots32: bytes, code_hashes32: bytes, mc: bytes,
+                      deletes: bytes) -> None:
+        self.fold_accounts_root(keys32, balances32, nonces, roots32,
+                                code_hashes32, mc, deletes)
+
+    def fold_accounts_root(self, keys32: bytes, balances32: bytes,
+                           nonces, roots32: bytes,
+                           code_hashes32: bytes, mc: bytes,
+                           deletes: bytes) -> bytes:
+        from coreth_tpu.types.account import StateAccount
+        root = self.native.fold_accounts_root(
+            keys32, balances32, nonces, roots32, code_hashes32, mc,
+            deletes)
+        for i in range(len(deletes)):
+            key32 = keys32[32 * i:32 * i + 32]
+            if deletes[i]:
+                self._py_update_hashed(key32, b"")
+                continue
+            self._py_update_hashed(key32, StateAccount(
+                nonce=int(nonces[i]),
+                balance=int.from_bytes(
+                    balances32[32 * i:32 * i + 32], "big"),
+                root=roots32[32 * i:32 * i + 32],
+                code_hash=code_hashes32[32 * i:32 * i + 32],
+                is_multi_coin=bool(mc[i])).rlp())
+        py_root = self.py.hash()
+        if root != py_root:
+            raise TrieOracleError(
+                f"account fold divergence: native {root.hex()} != "
+                f"py {py_root.hex()}")
+        return root

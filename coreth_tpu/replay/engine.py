@@ -39,7 +39,6 @@ import jax
 import jax.numpy as jnp
 
 from coreth_tpu.consensus.engine import DummyEngine
-from coreth_tpu.mpt.rehash import device_rehash
 from coreth_tpu.ops import u256
 from coreth_tpu.params import ChainConfig
 from coreth_tpu.params import protocol as P
@@ -564,13 +563,20 @@ class ReplayEngine:
             self._mesh_slot = sharded_slot_step(mesh, scap)
             self._mesh_recover = sharded_recover(mesh)
         from coreth_tpu.mpt import native_trie
-        self._native = native_trie.available()
+        # commit-path backend: CORETH_TRIE=native|py (default: native
+        # when the library loads); CORETH_TRIE_CHECK=1 arms the
+        # python-twin differential oracle on every root derivation
+        self._native = native_trie.backend() == "native"
+        self._trie_check = bool(os.environ.get("CORETH_TRIE_CHECK"))
         self.trie = db.open_trie(state_root)
         if self._native:
             # C++ trie for the hot fold (bit-identical roots pinned by
             # tests); python tries remain the interop format in the db
-            self.trie = native_trie.NativeSecureTrie.from_python_trie(
-                self.trie)
+            if self._trie_check:
+                self.trie = native_trie.CheckedSecureTrie(self.trie)
+            else:
+                self.trie = native_trie.NativeSecureTrie \
+                    .from_python_trie(self.trie)
         self.state = DeviceState(capacity, slot_capacity or capacity)
         self.signer = LatestSigner(config.chain_id)
         # a DummyEngine with ConsensusCallbacks makes the host fallback
@@ -604,6 +610,10 @@ class ReplayEngine:
         # window runner drops its device-resident slot table when it
         # observes a bump (its mirror can no longer be trusted)
         self.storage_epoch = 0
+        # window-batched trie commit (replay/commit.py): finished
+        # blocks stage deduped writes; flush() folds once per window
+        from coreth_tpu.replay.commit import CommitPipeline
+        self.commit_pipe = CommitPipeline(self)
 
     # ---------------------------------------------------------------- index
     def _account(self, addr: bytes) -> int:
@@ -615,22 +625,21 @@ class ReplayEngine:
         return self.state.ensure(addr, account)
 
     def _storage_trie(self, contract: bytes):
+        """Per-contract storage-trie session, opened lazily from the
+        account root and kept alive across commit windows."""
         st = self.storage_tries.get(contract)
         if st is None:
             idx = self.state.index[contract]
             st = self.db.open_trie(self.state.roots[idx])
             if self._native:
-                from coreth_tpu.mpt.native_trie import NativeSecureTrie
-                st = NativeSecureTrie.from_python_trie(st)
+                from coreth_tpu.mpt.native_trie import (
+                    CheckedSecureTrie, NativeSecureTrie)
+                if self._trie_check:
+                    st = CheckedSecureTrie(st)
+                else:
+                    st = NativeSecureTrie.from_python_trie(st)
             self.storage_tries[contract] = st
         return st
-
-    def _rehash(self, trie) -> bytes:
-        """Root of a fold target: native tries hash in C++; python
-        tries go through the measured rehash policy (mpt/rehash)."""
-        if self._native:
-            return trie.hash()
-        return device_rehash(trie)
 
     def _slot(self, contract: bytes, key: bytes) -> int:
         """Device slot index for (contract, EVM-level storage key),
@@ -643,9 +652,11 @@ class ReplayEngine:
         s_idx = self.state.slot_index.get((contract, key))
         if s_idx is not None:
             return s_idx
-        from coreth_tpu import rlp
-        raw = self._storage_trie(contract).get(key)
-        value = int.from_bytes(rlp.decode(raw), "big") if raw else 0
+        value = self.commit_pipe.base_value(contract, key)
+        if value is None:
+            from coreth_tpu import rlp
+            raw = self._storage_trie(contract).get(key)
+            value = int.from_bytes(rlp.decode(raw), "big") if raw else 0
         return self.state.ensure_slot(contract, key, value)
 
     # -------------------------------------------------------------- senders
@@ -1217,11 +1228,16 @@ class ReplayEngine:
         items = win["items"]
         for k, (block, batch) in enumerate(items):
             if arr[k, -1, 0] != 1:
+                # fold the staged valid prefix [0, k) before the
+                # rewind: _fallback opens a StateDB at self.root
+                self.commit_pipe.flush()
                 return self._recover_window(win, arr, k, blocks, start_idx)
             self._validate_and_advance(block, batch, arr[k],
                                        win["touched_lists"][k],
                                        win["slot_lists"][k],
                                        win["t_pad"])
+        # ONE deduped fold + root check for the whole window
+        self.commit_pipe.flush()
         # NOTE: the classifier's slot overlay is NOT cleared here — with
         # window speculation (replay() issues window k+1 before
         # validating window k) the overlay still carries the in-flight
@@ -1264,8 +1280,8 @@ class ReplayEngine:
                               fetched: np.ndarray, touched: List[int],
                               touched_slots: List[int],
                               t_pad: int) -> None:
-        """Host-side consensus checks + trie fold for one device block."""
-        from coreth_tpu import rlp
+        """Host-side consensus checks + staged commit for one device
+        block (the trie fold itself is window-batched)."""
         B = len(block.transactions)
         gas_list = batch["gas_used"]
         logs = batch["logs"]
@@ -1316,83 +1332,27 @@ class ReplayEngine:
                 block.base_fee, block.header.block_gas_cost,
                 block.transactions, receipts, None)
         t0 = time.monotonic()
-        # fold touched storage slots into their contract tries, rehash,
-        # and pick up the new storage roots before the account fold
+        # STAGE this block's trie effects — the fold itself is
+        # window-batched (replay/commit.py): _complete_window flushes
+        # ONE deduped fold per window after the next window's device
+        # scan is already in flight, so the trie phase overlaps it
+        writes: Dict[Tuple[bytes, bytes], int] = {}
         if touched_slots:
             self.storage_epoch += 1
             slot_vals = u256.to_ints(
                 fetched[t_pad:t_pad + len(touched_slots), :16])
-            changed = {}
             for i, s_idx in enumerate(touched_slots):
                 contract, key = self.state.slot_keys[s_idx]
                 v = slot_vals[i]
                 self.state.slot_host[s_idx] = v
-                st = self._storage_trie(contract)
-                if v == 0:
-                    st.delete(key)
-                else:
-                    st.update(key, rlp.encode(
-                        v.to_bytes(32, "big").lstrip(b"\x00")))
-                changed[contract] = st
-            for contract, st in changed.items():
-                self.state.roots[self.state.index[contract]] = \
-                    self._rehash(st)
+                writes[(contract, key)] = v
         n_touched = len(touched)
         balances = u256.to_ints(fetched[:n_touched, :16])
         nonces = fetched[:n_touched, 16]
-        if self._native:
-            # one ctypes call folds the whole block; RLP happens in C++
-            keys = bytearray()
-            bals = bytearray()
-            roots = bytearray()
-            hashes = bytearray()
-            mc = bytearray(n_touched)
-            dels = bytearray(n_touched)
-            nlist = []
-            addr_hashes = self.state.addr_hashes
-            for i, idx in enumerate(touched):
-                keys += addr_hashes[idx]
-                balance, nonce = balances[i], int(nonces[i])
-                code_hash = self.state.code_hashes[idx]
-                storage_root = self.state.roots[idx]
-                if (balance == 0 and nonce == 0
-                        and code_hash == EMPTY_CODE_HASH
-                        and storage_root == EMPTY_ROOT_HASH
-                        and not self.state.multicoin[idx]):
-                    dels[i] = 1  # EIP-158 touched-empty deletion
-                    balance = 0
-                bals += balance.to_bytes(32, "big")
-                roots += storage_root
-                hashes += code_hash
-                mc[i] = 1 if self.state.multicoin[idx] else 0
-                nlist.append(nonce)
-            self.trie.fold_accounts(bytes(keys), bytes(bals), nlist,
-                                    bytes(roots), bytes(hashes),
-                                    bytes(mc), bytes(dels))
-        else:
-            for i, idx in enumerate(touched):
-                addr = self.state.addrs[idx]
-                balance, nonce = balances[i], int(nonces[i])
-                code_hash = self.state.code_hashes[idx]
-                storage_root = self.state.roots[idx]
-                if (balance == 0 and nonce == 0
-                        and code_hash == EMPTY_CODE_HASH
-                        and storage_root == EMPTY_ROOT_HASH
-                        and not self.state.multicoin[idx]):
-                    # touched but empty: EIP-158 deletion semantics
-                    self.trie.delete(addr)
-                else:
-                    self.trie.update(addr, StateAccount(
-                        nonce=nonce, balance=balance, root=storage_root,
-                        code_hash=code_hash,
-                        is_multi_coin=self.state.multicoin[idx]).rlp())
-        root = self._rehash(self.trie)
+        accounts = {self.state.addrs[idx]: (balances[i], int(nonces[i]))
+                    for i, idx in enumerate(touched)}
+        self.commit_pipe.stage(block.header, writes, accounts)
         self.stats.t_trie += time.monotonic() - t0
-        if root != block.header.root:
-            raise ReplayError(
-                f"state root mismatch at block {block.number}: "
-                f"{root.hex()} != {block.header.root.hex()}")
-        self.root = root
         self.parent_header = block.header
         self.stats.blocks_device += 1
         self.stats.txs += B
@@ -1561,6 +1521,7 @@ class ReplayEngine:
     def _fallback(self, block: Block) -> bytes:
         """Bit-exact host path for non-transfer blocks; device state for
         touched accounts is refreshed afterwards."""
+        self.commit_pipe.flush()  # staged windows precede this block
         t0 = time.monotonic()
         if self._native:
             self.trie.commit_into(self.db.node_db)
@@ -1656,6 +1617,7 @@ class ReplayEngine:
 
     def commit(self) -> bytes:
         """Persist the engine tries so host StateDBs can open the state."""
+        self.commit_pipe.flush()
         if self._native:
             for st in self.storage_tries.values():
                 st.commit_into(self.db.node_db)

@@ -45,7 +45,6 @@ from coreth_tpu.mpt import StackTrie
 from coreth_tpu.types import (
     Block, Log, Receipt, StateAccount, create_bloom, derive_sha,
 )
-from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
 from coreth_tpu import rlp
 
 
@@ -254,6 +253,12 @@ class MachineBlockExecutor:
 
     # ------------------------------------------------------------- storage
     def _base_value(self, contract: bytes, key: bytes) -> int:
+        # staged-but-unfolded window writes are authoritative over the
+        # trie (the commit pipeline defers folds past the next
+        # window's dispatch)
+        v = self.e.commit_pipe.base_value(contract, key)
+        if v is not None:
+            return v
         st = self.e._storage_trie(contract)
         raw = st.get(key)
         return int.from_bytes(rlp.decode(raw), "big") if raw else 0
@@ -267,6 +272,10 @@ class MachineBlockExecutor:
         path."""
         from coreth_tpu.replay.engine import ReplayError
         e = self.e
+        # a fused window may have staged earlier blocks of this run;
+        # _host_resolve commits the engine tries for its scratch
+        # StateDB, so the pending folds must land first
+        e.commit_pipe.flush()
         t0 = time.monotonic()
         env = BlockEnv(
             coinbase=block.header.coinbase, timestamp=block.time,
@@ -366,11 +375,19 @@ class MachineBlockExecutor:
 
     # ---------------------------------------------------- finish (shared)
     def _finish_block(self, block: Block, plans: List[TxPlan],
-                      results: Dict[int, object]) -> bytes:
-        """Account sweep + receipts + trie fold + root check for one
-        block whose per-call-tx results are final (device-committed by
-        the fused OCC kernel, or converged by the legacy host loop).
-        Host work is O(txs), not O(gas)."""
+                      results: Dict[int, object],
+                      defer: bool = False) -> Optional[bytes]:
+        """Account sweep + receipts + staged trie commit for one block
+        whose per-call-tx results are final (device-committed by the
+        fused OCC kernel, or converged by the legacy host loop).  Host
+        work is O(txs), not O(gas).
+
+        The trie fold itself is window-batched (replay/commit.py):
+        this stages the block's deduped writes and, unless ``defer``,
+        flushes immediately (per-block semantics — the legacy paths).
+        With ``defer=True`` the caller owns the flush, so a fused
+        window folds ONCE while the next window's dispatch is already
+        in flight."""
         from coreth_tpu.replay.engine import ReplayError
         e = self.e
         t1 = time.monotonic()
@@ -379,12 +396,18 @@ class MachineBlockExecutor:
         def acct(addr: bytes) -> List[int]:
             st = accounts.get(addr)
             if st is None:
-                raw = e.trie.get(addr)
-                if raw is not None:
-                    a = StateAccount.from_rlp(raw)
-                    st = [a.balance, a.nonce]
+                pend = e.commit_pipe.account_view(addr)
+                if pend is not None:
+                    # written by an earlier block of this window; the
+                    # fold is still pending
+                    st = [pend[0], pend[1]]
                 else:
-                    st = [0, 0]
+                    raw = e.trie.get(addr)
+                    if raw is not None:
+                        a = StateAccount.from_rlp(raw)
+                        st = [a.balance, a.nonce]
+                    else:
+                        st = [0, 0]
                 accounts[addr] = st
             return st
 
@@ -438,44 +461,18 @@ class MachineBlockExecutor:
                 block.base_fee, block.header.block_gas_cost,
                 block.transactions, receipts, None)
 
-        # ---------------- fold storage + accounts into the tries
+        # ---------------- stage storage + accounts for the window fold
         self.last_writes = writes_final
-        contracts: Dict[bytes, object] = {}
-        for (contract, key), v in writes_final.items():
-            st = e._storage_trie(contract)
-            if v == 0:
-                st.delete(key)
-            else:
-                st.update(key, rlp.encode(
-                    v.to_bytes(32, "big").lstrip(b"\x00")))
-            contracts[contract] = st
-        for contract, st in contracts.items():
-            idx = e.state.index[contract]
-            e.state.roots[idx] = e._rehash(st)
-        for addr, (bal, nonce) in accounts.items():
-            idx = e._account(addr)
-            code_hash = e.state.code_hashes[idx]
-            root = e.state.roots[idx]
-            if (bal == 0 and nonce == 0
-                    and code_hash == EMPTY_CODE_HASH
-                    and root == EMPTY_ROOT_HASH
-                    and not e.state.multicoin[idx]):
-                e.trie.delete(addr)
-            else:
-                e.trie.update(addr, StateAccount(
-                    nonce=nonce, balance=bal, root=root,
-                    code_hash=code_hash,
-                    is_multi_coin=e.state.multicoin[idx]).rlp())
-        root = e._rehash(e.trie)
-        e.stats.t_trie += time.monotonic() - t1
-        if root != block.header.root:
-            raise ReplayError(
-                f"machine block: state root mismatch at block "
-                f"{block.number}: {root.hex()} != "
-                f"{block.header.root.hex()}")
+        e.commit_pipe.stage(
+            block.header, writes_final,
+            {addr: (st[0], st[1]) for addr, st in accounts.items()})
 
         # ---------------- refresh the device-state mirrors
         e._slot_overlay.clear()
+        for addr in accounts:
+            # ensure device rows exist (fresh recipients/coinbase) —
+            # the account fold that used to do this is now deferred
+            e._account(addr)
         e.state.flush_staged()
         for addr, (bal, nonce) in accounts.items():
             idx = e.state.index[addr]
@@ -486,12 +483,14 @@ class MachineBlockExecutor:
                 e.state.slot_host[s_idx] = v
                 e.state._staged_slots.append((s_idx, v))
         e.state.flush_staged()
-        e.root = root
         e.parent_header = block.header
         self.blocks += 1
         e.stats.blocks_device += 1
         e.stats.txs += len(block.transactions)
-        return root
+        e.stats.t_trie += time.monotonic() - t1
+        if defer:
+            return None  # window owner flushes (and root-checks)
+        return e.commit_pipe.flush()
 
     # -------------------------------------------- serial short-circuit
     def _serial_eligible(self, plans: List[TxPlan]) -> bool:
@@ -591,10 +590,15 @@ class MachineBlockExecutor:
                     be.clear_storage()  # execute() moved the tries
                 else:
                     n_calls = len(results)
-                    self._finish_block(block, plans, results)
+                    # deferred: one deduped fold per serial run (the
+                    # session's committed cache carries cross-block
+                    # reads; _base_value consults the staged writes)
+                    self._finish_block(block, plans, results,
+                                       defer=True)
                     self.serial_blocks += 1
                     self.native_txs += n_calls
                 consumed += 1
+            e.commit_pipe.flush()
         finally:
             be.close()
             if self._runner is not None:
@@ -721,8 +725,12 @@ class MachineBlockExecutor:
                     results = {i: wres.results[k][n]
                                for n, i in enumerate(call_idx)}
                     self.rounds += max(0, wres.rounds[k] - 1)
-                    # _finish_block also advances blocks/stats/root
-                    self._finish_block(block, plans, results)
+                    # deferred: the whole window's writes dedupe to
+                    # last-value-per-(contract, slot) and fold in ONE
+                    # batch per contract below, after the next
+                    # window's dispatch is already in flight
+                    self._finish_block(block, plans, results,
+                                       defer=True)
                     if not pre_committed:
                         # mirror already learned this chunk's writes
                         # ahead of the pipelined issue() above
@@ -733,6 +741,7 @@ class MachineBlockExecutor:
                 # and every later block of the window ran against a
                 # speculative base — escalate THIS block to the legacy
                 # path and hand the rest back for re-classification
+                # (execute() flushes the staged clean prefix first)
                 self.dirty_blocks += 1
                 runner.invalidate()
                 root = self.execute(block, plans)
@@ -743,5 +752,8 @@ class MachineBlockExecutor:
                 else:
                     runner.commit_block(self.last_writes)
                 return consumed + 1
+            # ONE deduped fold + root check per fused window — the
+            # commit-phase analog of the O(1)-dispatch execute phase
+            e.commit_pipe.flush()
             ci += 1
         return consumed

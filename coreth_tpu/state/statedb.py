@@ -117,6 +117,13 @@ class StateDB:
         self.transient: Dict[Tuple[bytes, bytes], bytes] = {}
         self.predicate_storage_slots: Dict[bytes, List[bytes]] = {}
         self._storage_tries: Dict[bytes, SecureTrie] = {}
+        # monotone counter bumped on every mutation that can change
+        # what a (contract, slot) or code resolution returns (storage
+        # writes, deploys, journal reverts, suicides).  The hostexec
+        # bridge compares it across txs to keep its native session's
+        # committed-storage cache alive within a block and invalidate
+        # it the moment an interpreter-path tx moves state under it.
+        self.storage_gen = 0
 
     # ------------------------------------------------------------- journal
     def _append_journal(self, undo, addr: Optional[bytes] = None) -> None:
@@ -131,6 +138,8 @@ class StateDB:
         if snap > len(self._journal) or snap < 0:
             raise ValueError(f"invalid snapshot id {snap} "
                              f"(journal length {len(self._journal)})")
+        if len(self._journal) > snap:
+            self.storage_gen += 1  # undone writes may reappear changed
         while len(self._journal) > snap:
             undo, addr = self._journal.pop()
             undo()
@@ -310,6 +319,7 @@ class StateDB:
             obj.dirty_code = False
 
         self._append_journal(undo, addr)
+        self.storage_gen += 1  # a deploy changes code resolution
         obj.code = code
         obj.account.code_hash = keccak256(code)
         obj.dirty_code = True
@@ -385,6 +395,7 @@ class StateDB:
                 obj.dirty_storage.pop(key, None)
 
         self._append_journal(undo, obj.address)
+        self.storage_gen += 1
         obj.dirty_storage[key] = value
 
     # ----------------------------------------------------------- transient
@@ -418,6 +429,7 @@ class StateDB:
             obj.account.balance = prev_balance
 
         self._append_journal(undo, addr)
+        self.storage_gen += 1  # storage of addr vanishes at finalise
         obj.suicided = True
         obj.account.balance = 0
         return True

@@ -492,6 +492,55 @@ void coreth_trie_fold_accounts(void* h, const uint8_t* keys32,
   }
 }
 
+// Explicit single-key deletion (zeroed slot / EIP-158 empty-account
+// removal) — the one-record form of the len==0 update_batch path.
+void coreth_trie_delete(void* h, const uint8_t* key32) {
+  Trie* t = (Trie*)h;
+  uint8_t nib[64];
+  key_to_nibs(key32, nib);
+  t->erase(nib, 64);
+}
+
+// Batched storage fold-and-root: ONE call per contract per commit
+// window.  n records of pre-hashed slot key + raw 32-byte BE value;
+// an all-zero value deletes the slot (slot zeroing), otherwise the
+// stored leaf is RLP(value stripped of leading zeros) — the exact
+// encoding state_object.go updateTrie writes.  The new storage root
+// lands in root_out, so the caller pays one ctypes crossing for the
+// whole deduped window instead of one per slot plus a hash call.
+void coreth_trie_fold_storage(void* h, const uint8_t* keys32,
+                              const uint8_t* vals32, uint64_t n,
+                              uint8_t root_out[32]) {
+  Trie* t = (Trie*)h;
+  uint8_t nib[64];
+  for (uint64_t i = 0; i < n; ++i) {
+    key_to_nibs(keys32 + 32 * i, nib);
+    const uint8_t* v = vals32 + 32 * i;
+    int lead = 0;
+    while (lead < 32 && v[lead] == 0) ++lead;
+    if (lead == 32) {
+      t->erase(nib, 64);
+      continue;
+    }
+    Bytes payload;
+    rlp_string(payload, v + lead, 32 - lead);
+    t->insert(nib, 64, payload);
+  }
+  t->hash_root(root_out);
+}
+
+// Account fold-and-root: fold_accounts + rehash in one crossing (the
+// per-window account-trie commit).
+void coreth_trie_fold_accounts_root(
+    void* h, const uint8_t* keys32, const uint8_t* balances32,
+    const uint64_t* nonces, const uint8_t* roots32,
+    const uint8_t* code_hashes32, const uint8_t* mc, const uint8_t* del,
+    uint64_t n, uint8_t root_out[32]) {
+  coreth_trie_fold_accounts(h, keys32, balances32, nonces, roots32,
+                            code_hashes32, mc, del, n);
+  ((Trie*)h)->hash_root(root_out);
+}
+
 // export all hashed nodes: returns byte size written into `out`
 // ([hash32][u32 len][rlp])*, or the required size when out == NULL.
 uint64_t coreth_trie_export(void* h, uint8_t* out, uint64_t cap) {

@@ -1511,6 +1511,22 @@ void coreth_hostexec_reset(void* hp) {
   s->kind.clear();
 }
 
+// drop ONLY the cached EOA verdicts (kind == 0): an account can
+// spring into existence — or become existing-but-empty — through pure
+// balance moves, which the bridge's storage_gen reuse check cannot
+// see, and a stale EOA verdict would skip the code_resolver's
+// exist-and-empty host guard (EIP-158 touch deletion).  Registered
+// contracts keep their code, jumpdest analysis, and storage cache: a
+// code change always goes through StateDB.set_code, which bumps
+// storage_gen and forces the full reset.
+void coreth_hostexec_reset_kinds(void* hp) {
+  Sess* s = (Sess*)hp;
+  for (auto it = s->kind.begin(); it != s->kind.end();) {
+    if (it->second == 0) it = s->kind.erase(it);
+    else ++it;
+  }
+}
+
 // seed a committed value (OCC prefix overlay / sequential carry)
 void coreth_hostexec_seed_slot(void* hp, const uint8_t* addr20,
                                const uint8_t* key32,

@@ -164,6 +164,103 @@ def test_bridge_resolves_callee_fresh_per_tx():
     assert int.from_bytes(db.get_state(a, key1), "big") == 32
 
 
+def test_bridge_cross_tx_storage_cache_reuse():
+    """Resolved (contract, slot) values survive across native txs of
+    the same block — the session is NOT reset while statedb.storage_gen
+    proves nothing outside the bridge moved state — and a foreign write
+    (an interpreter-path tx) invalidates the cache (PR 3 follow-up)."""
+    from coreth_tpu.evm import EVM, BlockContext, TxContext
+    from coreth_tpu.evm import hostexec
+    from coreth_tpu.mpt import EMPTY_ROOT
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database, StateDB
+    sender, a = b"\x0a" * 20, b"\x43" * 20
+    # slot1 := SLOAD(slot0) — mirrors the committed base each tx
+    code = bytes([0x60, 0x00, 0x54, 0x60, 0x01, 0x55, 0x00])
+    db = StateDB(EMPTY_ROOT, Database())
+    db.set_code(a, code)
+    db.set_state(a, (0).to_bytes(32, "big"), (3).to_bytes(32, "big"))
+    db.add_balance(sender, 10**20)
+    db.finalise(True)
+    db.intermediate_root(True)
+    rules = CFG.rules(1, 1)
+    ctx = BlockContext(coinbase=b"\xba" * 20, gas_limit=8_000_000,
+                       number=1, time=1, base_fee=25 * 10**9)
+    evm = EVM(ctx, TxContext(origin=sender, gas_price=25 * 10**9), db,
+              CFG)
+    key0, key1 = (0).to_bytes(32, "big"), (1).to_bytes(32, "big")
+
+    def one_tx():
+        db.prepare(rules, sender, ctx.coinbase, a,
+                   list(rules.active_precompiles), [])
+        _, _, err = evm.call(sender, a, b"", 200_000, 0)
+        assert err is None
+        db.finalise(True)
+
+    hostexec.reset_counters()
+    one_tx()
+    assert hostexec.counters().get("storage_cache_reuse", 0) == 0
+    assert int.from_bytes(db.get_state(a, key1), "big") == 3
+    one_tx()                      # same statedb, untouched between txs
+    assert hostexec.counters().get("storage_cache_reuse", 0) == 1
+    # a foreign write moves slot0 under the session: the generation
+    # check must force a reset, and the new value must be visible
+    db.set_state(a, key0, (5).to_bytes(32, "big"))
+    db.finalise(True)
+    one_tx()
+    assert hostexec.counters().get("storage_cache_reuse", 0) == 1
+    assert int.from_bytes(db.get_state(a, key1), "big") == 5
+    assert hostexec.counters().get("native_calls", 0) == 3
+
+
+def test_bridge_cache_reuse_redrives_eoa_existence():
+    """An account can become existing-but-empty through pure balance
+    moves — invisible to storage_gen.  The reuse path must still
+    re-resolve EOA callees per tx, so the code_resolver's
+    exist-and-empty guard (EIP-158 touch deletion belongs to the
+    interpreter) fires instead of a stale cached EOA verdict executing
+    the subcall natively (regression on the cross-tx cache)."""
+    from coreth_tpu.evm import EVM, BlockContext, TxContext
+    from coreth_tpu.evm import hostexec
+    from coreth_tpu.mpt import EMPTY_ROOT
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database, StateDB
+    sender, a, b = b"\x0a" * 20, b"\x44" * 20, b"\x45" * 20
+    # A: zero-value CALL B, store success flag
+    code_a = (bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+                     0x60, 0x00, 0x73]) + b
+              + bytes([0x61, 0xFF, 0xFF, 0xF1,
+                       0x60, 0x01, 0x55, 0x00]))
+    db = StateDB(EMPTY_ROOT, Database())
+    db.set_code(a, code_a)
+    db.add_balance(sender, 10**20)
+    db.finalise(True)
+    db.intermediate_root(True)
+    rules = CFG.rules(1, 1)
+    ctx = BlockContext(coinbase=b"\xba" * 20, gas_limit=8_000_000,
+                       number=1, time=1, base_fee=25 * 10**9)
+    evm = EVM(ctx, TxContext(origin=sender, gas_price=25 * 10**9), db,
+              CFG)
+
+    def one_tx():
+        db.prepare(rules, sender, ctx.coinbase, a,
+                   list(rules.active_precompiles), [])
+        evm.call(sender, a, b"", 200_000, 0)
+
+    hostexec.reset_counters()
+    one_tx()                      # B nonexistent: native, EOA cached
+    assert hostexec.counters().get("native_calls", 0) == 1
+    # pure balance moves: B now EXISTS and is EMPTY; storage_gen is
+    # untouched, so the bridge will take the cache-reuse path
+    gen = db.storage_gen
+    db.add_balance(b, 5)
+    db.sub_balance(b, 5)
+    assert db.storage_gen == gen and db.exist(b) and db.empty(b)
+    one_tx()                      # must escape to the interpreter
+    assert hostexec.counters().get("host_escapes", 0) == 1
+    assert hostexec.counters().get("native_calls", 0) == 1
+
+
 # ------------------------------------------- corpus through the bridge
 
 def test_statetests_corpus_native_bit_identical(monkeypatch):

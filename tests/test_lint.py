@@ -87,6 +87,23 @@ def test_layer_upward_import_flagged():
     assert codes(check_layers([s], CONFIG)) == ["LAY001"]
 
 
+def test_ctypes_outside_native_boundary_flagged():
+    """replay binding ctypes directly bypasses the crypto/mpt/evm
+    native-runtime wrappers (LAY004)."""
+    s = src("import ctypes\n", path="coreth_tpu/replay/x.py")
+    assert codes(check_layers([s], CONFIG)) == ["LAY004"]
+    s = src("from ctypes import CDLL\n", path="coreth_tpu/state/x.py")
+    assert codes(check_layers([s], CONFIG)) == ["LAY004"]
+
+
+def test_ctypes_inside_native_boundary_allowed():
+    for path in ("coreth_tpu/mpt/native_trie2.py",
+                 "coreth_tpu/crypto/x.py",
+                 "coreth_tpu/evm/hostexec/y.py"):
+        s = src("import ctypes\n", path=path)
+        assert check_layers([s], CONFIG) == [], path
+
+
 def test_layer_lazy_import_also_flagged():
     s = src("""
         def f():
@@ -286,6 +303,91 @@ def test_jit_clean_and_unjitted_ignored():
             print(x)                      # not jitted: fine
             return [float(v) for v in x]
     """, path="coreth_tpu/parallel/x.py")
+    assert check_jit_purity([s]) == []
+
+
+def test_jit_factory_call_result_traced():
+    """jax.jit(build(...)) — the closure the factory returns is checked
+    like a decorated kernel (machine.py build_machine shape)."""
+    s = src("""
+        import jax
+        def build(params):
+            def run(x):
+                print(x)
+                return x
+            return run
+        fn = jax.jit(build(3))
+    """)
+    assert codes(check_jit_purity([s])) == ["JIT001"]
+
+
+def test_jit_factory_transitive_returns_traced():
+    """A factory returning another factory's call result is followed
+    through the call graph."""
+    s = src("""
+        import jax
+        import numpy as np
+        def inner(p):
+            def kernel(x):
+                return np.sum(x)
+            return kernel
+        def outer(p):
+            return inner(p)
+        fn = jax.jit(outer(1))
+    """)
+    assert codes(check_jit_purity([s])) == ["JIT002"]
+
+
+def test_jit_factory_marker_opt_in():
+    """# corethlint: jit-factory marks a factory whose closure is
+    jitted elsewhere (the _build_exec shape)."""
+    s = src("""
+        # corethlint: jit-factory
+        def build_exec(p):
+            def lanes(x):
+                return x.tolist()
+            return lanes
+    """)
+    assert codes(check_jit_purity([s])) == ["JIT005"]
+
+
+def test_jit_factory_tuple_return_and_decorated_marker():
+    """Tuple returns (`return init_fn, step_fn`) are traced, and the
+    marker is found above a decorator stack (FunctionDef.lineno is the
+    def line, not the first decorator's)."""
+    s = src("""
+        import functools
+        # corethlint: jit-factory
+        @functools.cache
+        def build_pair(p):
+            def init_fn(x):
+                return x
+            def step_fn(x):
+                print(x)
+                return x
+            return init_fn, step_fn
+    """)
+    assert codes(check_jit_purity([s])) == ["JIT001"]
+
+
+def test_jit_factory_clean_and_untraced_factory_ignored():
+    """Factories whose results are never jitted (and carry no marker)
+    stay unchecked; clean factory closures produce no findings."""
+    s = src("""
+        import jax
+        import jax.numpy as jnp
+        def build(p):
+            def run(x):
+                return jnp.add(x, p)
+            return run
+        def host_builder(p):
+            def probe(x):
+                print(x)              # never jitted: fine
+                return x
+            return probe
+        fn = jax.jit(build(2))
+        probe = host_builder(2)
+    """)
     assert check_jit_purity([s]) == []
 
 

@@ -1,0 +1,278 @@
+"""Native commit-path backend: differential + window-dedup suite.
+
+Pins the PR-4 commit pipeline:
+
+- randomized differential equivalence of the C++ secure trie vs the
+  Python ``SecureTrie`` over mixed update/delete/re-insert sequences
+  (slot zeroing, empty-value deletion, re-insertion after delete);
+- the batched fold-and-root ABI (``coreth_trie_fold_storage`` /
+  ``coreth_trie_fold_accounts_root``) against hand-folded Python
+  tries, including EIP-158 empty-account deletion records;
+- window-deduped folds produce the SAME roots as per-block folds
+  (CORETH_MACHINE_WINDOW=4 vs =1) while actually folding fewer times;
+- the ``CORETH_TRIE=py`` backend replays the same chain bit-identically
+  (the pipeline's pure-Python fold path);
+- the ``CORETH_TRIE_CHECK=1`` oracle passes on a clean run and raises
+  on an injected divergence.
+"""
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu.chain import Genesis, GenesisAccount
+from coreth_tpu.chain.chain_makers import generate_chain
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.mpt import SecureTrie, native_trie
+from coreth_tpu.mpt.trie import Trie
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.replay import ReplayEngine
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, StateAccount, sign_tx
+from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
+from coreth_tpu.workloads.swap import pool_genesis_account, swap_calldata
+from coreth_tpu import rlp
+
+native_only = pytest.mark.skipif(
+    not native_trie.available(),
+    reason="native trie library unavailable")
+
+GWEI = 10**9
+KEYS = [0x5000 + i for i in range(6)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+POOL = b"\x79" * 20
+
+
+# ------------------------------------------------------- differential
+
+@native_only
+def test_randomized_differential_mixed_ops():
+    """300 mixed update/delete/re-insert ops, root-compared every few
+    steps — deletion collapse paths (branch->ext/leaf merges) included
+    by construction from the random interleaving."""
+    rng = random.Random(0xC0FFEE)
+    py = SecureTrie()
+    nt = native_trie.NativeSecureTrie()
+    keys = [bytes([i + 1]) * 20 for i in range(32)]
+    live = set()
+    for step in range(300):
+        k = rng.choice(keys)
+        if k in live and rng.random() < 0.4:
+            py.delete(k)
+            nt.delete(k)
+            live.discard(k)
+        else:
+            v = bytes(rng.getrandbits(8)
+                      for _ in range(rng.randint(1, 60)))
+            py.update(k, v)
+            nt.update(k, v)
+            live.add(k)
+        if step % 7 == 0:
+            assert py.hash() == nt.hash(), f"diverged at step {step}"
+    assert py.hash() == nt.hash()
+    # drain to empty: the full delete-collapse gauntlet
+    for k in sorted(live):
+        py.delete(k)
+        nt.delete(k)
+        assert py.hash() == nt.hash()
+
+
+@native_only
+def test_fold_storage_batched_fold_and_root():
+    """One fold_storage call == python per-slot update/delete loop,
+    including zeroed slots (deletes) and re-inserts after zeroing."""
+    rng = random.Random(42)
+    slot_keys = [bytes([i]) * 32 for i in range(1, 24)]
+    base = {k: rng.randrange(1, 1 << 128) for k in slot_keys[:16]}
+    py = SecureTrie()
+    nt = native_trie.NativeSecureTrie()
+    for k, v in base.items():
+        enc = rlp.encode(v.to_bytes(32, "big").lstrip(b"\x00"))
+        py.update(k, enc)
+        nt.update(k, enc)
+    assert py.hash() == nt.hash()
+    # window write set: updates, zeroings, fresh inserts
+    writes = {}
+    for k in slot_keys[:8]:
+        writes[k] = rng.randrange(1, 1 << 200)
+    for k in slot_keys[8:12]:
+        writes[k] = 0                       # slot zeroing -> delete
+    for k in slot_keys[16:20]:
+        writes[k] = rng.randrange(1, 1 << 64)  # fresh slots
+    keys32 = b"".join(keccak256(k) for k in writes)
+    vals32 = b"".join(v.to_bytes(32, "big") for v in writes.values())
+    root = nt.fold_storage(keys32, vals32, len(writes))
+    for k, v in writes.items():
+        if v == 0:
+            py.delete(k)
+        else:
+            py.update(k, rlp.encode(v.to_bytes(32, "big").lstrip(b"\x00")))
+    assert root == py.hash() == nt.hash()
+    # zeroed slots can come back in a later window
+    k = slot_keys[8]
+    root2 = nt.fold_storage(keccak256(k), (77).to_bytes(32, "big"), 1)
+    py.update(k, rlp.encode(bytes([77])))
+    assert root2 == py.hash()
+
+
+@native_only
+def test_fold_accounts_root_with_empty_account_deletion():
+    """fold_accounts_root == python StateAccount fold, with EIP-158
+    deletion records, then re-insertion of a deleted account."""
+    rng = random.Random(7)
+    addrs = [bytes([i]) * 20 for i in range(1, 17)]
+    py = SecureTrie()
+    nt = native_trie.NativeSecureTrie()
+    for a in addrs[:12]:
+        acct = StateAccount(nonce=rng.randrange(100),
+                            balance=rng.randrange(1 << 100)).rlp()
+        py.update(a, acct)
+        nt.update(a, acct)
+    assert py.hash() == nt.hash()
+
+    def fold(records):
+        keys = bytearray()
+        bals = bytearray()
+        roots = bytearray()
+        hashes = bytearray()
+        mc = bytearray(len(records))
+        dels = bytearray(len(records))
+        nonces = []
+        for i, (a, balance, nonce, dele) in enumerate(records):
+            keys += keccak256(a)
+            bals += balance.to_bytes(32, "big")
+            roots += EMPTY_ROOT_HASH
+            hashes += EMPTY_CODE_HASH
+            dels[i] = 1 if dele else 0
+            nonces.append(nonce)
+            if dele:
+                py.delete(a)
+            else:
+                py.update(a, StateAccount(
+                    nonce=nonce, balance=balance).rlp())
+        return nt.fold_accounts_root(
+            bytes(keys), bytes(bals), nonces, bytes(roots),
+            bytes(hashes), bytes(mc), bytes(dels))
+
+    # one batch: updates + touched-empty deletions + fresh accounts
+    records = [(addrs[0], 5, 1, False),
+               (addrs[1], 0, 0, True),     # EIP-158 deletion
+               (addrs[2], 0, 0, True),
+               (addrs[13], 9, 0, False)]   # fresh
+    assert fold(records) == py.hash()
+    # deleted account reappears in a later window
+    assert fold([(addrs[1], 123, 1, False)]) == py.hash()
+
+
+# --------------------------------------------------- oracle + backend
+
+@native_only
+def test_checked_trie_oracle_detects_divergence():
+    py = SecureTrie()
+    py.update(b"\x01" * 20, b"hello")
+    ct = native_trie.CheckedSecureTrie(py)
+    ct.update(b"\x02" * 20, b"world")
+    assert ct.hash() == ct.native.hash()
+    # mutate the python twin behind the wrapper's back -> divergence
+    Trie.update(ct.py, keccak256(b"\x03" * 20), b"sneak")
+    with pytest.raises(native_trie.TrieOracleError):
+        ct.hash()
+
+
+def test_backend_selection_env(monkeypatch):
+    monkeypatch.setenv("CORETH_TRIE", "py")
+    assert native_trie.backend() == "py"
+    monkeypatch.delenv("CORETH_TRIE")
+    if native_trie.available():
+        assert native_trie.backend() == "native"
+        monkeypatch.setenv("CORETH_TRIE", "native")
+        assert native_trie.backend() == "native"
+    monkeypatch.setenv("CORETH_TRIE", "bogus")
+    with pytest.raises(ValueError):
+        native_trie.backend()
+
+
+# ------------------------------------------- engine window-dedup runs
+
+def _build_swap_chain(n_blocks, txs_per_block=4):
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[POOL] = pool_genesis_account(10**15, 10**15)
+    genesis = Genesis(config=CFG, gas_limit=8_000_000, alloc=alloc)
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    def gen(i, bg):
+        for k in range(txs_per_block):
+            t = sign_tx(DynamicFeeTx(
+                chain_id_=CFG.chain_id, nonce=nonces[k],
+                gas_tip_cap_=GWEI, gas_fee_cap_=300 * GWEI,
+                gas=200_000, to=POOL,
+                data=swap_calldata(1000 + 7 * i + k)), KEYS[k],
+                CFG.chain_id)
+            nonces[k] += 1
+            bg.add_tx(t)
+
+    blocks, _ = generate_chain(CFG, gblock, db, n_blocks, gen, gap=2)
+    return genesis, gblock, blocks
+
+
+def _replay_swap(genesis, gblock, blocks):
+    db = Database()
+    g = genesis.to_block(db)
+    assert g.root == gblock.root
+    eng = ReplayEngine(CFG, db, g.root, parent_header=g.header,
+                       window=4)
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 0
+    return eng
+
+def test_window_dedup_fold_equals_per_block_folds(monkeypatch):
+    """Every swap block rewrites the SAME pool reserve slots, so a
+    4-block window dedupes to one last-value write set — the fused
+    fold must land the same chain of header roots as per-block folds,
+    while actually folding once per window."""
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+    genesis, gblock, blocks = _build_swap_chain(4)
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "4")
+    windowed = _replay_swap(genesis, gblock, blocks)
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "1")
+    per_block = _replay_swap(genesis, gblock, blocks)
+    assert windowed.root == per_block.root == blocks[-1].root
+    # the windowed run folded once per fused window, not per block
+    assert windowed.commit_pipe.fold_calls < per_block.commit_pipe.fold_calls
+    assert windowed.commit_pipe.fold_blocks == \
+        per_block.commit_pipe.fold_blocks == 4
+
+
+def test_py_backend_replays_bit_identically(monkeypatch):
+    """CORETH_TRIE=py drives the pipeline's pure-Python fold path over
+    the same chain (machine blocks + window dedup) to the same roots."""
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+    genesis, gblock, blocks = _build_swap_chain(3)
+    native_eng = _replay_swap(genesis, gblock, blocks) \
+        if native_trie.available() else None
+    monkeypatch.setenv("CORETH_TRIE", "py")
+    py_eng = _replay_swap(genesis, gblock, blocks)
+    assert py_eng._native is False
+    assert py_eng.root == blocks[-1].root
+    if native_eng is not None:
+        assert native_eng.root == py_eng.root
+
+
+@native_only
+def test_trie_check_oracle_armed_replay(monkeypatch):
+    """CORETH_TRIE_CHECK=1: every window root re-derived on the Python
+    twin during a real machine-path replay."""
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+    monkeypatch.setenv("CORETH_TRIE_CHECK", "1")
+    genesis, gblock, blocks = _build_swap_chain(3)
+    eng = _replay_swap(genesis, gblock, blocks)
+    assert eng._trie_check
+    assert eng.commit_pipe.fold_calls > 0

@@ -7,6 +7,13 @@ jitted: functions whose decorator chain ends in ``jit``/``pallas_call``
 (including ``functools.partial(jax.jit, ...)``), and named functions
 passed to a ``jit``/``pallas_call`` call in the same module.
 
+Factory-built kernels are traced too: when a *call result* is jitted
+(``jax.jit(build_machine(params))``), the factory's call graph is
+followed — every closure it (or a factory it delegates to) returns is
+checked exactly like a decorated function.  A factory that is only
+invoked elsewhere can opt in explicitly with a ``# corethlint:
+jit-factory`` marker on (or directly above) its ``def`` line.
+
 - JIT001  ``print(...)`` inside a jitted function
 - JIT002  host numpy op (``np.*`` / ``numpy.*``) — use ``jnp``
 - JIT003  I/O (``open``/``input``) inside a jitted function
@@ -81,20 +88,80 @@ def _local_names(fn: ast.FunctionDef) -> Set[str]:
     return names
 
 
-def _jitted_functions(tree: ast.AST):
+_FACTORY_MARK = "corethlint: jit-factory"
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs (a
+    ``return`` inside a nested function belongs to that function)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _factory_returns(factory, by_name, seen):
+    """Closures a factory hands to its caller: nested (or module-level)
+    functions returned by name, plus — transitively — the returns of
+    any module-level factory whose *call result* is returned."""
+    if id(factory) in seen:
+        return []
+    seen.add(id(factory))
+    nested = {n.name: n for n in _own_nodes(factory)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    out = []
+    for node in _own_nodes(factory):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        vals = (node.value.elts
+                if isinstance(node.value, (ast.Tuple, ast.List))
+                else [node.value])  # `return init_fn, step_fn` counts
+        for val in vals:
+            if isinstance(val, ast.Name):
+                target = nested.get(val.id) or by_name.get(val.id)
+                if target is not None:
+                    out.append(target)
+            elif isinstance(val, ast.Call):
+                inner = by_name.get(_dotted_leaf(val.func))
+                if inner is not None:
+                    out.extend(_factory_returns(inner, by_name, seen))
+    return out
+
+
+def _jitted_functions(src: "Source"):
+    tree = src.tree
     defs = [n for n in ast.walk(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
     by_name = {}
     for d in defs:
         by_name.setdefault(d.name, d)
     jitted = [d for d in defs if any(_decorator_is_jit(x) for x in d.decorator_list)]
+    factory_seen: Set[int] = set()
     # fn = jax.jit(step)  /  return pallas_call(kernel, ...)
+    # fn = jax.jit(build_machine(params))  — follow the factory
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
                 and _dotted_leaf(node.func) in _JIT_LEAVES):
             for arg in node.args:
                 if isinstance(arg, ast.Name) and arg.id in by_name:
                     jitted.append(by_name[arg.id])
+                elif isinstance(arg, ast.Call):
+                    factory = by_name.get(_dotted_leaf(arg.func))
+                    if factory is not None:
+                        jitted.extend(_factory_returns(
+                            factory, by_name, factory_seen))
+    # explicit opt-in: '# corethlint: jit-factory' on or above the def
+    # (above the decorator stack, when there is one — FunctionDef.lineno
+    # is the `def` line, not the first decorator's)
+    for d in defs:
+        first = min([d.lineno]
+                    + [dec.lineno for dec in d.decorator_list])
+        if (_FACTORY_MARK in src.line(d.lineno)
+                or _FACTORY_MARK in src.line(first)
+                or _FACTORY_MARK in src.line(first - 1)):
+            jitted.extend(_factory_returns(d, by_name, factory_seen))
     seen, out = set(), []
     for d in jitted:
         if id(d) not in seen:
@@ -118,7 +185,7 @@ def check_jit_purity(sources: List[Source]) -> List[Finding]:
     findings = []
     for src in sources:
         imported = _imported_names(src.tree)
-        for fn in _jitted_functions(src.tree):
+        for fn in _jitted_functions(src):
             locals_ = _local_names(fn) | imported
             for node in ast.walk(fn):
                 if isinstance(node, (ast.Global, ast.Nonlocal)):

@@ -27,6 +27,9 @@ DEFAULT_TOML = os.path.join(os.path.dirname(__file__), "layers.toml")
 class Config:
     levels: Dict[str, int] = field(default_factory=dict)
     determinism_packages: List[str] = field(default_factory=list)
+    # packages allowed to bind the C++ runtime directly via ctypes
+    # ([native] ctypes_packages); an import elsewhere is LAY004
+    ctypes_packages: List[str] = field(default_factory=list)
 
 
 def _parse_minitoml(text: str) -> dict:
@@ -95,6 +98,7 @@ def load_config(toml_path: str = DEFAULT_TOML) -> Config:
         for pkg in layer.get("packages", []):
             cfg.levels[pkg] = layer["level"]
     cfg.determinism_packages = data.get("determinism", {}).get("packages", [])
+    cfg.ctypes_packages = data.get("native", {}).get("ctypes_packages", [])
     return cfg
 
 
@@ -150,6 +154,23 @@ def check_layers(sources: List[Source], config: Config) -> List[Finding]:
                 f"assign it a layer", f"package:{pkg}"))
             continue
         level = config.levels[pkg]
+        # LAY004 — the native-runtime boundary: a raw ctypes import
+        # outside the designated binder packages bypasses the loader,
+        # the ABI declarations, and the per-symbol degradation policy
+        if config.ctypes_packages and pkg not in config.ctypes_packages:
+            for node in ast.walk(src.tree):
+                mods = []
+                if isinstance(node, ast.Import):
+                    mods = [a.name.split(".")[0] for a in node.names]
+                elif isinstance(node, ast.ImportFrom) and not node.level:
+                    mods = [(node.module or "").split(".")[0]]
+                if "ctypes" in mods:
+                    findings.append(Finding(
+                        src.path, node.lineno, "LAY004",
+                        f"direct ctypes import in '{pkg}' — only "
+                        f"{sorted(config.ctypes_packages)} bind the "
+                        f"native runtime; go through their wrappers",
+                        "ctypes-outside-boundary"))
         seen = set()
         for node, target, name_form in _import_targets(src):
             if target == pkg:
