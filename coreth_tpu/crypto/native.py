@@ -2,45 +2,26 @@
 
 The native library supplies the fast paths that the reference gets from
 asm/cgo dependencies (SURVEY.md section 2.7): keccak-256 and batched
-secp256k1 recovery.  Built lazily with ``make -C native`` on first import if
-g++ is available; every caller keeps working on the pure-Python path when
-the build is unavailable.
+secp256k1 recovery.  Built lazily via ``coreth_tpu.nativebuild`` on
+first load if g++ is available; every caller keeps working on the
+pure-Python path when the build is unavailable.
+
+``CORETH_NATIVE_SANITIZE=1`` loads the sanitizer-hardened build
+(``libcoreth_native_asan.so``, ``make sanitize``) instead: same ABI,
+but every heap overflow / use-after-free / UB at the boundary aborts
+the process.  The ASan runtime must be preloaded for that to work —
+drive it through a subprocess with ``nativebuild.asan_env()`` (see
+tests/test_sanitize.py); the tier-1 sanitizer suite does exactly this.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libcoreth_native.so")
+from coreth_tpu import nativebuild
 
 _lib = None
-
-
-def _build() -> bool:
-    try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
-        return os.path.exists(_LIB_PATH)
-    except Exception:  # noqa: BLE001 — any build failure leaves the pure-py path active
-        return False
-
-
-def _stale() -> bool:
-    """True when any C++ source is newer than the built library."""
-    try:
-        lib_mtime = os.path.getmtime(_LIB_PATH)
-        for fn in os.listdir(_NATIVE_DIR):
-            if fn.endswith(".cc") or fn == "Makefile":
-                if os.path.getmtime(
-                        os.path.join(_NATIVE_DIR, fn)) > lib_mtime:
-                    return True
-    except OSError:
-        return False
-    return False
 
 
 def load():
@@ -51,16 +32,16 @@ def load():
     If the rebuild fails (no C++ toolchain), the existing prebuilt .so
     still loads — callers probe per-symbol (hasattr) for ABI surfaces
     newer than the prebuilt, so features degrade one by one instead of
-    all-or-nothing."""
+    all-or-nothing.  The ``CORETH_NATIVE_SANITIZE`` selection is read
+    once, at first load (the handle is cached for the process)."""
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
-        if not _build():
-            return None
-    elif _stale():
-        _build()  # best effort: fall back to the prebuilt on failure
-    lib = ctypes.CDLL(_LIB_PATH)
+    sanitize = os.environ.get("CORETH_NATIVE_SANITIZE", "") == "1"
+    path = nativebuild.ensure_built(sanitize=sanitize)
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
     lib.coreth_keccak256.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
     lib.coreth_keccak256.restype = None
@@ -96,6 +77,18 @@ def load():
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_double)]
     lib.coreth_evm_replay.restype = ctypes.c_int
+    lib.coreth_keccak256_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p]
+    lib.coreth_keccak256_batch.restype = None
+    lib.coreth_test_fe_mul.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.coreth_test_fe_mul.restype = None
+    # test-only symbol compiled ONLY into the sanitized build (`make
+    # sanitize`) — proves the ASan trap actually fires
+    if hasattr(lib, "coreth_sanitize_smoke"):
+        lib.coreth_sanitize_smoke.argtypes = [ctypes.c_int64]
+        lib.coreth_sanitize_smoke.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -206,6 +199,32 @@ def recover_prep(hashes: bytes, rs: bytes, ss: bytes, recids: bytes):
     ok = ctypes.create_string_buffer(n)
     lib.coreth_recover_prep(hashes, rs, ss, recids, n, xs, u1, u2, ok)
     return xs.raw, u1.raw, u2.raw, ok.raw
+
+
+def keccak256_batch(data: bytes, lens, stride: int) -> bytes:
+    """Batched fixed-stride keccak-256: item i occupies
+    ``data[i*stride : i*stride + lens[i]]``.  Returns the packed
+    32-byte digests."""
+    n = len(lens)
+    arr = (ctypes.c_uint64 * n)(*lens)
+    out = ctypes.create_string_buffer(32 * n)
+    _require().coreth_keccak256_batch(data, arr, stride, n, out)
+    return out.raw
+
+
+def sanitize_smoke_available() -> bool:
+    """True when the loaded library carries the test-only sanitizer
+    smoke helper (i.e. it is the ``make sanitize`` build)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "coreth_sanitize_smoke")
+
+
+def sanitize_smoke(idx: int) -> int:
+    """Drive the deliberately-bugged test-only helper: reads
+    ``buf[idx]`` of an 8-byte heap allocation.  ``idx >= 8`` is a heap
+    overflow the sanitized build must trap (abort), which is exactly
+    what tests/test_sanitize.py proves in a subprocess."""
+    return _require().coreth_sanitize_smoke(idx)
 
 
 def recover_finish(rows: bytes, n: int, ok_in: bytes):

@@ -46,24 +46,33 @@ def load_hostexec():
         ctypes.c_int]
     lib.coreth_hostexec_new.restype = ctypes.c_void_p
     lib.coreth_hostexec_free.argtypes = [ctypes.c_void_p]
+    lib.coreth_hostexec_free.restype = None
     lib.coreth_hostexec_env.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
         ctypes.c_char_p]
+    lib.coreth_hostexec_env.restype = None
     lib.coreth_hostexec_set_code.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_uint32]
+    lib.coreth_hostexec_set_code.restype = None
     lib.coreth_hostexec_clear_storage.argtypes = [ctypes.c_void_p]
+    lib.coreth_hostexec_clear_storage.restype = None
     lib.coreth_hostexec_reset.argtypes = [ctypes.c_void_p]
+    lib.coreth_hostexec_reset.restype = None
     if hasattr(lib, "coreth_hostexec_reset_kinds"):
         lib.coreth_hostexec_reset_kinds.argtypes = [ctypes.c_void_p]
+        lib.coreth_hostexec_reset_kinds.restype = None
     lib.coreth_hostexec_seed_slot.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p]
+    lib.coreth_hostexec_seed_slot.restype = None
     lib.coreth_hostexec_warm_addr.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p]
+    lib.coreth_hostexec_warm_addr.restype = None
     lib.coreth_hostexec_warm_slot.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.coreth_hostexec_warm_slot.restype = None
     lib.coreth_hostexec_call.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -73,13 +82,17 @@ def load_hostexec():
     lib.coreth_hostexec_out_writes.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
         ctypes.c_char_p]
+    lib.coreth_hostexec_out_writes.restype = None
     lib.coreth_hostexec_out_logs.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p]
+    lib.coreth_hostexec_out_logs.restype = None
     lib.coreth_hostexec_out_ret.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p]
+    lib.coreth_hostexec_out_ret.restype = None
     lib.coreth_hostexec_commit.argtypes = [ctypes.c_void_p]
+    lib.coreth_hostexec_commit.restype = None
     _lib = lib
     return _lib
 

@@ -17,23 +17,20 @@ def _encode_item(item) -> bytes:
             else item.encode())
 
 
-def derive_sha(items: Sequence, trie=None) -> bytes:
+def derive_sha(items: Sequence, trie) -> bytes:
     """Root over items exposing ``.encode()`` or ``.encode_consensus()``.
 
     ``trie`` is an empty trie-hasher exposing ``update``/``hash`` —
     the explicit-hasher shape of reference DeriveSha(list, hasher)
     (core/types/hashing.go:97), which keeps ``types`` below ``mpt``
-    in the layer map.  ``StackTrie()`` is what every in-tree caller
-    passes; omitting it falls back to a lazy import for callers
-    outside the package (kept off the module import graph on purpose).
+    in the layer map.  ``StackTrie()`` is what every caller passes
+    today; the old lazily-imported default is gone (it was a noqa'd
+    upward import kept only for API compatibility).
 
     Inserts in ascending RLP-key order — rlp(1..0x7f) sort below
     rlp(0) = 0x80 which sorts below rlp(0x80...) — so the streaming
     StackTrie sees strictly increasing keys (the same iteration trick
     as reference core/types/hashing.go:87-110)."""
-    if trie is None:
-        from coreth_tpu.mpt import StackTrie  # noqa: LAY001 — DeriveSha compat default; consensus paths pass the hasher explicitly
-        trie = StackTrie()
     n = len(items)
     for i in range(1, min(n, 0x80)):
         trie.update(rlp.encode(rlp.encode_uint(i)), _encode_item(items[i]))

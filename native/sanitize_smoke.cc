@@ -1,0 +1,24 @@
+// Test-only sanitizer smoke helper — compiled ONLY into the
+// sanitized build (`make sanitize` -> libcoreth_native_asan.so),
+// never into the production library.
+//
+// coreth_sanitize_smoke(idx) heap-allocates 8 bytes and reads
+// buf[idx]: in-bounds indices return the byte value (0), and any
+// idx >= 8 is a heap-buffer-overflow that AddressSanitizer must
+// abort on (-fno-sanitize-recover).  tests/test_sanitize.py calls it
+// in a subprocess both ways to prove the trap is actually armed —
+// a sanitizer build that silently loads without instrumenting would
+// otherwise pass every other test.
+
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+extern "C" int coreth_sanitize_smoke(int64_t idx) {
+  uint8_t* buf = new uint8_t[8];
+  std::memset(buf, 0, 8);
+  // volatile so the out-of-bounds read cannot be optimized away
+  volatile uint8_t v = buf[idx];
+  delete[] buf;
+  return (int)v;
+}

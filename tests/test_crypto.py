@@ -92,6 +92,25 @@ def test_recover_rejects_invalid():
         S.recover_pubkey(b"\x00" * 32, S.N, 1, 0)
 
 
+def test_native_keccak_batch_matches_singles():
+    """coreth_keccak256_batch (fixed-stride packed hashing) must agree
+    with per-item keccak256 across ragged lengths incl. the 136-byte
+    rate boundary."""
+    from coreth_tpu.crypto import keccak, native
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    stride = 144
+    lens = [0, 1, 55, 135, 136, 137, 144]
+    data = bytearray()
+    for i, ln in enumerate(lens):
+        item = bytes((i + j) % 256 for j in range(ln))
+        data += item + b"\x00" * (stride - ln)
+    out = native.keccak256_batch(bytes(data), lens, stride)
+    for i, ln in enumerate(lens):
+        item = bytes(data[i * stride:i * stride + ln])
+        assert out[32 * i:32 * i + 32] == keccak.keccak256_py(item), ln
+
+
 def test_native_fe_mul_carry_band():
     """Regression: fe_mul's second reduction fold can carry out of limb 3;
     the dropped 2^256 must be folded back in as P_C (mod p)."""
@@ -99,8 +118,7 @@ def test_native_fe_mul_carry_band():
     from coreth_tpu.crypto import native
     if native.load() is None:
         pytest.skip("native lib unavailable")
-    lib = native.load()
-    lib.coreth_test_fe_mul.argtypes = [ctypes.c_char_p] * 3
+    lib = native.load()  # loader declares coreth_test_fe_mul argtypes
     cases = [
         (0x200000000000000000000000000000000000000000000000000000003,
          0xDEBC32AB94B43FABCB3D33BEF15F01B6BB5DC8A5F93BB2A187AAE89CD3297E01),

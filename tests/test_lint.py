@@ -1,8 +1,10 @@
 """corethlint (tools/lint) — tier-1 gate plus per-pass unit fixtures.
 
 The gate test keeps the tree permanently clean: layer boundaries,
-determinism in consensus packages, jit purity, and rationalized broad
-excepts.  Pure AST — no jax, no device, no network.
+determinism in consensus packages, jit purity, rationalized broad
+excepts, and native-ABI conformance (run_all includes the nativeabi
+pass; its own fixtures live in tests/test_nativeabi.py).  Pure static
+analysis — no jax, no device, no network.
 """
 
 import os

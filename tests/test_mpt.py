@@ -242,6 +242,7 @@ def test_derive_sha_sizes_cross_engine():
     """derive_sha (StackTrie, reordered inserts) == naive Trie build
     across the 0x7f/0x80 index-ordering boundary."""
     from coreth_tpu import rlp as R
+    from coreth_tpu.mpt import StackTrie
     from coreth_tpu.mpt.trie import Trie
     from coreth_tpu.types import derive_sha
 
@@ -257,4 +258,4 @@ def test_derive_sha_sizes_cross_engine():
         t = Trie()
         for i, it in enumerate(items):
             t.update(R.encode(R.encode_uint(i)), it.encode())
-        assert derive_sha(items) == t.hash(), n
+        assert derive_sha(items, StackTrie()) == t.hash(), n

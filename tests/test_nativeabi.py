@@ -1,0 +1,431 @@
+"""nativeabi lint pass (tools/lint/nativeabi) — parser fixtures plus
+ABI001-ABI004 cross-check fixtures, each injected bug firing exactly
+its code.  Pure static analysis: no native build, no ctypes calls.
+"""
+
+import textwrap
+
+import pytest
+
+from tools.lint.core import Source
+from tools.lint.nativeabi import (
+    BINDING_MODULES, FUNCPTR, PTR_BYTES, PTR_VOID, VOID, check_nativeabi,
+    collect_c_exports, cross_check, normalize_c_type, parse_c_exports,
+    parse_ctypes_bindings, type_name,
+)
+
+U64 = ("int", 64, False)
+I64 = ("int", 64, True)
+U32 = ("int", 32, False)
+I32 = ("int", 32, True)
+F64 = ("float", 64)
+
+
+def c_exports(snippet: str, path: str = "native/x.cc"):
+    return {e.symbol: e
+            for e in parse_c_exports(textwrap.dedent(snippet), path)}
+
+
+def bindings(snippet: str, path: str = BINDING_MODULES[0]):
+    return parse_ctypes_bindings(Source(path, textwrap.dedent(snippet)))
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ------------------------------------------------------- C declaration parser
+
+def test_parse_extern_decl_oneoff():
+    exps = c_exports("""
+        extern "C" void coreth_keccak256(const uint8_t*, uint64_t, uint8_t*);
+    """)
+    e = exps["coreth_keccak256"]
+    assert e.ret == VOID
+    assert e.params == [PTR_BYTES, U64, PTR_BYTES]
+    assert not e.is_definition
+
+
+def test_parse_extern_decl_multiline_and_pointer_return():
+    exps = c_exports("""
+        extern "C" int coreth_ecrecover(const uint8_t*, const uint8_t*,
+                                        const uint8_t*, int, uint8_t*);
+        extern "C" void* coreth_trie_new();
+    """)
+    assert exps["coreth_ecrecover"].ret == I32
+    assert exps["coreth_ecrecover"].params == \
+        [PTR_BYTES, PTR_BYTES, PTR_BYTES, I32, PTR_BYTES]
+    assert exps["coreth_trie_new"].ret == PTR_VOID
+    assert exps["coreth_trie_new"].params == []
+
+
+def test_parse_extern_block_definitions():
+    exps = c_exports("""
+        extern "C" {
+        void* coreth_new() { return 0; }
+        uint64_t coreth_export(void* h, uint8_t* out, uint64_t cap) {
+          if (!out) return 0;
+          return cap;
+        }
+        int coreth_get(void* h, const uint8_t* key32, uint32_t* out_len) {
+          return 1;
+        }
+        }  // extern "C"
+    """)
+    assert set(exps) == {"coreth_new", "coreth_export", "coreth_get"}
+    assert exps["coreth_new"].ret == PTR_VOID
+    assert exps["coreth_export"].ret == U64
+    assert exps["coreth_export"].params == [PTR_VOID, PTR_BYTES, U64]
+    assert exps["coreth_get"].params == [PTR_VOID, PTR_BYTES, ("ptr", U32)]
+    assert all(e.is_definition for e in exps.values())
+
+
+def test_parse_skips_static_helpers_and_body_locals():
+    """static fns have internal linkage; constructor-style locals
+    inside bodies (`std::string addr(p, 20);`) are not signatures."""
+    exps = c_exports("""
+        extern "C" {
+        static void key_to_nibs(const uint8_t* key32, uint8_t nib[64]) {
+          nib[0] = key32[0] >> 4;
+        }
+        void coreth_use(void* h, const uint8_t* p) {
+          std::string addr((const char*)p, 20);
+          uint8_t nib[64];
+          key_to_nibs(p, nib);
+        }
+        }  // extern "C"
+    """)
+    assert set(exps) == {"coreth_use"}
+
+
+def test_parse_array_params_decay_and_named_params():
+    exps = c_exports("""
+        extern "C" {
+        void coreth_hash(void* h, uint8_t out32[32]) { }
+        void coreth_fold(void* h, const uint8_t* keys32,
+                         const uint64_t* nonces, uint64_t n,
+                         double* phases) { }
+        }  // extern "C"
+    """)
+    assert exps["coreth_hash"].params == [PTR_VOID, PTR_BYTES]
+    assert exps["coreth_fold"].params == \
+        [PTR_VOID, PTR_BYTES, ("ptr", U64), U64, ("ptr", F64)]
+
+
+def test_parse_funcptr_typedef_params():
+    exps = c_exports("""
+        typedef int (*FetchSlotCb)(const uint8_t* addr20,
+                                   const uint8_t* key32, uint8_t* out);
+        extern "C" {
+        void* coreth_sess_new(uint64_t chain_id, FetchSlotCb fetch,
+                              const uint8_t* optable256, int flags) {
+          return 0;
+        }
+        }  // extern "C"
+    """)
+    assert exps["coreth_sess_new"].params == [U64, FUNCPTR, PTR_BYTES, I32]
+
+
+def test_parse_definition_wins_over_declaration():
+    exps = c_exports("""
+        extern "C" void coreth_thing(const uint8_t*, uint64_t);
+        extern "C" {
+        void coreth_thing(const uint8_t* data, uint64_t len) { }
+        }  // extern "C"
+    """)
+    assert len(exps) == 1 and exps["coreth_thing"].is_definition
+
+
+def test_parse_comments_do_not_confuse():
+    exps = c_exports("""
+        // extern "C" void coreth_commented_out(int);
+        /* extern "C" { void coreth_also_commented(int) {} } */
+        extern "C" {
+        // returns 1 + copies value when present (cap bytes), else 0
+        int coreth_real(void* h, uint32_t cap) { return 1; }
+        }  // extern "C"
+    """)
+    assert set(exps) == {"coreth_real"}
+
+
+def test_normalize_c_type_table():
+    assert normalize_c_type("const uint8_t*") == PTR_BYTES
+    assert normalize_c_type("char*") == PTR_BYTES
+    assert normalize_c_type("size_t") == U64
+    assert normalize_c_type("int64_t") == I64
+    assert normalize_c_type("void") == VOID
+    assert normalize_c_type("void* hp") == PTR_VOID
+    assert normalize_c_type("const uint32_t* val_lens") == ("ptr", U32)
+    assert normalize_c_type("SomeStruct*")[0] == "unknown"
+    assert type_name(("ptr", U64)) == "uint64*"
+
+
+# ------------------------------------------------------------- ctypes parser
+
+def test_parse_ctypes_bindings_basic():
+    bs = bindings("""
+        import ctypes
+        def load():
+            lib = ctypes.CDLL("x.so")
+            lib.coreth_keccak256.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+            lib.coreth_keccak256.restype = None
+            lib.coreth_trie_new.restype = ctypes.c_void_p
+            lib.coreth_trie_new.argtypes = []
+            return lib
+    """)
+    by = {b.symbol: b for b in bs}
+    assert by["coreth_keccak256"].argtypes == [PTR_BYTES, U64, PTR_BYTES]
+    assert by["coreth_keccak256"].restype == VOID
+    assert by["coreth_trie_new"].argtypes == []
+    assert by["coreth_trie_new"].restype == PTR_VOID
+
+
+def test_parse_ctypes_pointer_cfunctype_and_replication():
+    bs = bindings("""
+        import ctypes
+        _CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(ctypes.c_uint8))
+        def load(lib):
+            lib.coreth_new.argtypes = [ctypes.c_uint64, _CB,
+                                       ctypes.c_char_p]
+            lib.coreth_new.restype = ctypes.c_void_p
+            lib.coreth_test_fe_mul.argtypes = [ctypes.c_char_p] * 3
+            lib.coreth_replay.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_double)]
+    """)
+    by = {b.symbol: b for b in bs}
+    assert by["coreth_new"].argtypes == [U64, FUNCPTR, PTR_BYTES]
+    assert by["coreth_test_fe_mul"].argtypes == [PTR_BYTES] * 3
+    assert by["coreth_replay"].argtypes == \
+        [PTR_BYTES, ("ptr", U64), ("ptr", F64)]
+
+
+def test_parse_ctypes_ignores_non_prefixed_and_other_attrs():
+    bs = bindings("""
+        import ctypes
+        def load(lib):
+            lib.some_other_symbol.argtypes = [ctypes.c_int]
+            lib._trie_decls = True
+            lib.coreth_x.argtypes = [ctypes.c_int]
+    """)
+    assert [b.symbol for b in bs] == ["coreth_x"]
+
+
+# --------------------------------------------------------- ABI cross-checks
+
+_GOOD_C = """
+    extern "C" {
+    void coreth_fill(void* h, const uint8_t* buf, uint64_t n) { }
+    void* coreth_open(uint64_t flags) { return 0; }
+    int coreth_poll(void* h) { return 0; }
+    }  // extern "C"
+"""
+
+_GOOD_PY = """
+    import ctypes
+    def load(lib):
+        lib.coreth_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.coreth_fill.restype = None
+        lib.coreth_open.argtypes = [ctypes.c_uint64]
+        lib.coreth_open.restype = ctypes.c_void_p
+        lib.coreth_poll.argtypes = [ctypes.c_void_p]
+"""
+
+
+def test_clean_boundary_no_findings():
+    fs = cross_check(c_exports(_GOOD_C), bindings(_GOOD_PY),
+                     check_unbound=True)
+    assert fs == []
+
+
+def test_abi001_bound_but_not_exported():
+    py = _GOOD_PY + """
+        def more(lib):
+            lib.coreth_ghost.argtypes = [ctypes.c_void_p]
+            lib.coreth_ghost.restype = None
+    """
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI001"]
+    assert "coreth_ghost" in fs[0].message
+    assert fs[0].path == BINDING_MODULES[0]
+
+
+def test_abi001_exported_but_unbound_full_scope_only():
+    c = _GOOD_C + """
+        extern "C" {
+        void coreth_orphan(void* h) { }
+        }  // extern "C"
+    """
+    # partial scope: the converse direction must stay silent
+    assert cross_check(c_exports(c), bindings(_GOOD_PY)) == []
+    fs = cross_check(c_exports(c), bindings(_GOOD_PY), check_unbound=True)
+    assert codes(fs) == ["ABI001"]
+    assert fs[0].path == "native/x.cc" and "coreth_orphan" in fs[0].message
+
+
+def test_abi002_arity_mismatch():
+    py = _GOOD_PY.replace(
+        "[ctypes.c_void_p, ctypes.c_char_p,\n                                    ctypes.c_uint64]",
+        "[ctypes.c_void_p, ctypes.c_char_p]")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI002"]
+    assert "arity 2 != 3" in fs[0].message
+
+
+def test_abi003_width_mismatch_u32_vs_u64():
+    py = _GOOD_PY.replace("lib.coreth_open.argtypes = [ctypes.c_uint64]",
+                          "lib.coreth_open.argtypes = [ctypes.c_uint32]")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+    assert "argtypes[0]" in fs[0].message and "uint32" in fs[0].message
+
+
+def test_abi003_pointerness_mismatch():
+    py = _GOOD_PY.replace(
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p,",
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p, ctypes.c_uint64,")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+
+
+def test_abi003_wrong_restype():
+    py = _GOOD_PY.replace("lib.coreth_open.restype = ctypes.c_void_p",
+                          "lib.coreth_open.restype = ctypes.c_int")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+    assert fs[0].detail == "coreth_open:ret"
+
+
+def test_abi004_missing_restype_on_pointer_return():
+    py = _GOOD_PY.replace(
+        "        lib.coreth_open.restype = ctypes.c_void_p\n", "")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI004"]
+    assert "TRUNCATES" in fs[0].message
+
+
+def test_abi004_missing_restype_on_void_return():
+    py = _GOOD_PY.replace("        lib.coreth_fill.restype = None\n", "")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI004"]
+    assert "restype = None" in fs[0].message
+
+
+def test_abi004_int_return_default_is_fine():
+    """coreth_poll returns int and never sets restype: the ctypes
+    default c_int matches — no finding (the whole point of ABI004
+    being restricted to NON-int returns)."""
+    fs = cross_check(c_exports(_GOOD_C), bindings(_GOOD_PY),
+                     check_unbound=True)
+    assert fs == []
+
+
+def test_abi003_pointer_to_char_p_is_not_a_byte_buffer():
+    """POINTER(c_char_p) is a char** — it must NOT satisfy a C
+    uint8_t* parameter (fail-closed, review-surfaced gap)."""
+    py = _GOOD_PY.replace(
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p,",
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p,"
+        " ctypes.POINTER(ctypes.c_char_p),")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+
+
+def test_abi003_wchar_p_is_not_a_byte_buffer():
+    """c_wchar_p marshals UTF-32 wide strings — never a byte buffer
+    (fail-closed, review-surfaced gap); POINTER(c_char) still is."""
+    py = _GOOD_PY.replace(
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p,",
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p, ctypes.c_wchar_p,")
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+    ok = _GOOD_PY.replace(
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p,",
+        "lib.coreth_fill.argtypes = [ctypes.c_void_p,"
+        " ctypes.POINTER(ctypes.c_char),")
+    assert cross_check(c_exports(_GOOD_C), bindings(ok)) == []
+
+
+def test_unknown_ctypes_name_is_flagged_not_passed():
+    py = """
+        import ctypes
+        def load(lib):
+            lib.coreth_open.argtypes = [MYSTERY_TYPE]
+            lib.coreth_open.restype = ctypes.c_void_p
+    """
+    fs = cross_check(c_exports(_GOOD_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+
+
+# ------------------------------------------------------------ tree-level gate
+
+def test_real_tree_exports_parse():
+    """The real native/*.cc parse into a plausible export table: every
+    symbol coreth_-prefixed, the hostexec session and trie fold ABIs
+    present, callbacks recognized as funcptrs."""
+    exps = collect_c_exports()
+    assert len(exps) >= 30
+    assert all(s.startswith("coreth_") for s in exps)
+    sess = exps["coreth_hostexec_new"]
+    assert sess.ret == PTR_VOID
+    assert FUNCPTR in sess.params
+    fold = exps["coreth_trie_fold_storage"]
+    assert fold.params == [PTR_VOID, PTR_BYTES, PTR_BYTES, U64, PTR_BYTES]
+
+
+def test_real_tree_is_abi_clean():
+    """Zero ABI findings over the real binding modules + native/*.cc
+    — the acceptance bar: every mismatch fixed, nothing baselined."""
+    import os
+    from tools.lint.core import collect_sources
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sources = collect_sources([os.path.join(repo, "coreth_tpu")])
+    fs = check_nativeabi(sources)
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_partial_scope_skips_unbound_direction():
+    """Scanning one binding module must not flag exports bound in the
+    others (the full-scope gate)."""
+    import os
+    from tools.lint.core import collect_sources
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sources = collect_sources(
+        [os.path.join(repo, "coreth_tpu", "mpt", "native_trie.py")])
+    fs = check_nativeabi(sources)
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_synthetic_binding_flows_through_run_all(tmp_path):
+    """A binding file in a synthetic tree cross-checks against the
+    REAL native/*.cc through the full run_all pipeline (the tier-1
+    tree-gate wiring)."""
+    from tools.lint import run_all
+    from tools.lint.layers import load_config
+    bad = tmp_path / "coreth_tpu" / "mpt" / "native_trie.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import ctypes\n"
+        "def load(lib):\n"
+        "    lib.coreth_trie_hash.argtypes = [ctypes.c_void_p]\n")
+    new, _base, _stale = run_all([str(tmp_path / "coreth_tpu")],
+                                 load_config(), frozenset())
+    abi = [f for f in new if f.code.startswith("ABI")]
+    assert codes(abi) == ["ABI002", "ABI004"]  # arity 1 != 2, void ret
+
+
+def test_noqa_suppresses_abi_finding(tmp_path):
+    from tools.lint import run_all
+    from tools.lint.layers import load_config
+    bad = tmp_path / "coreth_tpu" / "mpt" / "native_trie.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import ctypes\n"
+        "def load(lib):\n"
+        "    lib.coreth_trie_free.argtypes = []"
+        "  # noqa: ABI002, ABI004 — fixture: deliberately partial binding\n")
+    new, _base, _stale = run_all([str(tmp_path / "coreth_tpu")],
+                                 load_config(), frozenset())
+    assert [f for f in new if f.code.startswith("ABI")] == []

@@ -381,6 +381,7 @@ def test_native_receipt_root_parity():
     Python StackTrie/bloom path across the rlp-key length boundary
     (127/129) and mixed typed/legacy receipts."""
     from coreth_tpu.crypto import native
+    from coreth_tpu.mpt import StackTrie
     from coreth_tpu.types import Receipt, Log
     if native.load() is None:
         pytest.skip("native lib unavailable")
@@ -408,7 +409,7 @@ def test_native_receipt_root_parity():
             types.append(tx_type)
         root, bloom = native.receipt_root(
             cums, bytes(types), bytes(haslog), blob)
-        assert root == derive_sha(receipts)
+        assert root == derive_sha(receipts, StackTrie())
         assert bloom == create_bloom(receipts)
 
 

@@ -6,6 +6,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from coreth_tpu import rlp
+from coreth_tpu.mpt import StackTrie
 from coreth_tpu.types import (
     AccessListTx, DynamicFeeTx, LegacyTx, Transaction, LatestSigner, sign_tx,
     Header, Block, Receipt, Log, derive_sha, logs_bloom, StateAccount,
@@ -97,7 +98,7 @@ def test_block_roundtrip_with_extdata():
 
 
 def test_empty_roots():
-    assert derive_sha([]) == EMPTY_ROOT_HASH
+    assert derive_sha([], StackTrie()) == EMPTY_ROOT_HASH
     assert EMPTY_UNCLE_HASH.hex() == (
         "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347")
     from coreth_tpu.crypto import keccak256
@@ -110,7 +111,7 @@ def test_receipt_bloom_and_derive():
     r2 = Receipt(tx_type=2, status=0, cumulative_gas_used=42000, logs=[])
     bloom = logs_bloom([log])
     assert sum(bin(b).count("1") for b in bloom) <= 6  # 3 bits per value x2
-    root = derive_sha([r1, r2])
+    root = derive_sha([r1, r2], StackTrie())
     assert len(root) == 32 and root != EMPTY_ROOT_HASH
     # typed receipt consensus encoding is prefixed with the tx type
     assert r2.encode_consensus()[0] == 2

@@ -1,6 +1,6 @@
 """corethlint — AST-based architecture lint for the coreth_tpu tree.
 
-Four passes, all pure-AST (no imports of the linted code, safe to run
+Five passes, all static (no imports of the linted code, safe to run
 anywhere, no JAX/device access):
 
 - **layers** (LAY001/LAY002): the package DAG declared in
@@ -19,6 +19,11 @@ anywhere, no JAX/device access):
 - **bare excepts** (EXC001/EXC002): ``except Exception`` and broader
   require a same-line ``# noqa: BLE001 — <reason>`` rationale (the
   idiom already used across the tree).
+- **native ABI conformance** (ABI001-ABI004): every ctypes binding
+  (``argtypes``/``restype``) is cross-checked against the ``extern
+  "C"`` declarations parsed out of ``native/*.cc`` — unbound/unknown
+  symbols, arity mismatches, width/pointer-ness mismatches, and
+  missing ``restype`` (the default-``c_int`` truncation bug class).
 
 Findings can be suppressed inline with ``# noqa: <CODE> — <reason>``
 (reason mandatory) or via ``tools/lint/baseline.txt`` for accepted
@@ -30,11 +35,12 @@ from tools.lint.layers import check_layers, load_config  # noqa: F401
 from tools.lint.determinism import check_determinism  # noqa: F401
 from tools.lint.jitpurity import check_jit_purity  # noqa: F401
 from tools.lint.excepts import check_excepts  # noqa: F401
+from tools.lint.nativeabi import check_nativeabi  # noqa: F401
 from tools.lint.baseline import load_baseline, split_findings  # noqa: F401
 
 
 def run_all(paths, config, baseline=frozenset()):
-    """Run all four passes; returns (new, baselined, stale_keys)."""
+    """Run all five passes; returns (new, baselined, stale_keys)."""
     from tools.lint.core import _display_path
     sources = collect_sources(paths)
     findings = []
@@ -42,6 +48,7 @@ def run_all(paths, config, baseline=frozenset()):
     findings += check_determinism(sources, config)
     findings += check_jit_purity(sources)
     findings += check_excepts(sources)
+    findings += check_nativeabi(sources)
     by_path = {s.path: s for s in sources}
     findings = [f for f in findings if not is_suppressed(f, by_path)]
     return split_findings(findings, baseline,

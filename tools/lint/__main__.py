@@ -22,7 +22,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.lint",
         description="AST lint: layer boundaries, determinism, jit "
-                    "purity, bare excepts.")
+                    "purity, bare excepts, native ABI conformance.")
     ap.add_argument("paths", nargs="*", default=["coreth_tpu"],
                     help="files/directories to lint (default: coreth_tpu)")
     ap.add_argument("--layers", default=DEFAULT_TOML,

@@ -1,0 +1,581 @@
+"""nativeabi pass — ctypes bindings must conform to the C ABI they name.
+
+PRs 3-4 grew ``native/`` into the production host executor and trie
+committer, reached through dozens of hand-written ``extern "C"`` /
+``argtypes`` / ``restype`` sites.  That boundary fails silently: an
+arity or width mismatch does not raise, it corrupts memory (or, for a
+missing ``restype`` on a pointer-returning symbol, truncates the
+handle to ctypes' default ``c_int`` — the classic 64-bit bug).  The
+Python-only passes cannot see any of this, so this pass parses BOTH
+sides and cross-checks them:
+
+- the C side: every ``extern "C"`` declaration/definition in
+  ``native/*.cc`` (symbol, parameter types, return type — one-off
+  ``extern "C" ret name(...);`` declarations and functions defined
+  inside ``extern "C" { ... }`` blocks; ``static`` helpers inside a
+  block have internal linkage and are not ABI surface);
+- the Python side: every ``lib.<symbol>.argtypes = [...]`` /
+  ``lib.<symbol>.restype = ...`` assignment for ``coreth_``-prefixed
+  symbols in the scanned sources (the binding modules:
+  ``crypto/native.py``, ``evm/hostexec/backend.py``,
+  ``mpt/native_trie.py``).
+
+Checks:
+
+- ABI001  symbol bound in Python but not exported by any native
+          source — the call would AttributeError at best, bind a
+          same-named stale symbol at worst.  The converse (exported
+          but never bound) fires only on a full-tree run that sees
+          every binding module, anchored at the C definition.
+- ABI002  argtypes arity differs from the C parameter count — ctypes
+          packs the wrong number of machine words onto the call.
+- ABI003  per-position width / signedness / pointer-ness mismatch
+          (``c_uint64``↔``uint64_t``, ``c_size_t``↔``size_t``,
+          ``POINTER(c_uint64)``↔``uint64_t*``, ``c_char_p``↔
+          ``uint8_t*``, CFUNCTYPE↔function-pointer typedef), and a
+          *set-but-wrong* ``restype``.
+- ABI004  ``argtypes`` declared but no ``restype`` for a symbol whose
+          C return type is not plain ``int`` — ctypes defaults to
+          ``c_int`` and truncates ``void*``/``uint64_t`` returns (a
+          ``void`` return gets an explicit ``restype = None``).
+
+Both parsers are deliberately shallow (regex over comment-stripped C,
+AST over Python) — the native ABI is C89-shaped by construction, and
+the parsers are fixture-tested so any new declaration form that
+arrives gets a test alongside it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.lint.core import Finding, Source
+
+# The modules that own the ctypes boundary (layers.toml [native]).
+# The exported-but-unbound direction of ABI001 only runs when ALL of
+# them are in scope — a partial run cannot prove a symbol unbound.
+BINDING_MODULES = (
+    "coreth_tpu/crypto/native.py",
+    "coreth_tpu/evm/hostexec/backend.py",
+    "coreth_tpu/mpt/native_trie.py",
+)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+
+# ---------------------------------------------------------------------------
+# normalized ABI types
+#
+# Tuples compare structurally:
+#   ("void",)                -- no value (restype None / C void)
+#   ("int", width, signed)   -- integer scalar
+#   ("float", width)         -- floating scalar
+#   ("ptr", "bytes")         -- byte buffer (uint8_t*/char* <-> c_char_p)
+#   ("ptr", "void")          -- opaque handle (void* <-> c_void_p)
+#   ("ptr", <scalar>)        -- typed pointer (uint64_t* <-> POINTER(c_uint64))
+#   ("funcptr",)             -- callback (typedef'd fn ptr <-> CFUNCTYPE)
+#   ("unknown", text)        -- unparseable; always a finding, never a pass
+
+VOID = ("void",)
+PTR_BYTES = ("ptr", "bytes")
+PTR_VOID = ("ptr", "void")
+FUNCPTR = ("funcptr",)
+
+_C_SCALARS: Dict[str, Tuple] = {
+    "int": ("int", 32, True),
+    "int8_t": ("int", 8, True),
+    "int16_t": ("int", 16, True),
+    "int32_t": ("int", 32, True),
+    "int64_t": ("int", 64, True),
+    "uint8_t": ("int", 8, False),
+    "uint16_t": ("int", 16, False),
+    "uint32_t": ("int", 32, False),
+    "uint64_t": ("int", 64, False),
+    # LP64 (the only ABI the native runtime builds for)
+    "size_t": ("int", 64, False),
+    "ssize_t": ("int", 64, True),
+    "char": ("int", 8, True),
+    "bool": ("int", 8, False),
+    "float": ("float", 32),
+    "double": ("float", 64),
+}
+
+_CTYPES_SCALARS: Dict[str, Tuple] = {
+    "c_int": ("int", 32, True),
+    "c_uint": ("int", 32, False),
+    "c_int8": ("int", 8, True),
+    "c_int16": ("int", 16, True),
+    "c_int32": ("int", 32, True),
+    "c_int64": ("int", 64, True),
+    "c_uint8": ("int", 8, False),
+    "c_uint16": ("int", 16, False),
+    "c_uint32": ("int", 32, False),
+    "c_uint64": ("int", 64, False),
+    "c_size_t": ("int", 64, False),
+    "c_ssize_t": ("int", 64, True),
+    "c_byte": ("int", 8, True),
+    "c_ubyte": ("int", 8, False),
+    "c_char": ("int", 8, True),
+    "c_bool": ("int", 8, False),
+    "c_float": ("float", 32),
+    "c_double": ("float", 64),
+}
+# platform-width ctypes whose size is NOT fixed by the name; binding
+# the 64-bit-only native runtime through them is itself a smell
+_CTYPES_PLATFORM = {"c_long", "c_ulong", "c_longlong", "c_ulonglong"}
+
+
+def type_name(t: Tuple) -> str:
+    """Human rendering of a normalized type for diagnostics."""
+    if t == VOID:
+        return "void"
+    if t == PTR_BYTES:
+        return "byte-ptr"
+    if t == PTR_VOID:
+        return "void*"
+    if t == FUNCPTR:
+        return "funcptr"
+    if t[0] == "int":
+        return f"{'' if t[2] else 'u'}int{t[1]}"
+    if t[0] == "float":
+        return f"float{t[1]}"
+    if t[0] == "ptr":
+        return type_name(t[1]) + "*"
+    return f"?{t[1]}?"
+
+
+# ---------------------------------------------------------------------------
+# C side
+
+
+@dataclass
+class CExport:
+    symbol: str
+    params: List[Tuple]
+    ret: Tuple
+    path: str
+    line: int
+    is_definition: bool
+    param_texts: List[str] = field(default_factory=list)
+
+
+_LINE_COMMENT_RE = re.compile(r"//[^\n]*")
+_BLOCK_COMMENT_RE = re.compile(r"/\*.*?\*/", re.S)
+_TYPEDEF_FNPTR_RE = re.compile(
+    r"typedef\s+[\w\s\*]+?\(\s*\*\s*(\w+)\s*\)\s*\(")
+_EXTERN_DECL_RE = re.compile(
+    r'extern\s*"C"\s*(?!\s*\{)(?P<ret>[A-Za-z_][\w\s]*?[\w\*])\s*'
+    r"(?P<name>\w+)\s*\(")
+_BLOCK_FN_RE = re.compile(
+    r"(?P<prefix>(?:\b(?:static|inline|constexpr)\s+)*)"
+    r"(?P<ret>[A-Za-z_]\w*(?:\s*\*+)?)\s+(?P<ptr>\*\s*)?"
+    r"(?P<name>\w+)\s*\(")
+_C_KEYWORDS = {"return", "if", "while", "for", "switch", "sizeof",
+               "else", "case", "new", "delete", "do", "goto"}
+
+
+def _strip_c_comments(text: str) -> str:
+    """Blank out comments, preserving newlines so line numbers hold."""
+    def _blank(m: re.Match) -> str:
+        return re.sub(r"[^\n]", " ", m.group(0))
+    return _LINE_COMMENT_RE.sub(_blank, _BLOCK_COMMENT_RE.sub(_blank, text))
+
+
+def _match_paren(text: str, open_idx: int) -> int:
+    """Index just past the ')' matching the '(' at open_idx, or -1."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return -1
+
+
+def _extern_block_spans(text: str) -> List[Tuple[int, int]]:
+    spans = []
+    for m in re.finditer(r'extern\s*"C"\s*\{', text):
+        depth = 0
+        for i in range(m.end() - 1, len(text)):
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    spans.append((m.end(), i))
+                    break
+    return spans
+
+
+def normalize_c_type(text: str, fnptr_typedefs=frozenset()) -> Tuple:
+    """One C parameter or return type -> normalized ABI type."""
+    t = text.strip()
+    # arrays decay: `uint8_t out32[32]` / `uint8_t nib[]` are pointers
+    arr = re.search(r"(\w+)?\s*\[[^\]]*\]\s*$", t)
+    if arr:
+        t = t[:arr.start()].strip() + "*"
+    t = re.sub(r"\bconst\b", " ", t)
+    t = re.sub(r"\s*\*\s*", "* ", t).strip()
+    tokens = t.split()
+    if not tokens:
+        return ("unknown", text.strip())
+    # drop a trailing parameter name: `uint8_t* keys32` -> [uint8_t*]
+    if len(tokens) >= 2 and not tokens[-1].endswith("*") \
+            and (tokens[-2].endswith("*") or tokens[-2] in _C_SCALARS
+                 or tokens[-2] == "void" or tokens[-2] in fnptr_typedefs
+                 or tokens[-2] in ("unsigned", "signed")):
+        tokens = tokens[:-1]
+    base = " ".join(tokens)
+    stars = 0
+    while base.endswith("*"):
+        stars += 1
+        base = base[:-1].rstrip()
+    if base in ("unsigned", "unsigned int"):
+        base = "uint32_t"
+    elif base in ("signed", "signed int"):
+        base = "int"
+    elif base in ("unsigned char", "signed char"):
+        base = "char"
+    if stars == 0:
+        if base == "void":
+            return VOID
+        if base in fnptr_typedefs:
+            return FUNCPTR
+        if base in _C_SCALARS:
+            return _C_SCALARS[base]
+        return ("unknown", text.strip())
+    if base == "void":
+        return PTR_VOID if stars == 1 else ("unknown", text.strip())
+    inner = _C_SCALARS.get(base)
+    if inner is None or stars > 1:
+        return ("unknown", text.strip())
+    if inner[0] == "int" and inner[1] == 8:
+        return PTR_BYTES
+    return ("ptr", inner)
+
+
+def _split_params(param_text: str) -> List[str]:
+    text = param_text.strip()
+    if not text or text == "void":
+        return []
+    parts, depth, start = [], 0, 0
+    for i, c in enumerate(text):
+        if c in "(<[":
+            depth += 1
+        elif c in ")>]":
+            depth -= 1
+        elif c == "," and depth == 0:
+            parts.append(text[start:i])
+            start = i + 1
+    parts.append(text[start:])
+    return parts
+
+
+def parse_c_exports(text: str, path: str,
+                    fnptr_typedefs=None) -> List[CExport]:
+    """Every extern-"C"-linkage function (declaration or definition)
+    in one C++ source.  ``fnptr_typedefs`` may carry callback typedef
+    names collected across files; the file's own typedefs are always
+    included."""
+    clean = _strip_c_comments(text)
+    typedefs = set(fnptr_typedefs or ())
+    typedefs.update(m.group(1)
+                    for m in _TYPEDEF_FNPTR_RE.finditer(clean))
+    exports: List[CExport] = []
+    tdset = frozenset(typedefs)
+
+    def _add(ret_text: str, name: str, open_idx: int) -> None:
+        end = _match_paren(clean, open_idx)
+        if end < 0:
+            return
+        after = clean[end:end + 64].lstrip()
+        if not after or after[0] not in "{;":
+            return  # a call site, not a signature
+        raw_params = _split_params(clean[open_idx + 1:end - 1])
+        exports.append(CExport(
+            symbol=name,
+            params=[normalize_c_type(p, tdset) for p in raw_params],
+            ret=normalize_c_type(ret_text, tdset),
+            path=path, line=clean.count("\n", 0, open_idx) + 1,
+            is_definition=after[0] == "{",
+            param_texts=[" ".join(p.split()) for p in raw_params]))
+
+    for m in _EXTERN_DECL_RE.finditer(clean):
+        _add(m.group("ret"), m.group("name"), m.end() - 1)
+    for lo, hi in _extern_block_spans(clean):
+        block = clean[lo:hi]
+        for m in _BLOCK_FN_RE.finditer(block):
+            if "static" in m.group("prefix"):
+                continue
+            # only block-level signatures: anything at brace depth > 0
+            # is inside a function body (e.g. a C++ constructor-call
+            # local like `std::string addr(p, 20);`)
+            if block.count("{", 0, m.start()) \
+                    != block.count("}", 0, m.start()):
+                continue
+            ret = m.group("ret")
+            if ret in _C_KEYWORDS or m.group("name") in _C_KEYWORDS:
+                continue
+            if m.group("ptr"):
+                ret += "*"
+            _add(ret, m.group("name"), lo + m.end() - 1)
+    return exports
+
+
+def collect_c_exports(
+        native_dir: str = DEFAULT_NATIVE_DIR) -> Dict[str, CExport]:
+    """All exports across native/*.cc, deduped by symbol (a definition
+    wins over a forward declaration)."""
+    try:
+        files = sorted(f for f in os.listdir(native_dir)
+                       if f.endswith(".cc"))
+    except OSError:
+        return {}
+    texts = {}
+    for fn in files:
+        with open(os.path.join(native_dir, fn), encoding="utf-8") as fh:
+            texts[fn] = fh.read()
+    # callback typedefs are shared across translation units
+    typedefs = set()
+    for text in texts.values():
+        typedefs.update(m.group(1) for m in
+                        _TYPEDEF_FNPTR_RE.finditer(_strip_c_comments(text)))
+    out: Dict[str, CExport] = {}
+    for fn, text in texts.items():
+        rel = os.path.relpath(os.path.join(native_dir, fn),
+                              _REPO_ROOT).replace(os.sep, "/")
+        for exp in parse_c_exports(text, rel, frozenset(typedefs)):
+            cur = out.get(exp.symbol)
+            if cur is None or (exp.is_definition and not cur.is_definition):
+                out[exp.symbol] = exp
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Python (ctypes) side
+
+
+@dataclass
+class CtypesBinding:
+    symbol: str
+    path: str
+    argtypes: Optional[List[Tuple]] = None
+    argtypes_line: int = 0
+    restype: Optional[Tuple] = None  # None = never assigned
+    restype_line: int = 0
+
+
+def _funcptr_names(tree: ast.AST) -> set:
+    """Module-level names bound to a ctypes.CFUNCTYPE(...) factory."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            leaf = node.value.func
+            leaf = leaf.attr if isinstance(leaf, ast.Attribute) else \
+                getattr(leaf, "id", "")
+            if leaf in ("CFUNCTYPE", "WINFUNCTYPE", "PYFUNCTYPE"):
+                names.add(node.targets[0].id)
+    return names
+
+
+def _normalize_py_type(node: ast.AST, funcptrs: frozenset) -> Tuple:
+    if isinstance(node, ast.Constant) and node.value is None:
+        return VOID
+    leaf = None
+    if isinstance(node, ast.Attribute):
+        leaf = node.attr
+    elif isinstance(node, ast.Name):
+        leaf = node.id
+    if leaf is not None:
+        if leaf == "c_char_p":
+            return PTR_BYTES
+        if leaf == "c_wchar_p":
+            # wchar_t* marshals str as UTF-32 on Linux — never a match
+            # for the uint8_t*/char* byte buffers this ABI uses
+            return ("unknown", "c_wchar_p (wide-string; use c_char_p)")
+        if leaf == "c_void_p":
+            return PTR_VOID
+        if leaf in _CTYPES_SCALARS:
+            return _CTYPES_SCALARS[leaf]
+        if leaf in _CTYPES_PLATFORM:
+            return ("unknown", f"{leaf} (platform-width; use a fixed-"
+                               f"width c_int64/c_uint64)")
+        if leaf in funcptrs:
+            return FUNCPTR
+        return ("unknown", leaf)
+    if isinstance(node, ast.Call):
+        fleaf = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else getattr(node.func, "id", "")
+        if fleaf == "POINTER" and node.args:
+            inner = _normalize_py_type(node.args[0], funcptrs)
+            if inner[0] == "int" and inner[1] == 8:
+                return PTR_BYTES  # POINTER(c_uint8/c_ubyte/c_byte/c_char)
+            if inner[0] in ("int", "float"):
+                return ("ptr", inner)
+            # POINTER(c_char_p) is a char** — NOT a byte buffer; fail
+            # closed so it can never satisfy a T* parameter
+            return ("unknown", ast.unparse(node))
+        if fleaf in ("CFUNCTYPE", "WINFUNCTYPE", "PYFUNCTYPE"):
+            return FUNCPTR
+    return ("unknown", ast.unparse(node))
+
+
+def _argtype_elements(value: ast.AST) -> Optional[List[ast.AST]]:
+    """The element nodes of an argtypes RHS: a list/tuple literal,
+    ``[...] * k`` replication, or list concatenation."""
+    if isinstance(value, (ast.List, ast.Tuple)):
+        return list(value.elts)
+    if isinstance(value, ast.BinOp) and isinstance(value.op, ast.Mult):
+        lst, k = value.left, value.right
+        if isinstance(k, (ast.List, ast.Tuple)):
+            lst, k = k, value.left
+        elems = _argtype_elements(lst)
+        if elems is not None and isinstance(k, ast.Constant) \
+                and isinstance(k.value, int):
+            return elems * k.value
+        return None
+    if isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add):
+        left = _argtype_elements(value.left)
+        right = _argtype_elements(value.right)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def parse_ctypes_bindings(source: Source,
+                          prefix: str = "coreth_") -> List[CtypesBinding]:
+    """All ``<expr>.<symbol>.argtypes/restype`` assignments for
+    symbols carrying the native prefix, merged per symbol."""
+    funcptrs = frozenset(_funcptr_names(source.tree))
+    by_symbol: Dict[str, CtypesBinding] = {}
+    for node in ast.walk(source.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and tgt.attr in ("argtypes", "restype")
+                and isinstance(tgt.value, ast.Attribute)):
+            continue
+        symbol = tgt.value.attr
+        if not symbol.startswith(prefix):
+            continue
+        b = by_symbol.setdefault(symbol, CtypesBinding(
+            symbol=symbol, path=source.path))
+        if tgt.attr == "argtypes":
+            elems = _argtype_elements(node.value)
+            if elems is None:
+                b.argtypes = [("unknown", ast.unparse(node.value))]
+            else:
+                b.argtypes = [_normalize_py_type(e, funcptrs)
+                              for e in elems]
+            b.argtypes_line = node.lineno
+        else:
+            b.restype = _normalize_py_type(node.value, funcptrs)
+            b.restype_line = node.lineno
+    return [by_symbol[s] for s in sorted(by_symbol)]
+
+
+# ---------------------------------------------------------------------------
+# cross-check
+
+_INT_RET = _C_SCALARS["int"]
+
+
+def _compatible(c_type: Tuple, py_type: Tuple) -> bool:
+    if c_type[0] == "unknown" or py_type[0] == "unknown":
+        return False
+    return c_type == py_type
+
+
+def cross_check(exports: Dict[str, CExport],
+                bindings: Sequence[CtypesBinding],
+                check_unbound: bool = False) -> List[Finding]:
+    """ABI001-ABI004 over one export table and one binding set."""
+    findings: List[Finding] = []
+    bound_symbols = set()
+    for b in bindings:
+        bound_symbols.add(b.symbol)
+        line = b.argtypes_line or b.restype_line
+        exp = exports.get(b.symbol)
+        if exp is None:
+            findings.append(Finding(
+                b.path, line, "ABI001",
+                f"`{b.symbol}` is bound via ctypes but no native/*.cc "
+                f"exports it (extern \"C\")", b.symbol))
+            continue
+        if b.argtypes is not None:
+            if len(b.argtypes) != len(exp.params):
+                findings.append(Finding(
+                    b.path, b.argtypes_line, "ABI002",
+                    f"`{b.symbol}` argtypes arity {len(b.argtypes)} != "
+                    f"{len(exp.params)} C parameters "
+                    f"({exp.path}:{exp.line})", b.symbol))
+            else:
+                for i, (ct, pt) in enumerate(zip(exp.params, b.argtypes)):
+                    if not _compatible(ct, pt):
+                        c_txt = (exp.param_texts[i]
+                                 if i < len(exp.param_texts) else "?")
+                        findings.append(Finding(
+                            b.path, b.argtypes_line, "ABI003",
+                            f"`{b.symbol}` argtypes[{i}] is "
+                            f"{type_name(pt)} but the C parameter is "
+                            f"`{c_txt}` ({type_name(ct)}) "
+                            f"({exp.path}:{exp.line})",
+                            f"{b.symbol}:arg{i}"))
+        if b.restype is None:
+            if b.argtypes is not None and exp.ret != _INT_RET:
+                what = ("returns void — declare `restype = None`"
+                        if exp.ret == VOID else
+                        f"returns {type_name(exp.ret)} — ctypes "
+                        f"defaults restype to c_int and TRUNCATES it")
+                findings.append(Finding(
+                    b.path, b.argtypes_line, "ABI004",
+                    f"`{b.symbol}` has argtypes but no restype; the C "
+                    f"function {what} ({exp.path}:{exp.line})", b.symbol))
+        elif not _compatible(exp.ret, b.restype):
+            findings.append(Finding(
+                b.path, b.restype_line, "ABI003",
+                f"`{b.symbol}` restype is {type_name(b.restype)} but "
+                f"the C function returns {type_name(exp.ret)} "
+                f"({exp.path}:{exp.line})", f"{b.symbol}:ret"))
+    if check_unbound:
+        for symbol in sorted(set(exports) - bound_symbols):
+            exp = exports[symbol]
+            findings.append(Finding(
+                exp.path, exp.line, "ABI001",
+                f"`{symbol}` is exported (extern \"C\") but no ctypes "
+                f"binding declares it — dead ABI surface or a binding "
+                f"the lint cannot see", symbol))
+    return findings
+
+
+def check_nativeabi(sources: Sequence[Source],
+                    native_dir: Optional[str] = None) -> List[Finding]:
+    """The pass entry point run_all calls: bindings from the scanned
+    sources, exports from native/*.cc.  The unbound-export direction
+    needs the full binding picture, so it only fires when every
+    binding module is in scope."""
+    exports = collect_c_exports(native_dir or DEFAULT_NATIVE_DIR)
+    if not exports:
+        return []
+    bindings: List[CtypesBinding] = []
+    paths = set()
+    for src in sources:
+        paths.add(src.path)
+        bindings.extend(parse_ctypes_bindings(src))
+    full_scope = all(
+        any(p == mod or p.endswith("/" + mod) for p in paths)
+        for mod in BINDING_MODULES)
+    if not bindings and not full_scope:
+        return []
+    return cross_check(exports, bindings, check_unbound=full_scope)
