@@ -92,24 +92,71 @@ REPS = int(os.environ.get("BENCH_REPS", "3"))
 
 # Time budget: round 5's bench (5 workloads x 3 reps over 1024-block
 # chains) blew the driver's budget — BENCH_r05.json recorded rc 124
-# and NO result line.  Three layers of defense:
+# and NO result line, despite the in-process watchdog thread: a wedged
+# section holding the GIL (a C call that never returns) starves every
+# Python thread, timer included.  Four layers of defense now:
 # 1. per-SECTION deadlines: each workload owns a slice of the budget;
 #    its rep loops degrade to fewer reps (never below 1) and its chain
 #    build truncates at a chunk boundary when the slice runs out;
 # 2. later sections are skipped outright (fields emit null);
-# 3. a watchdog thread prints whatever RESULT holds and hard-exits
-#    just before the global deadline — even a wedged XLA compile on
-#    the main thread cannot take the JSON line down with it.
+# 3. incremental emission: after EVERY section the accumulated RESULT
+#    is flushed to a state file AND printed as a partial JSON line on
+#    stderr — progress survives any later catastrophe;
+# 4. a CHILD-PROCESS watchdog (immune to the parent's GIL) that, at
+#    the deadline, SIGKILLs the parent and prints the last recorded
+#    state as the stdout JSON line itself.  The in-process timer
+#    thread stays as the faster, richer path for non-GIL wedges.
 T0 = time.monotonic()
 DEADLINE = float(os.environ.get("BENCH_DEADLINE", "600"))
+STATE_PATH = os.path.join(_DIR, ".bench_cache",
+                          f"partial_{os.getpid()}.json")
 
-# one JSON line, exactly once — main() on success, watchdog on overrun.
-# The lock makes check-and-set atomic AND holds through the print, so
-# the watchdog firing while main() finishes cannot double-print or
-# os._exit mid-line.
+# one stdout JSON line, exactly once — main() on success, a watchdog
+# on overrun.  The lock makes check-and-set atomic AND holds through
+# the print, so the watchdog firing while main() finishes cannot
+# double-print or os._exit mid-line.
 RESULT = {}
 _EMITTED = False
 _EMIT_LOCK = threading.Lock()
+_WD_CHILD = None
+
+
+def _snapshot_json(extra=None):
+    """Serialize RESULT, retrying across concurrent mutation (a timer
+    thread may race a main-thread RESULT.update())."""
+    for _ in range(5):
+        try:
+            obj = dict(RESULT)
+            if extra:
+                obj.update(extra)
+            return json.dumps(obj)
+        except RuntimeError:
+            time.sleep(0.05)
+    return json.dumps({"metric": "transfer_replay_throughput",
+                       "value": None, "unit": "txs/s",
+                       "error": "result emit race"})
+
+
+def _write_state(tag):
+    """Persist the accumulated RESULT for the child watchdog; called
+    after every completed section (and at startup)."""
+    try:
+        os.makedirs(os.path.dirname(STATE_PATH), exist_ok=True)
+        line = _snapshot_json({"partial": tag})
+        tmp = STATE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(line)
+        os.replace(tmp, STATE_PATH)
+    except OSError:
+        pass
+
+
+def _section_done(name):
+    """Incremental emission (defense layer 3): state file + a partial
+    JSON line on stderr as each section completes."""
+    RESULT.setdefault("sections_done", []).append(name)
+    _write_state(name)
+    print(_snapshot_json({"partial": True}), file=sys.stderr, flush=True)
 
 
 def _emit():
@@ -119,21 +166,16 @@ def _emit():
             return
         _EMITTED = True
         RESULT["elapsed_s"] = round(time.monotonic() - T0, 1)
-        # the timer thread may race a main-thread RESULT.update();
-        # retry the snapshot+serialize so a mid-mutation RuntimeError
-        # cannot take the one guaranteed JSON line down with it
-        line = None
-        for _ in range(5):
+        print(_snapshot_json(), flush=True)
+        if _WD_CHILD is not None:
             try:
-                line = json.dumps(dict(RESULT))
-                break
-            except RuntimeError:
-                time.sleep(0.05)
-        if line is None:
-            line = json.dumps({"metric": "transfer_replay_throughput",
-                               "value": None, "unit": "txs/s",
-                               "error": "watchdog: result emit race"})
-        print(line, flush=True)
+                _WD_CHILD.kill()
+            except OSError:
+                pass
+        try:
+            os.unlink(STATE_PATH)
+        except OSError:
+            pass
 
 
 def _watchdog():
@@ -143,8 +185,65 @@ def _watchdog():
         os._exit(0)
 
 
-_WATCHDOG = threading.Timer(max(5.0, DEADLINE - 10.0), _watchdog)
+_WATCHDOG = threading.Timer(max(5.0, DEADLINE - 12.0), _watchdog)
 _WATCHDOG.daemon = True
+
+# The child watchdog: a separate interpreter sharing our stdout.  It
+# polls until its deadline; if the parent is still alive then, it
+# SIGKILLs it and prints the state file as the result line (leading
+# newline: the parent may have died mid-write, and the child's line
+# must still start fresh).  A GIL-holding wedge cannot touch it.
+_WD_CODE = (
+    "import json,os,signal,sys,time\n"
+    "pid=int(sys.argv[1]); path=sys.argv[2]\n"
+    "end=time.monotonic()+float(sys.argv[3])\n"
+    "while time.monotonic()<end:\n"
+    "    time.sleep(0.5)\n"
+    "    try: os.kill(pid,0)\n"
+    "    except OSError: sys.exit(0)\n"  # parent exited (and emitted)
+    "try: payload=open(path).read()\n"
+    "except OSError: payload=''\n"
+    "try: obj=json.loads(payload)\n"
+    "except ValueError: obj={}\n"
+    "if not obj:\n"
+    "    obj={'metric':'transfer_replay_throughput','value':None,\n"
+    "         'unit':'txs/s','error':'watchdog: no state recorded'}\n"
+    "obj['watchdog']='child'\n"
+    "try: os.kill(pid,signal.SIGKILL)\n"
+    "except OSError: pass\n"
+    "time.sleep(0.3)\n"
+    "sys.stdout.write('\\n'+json.dumps(obj)+'\\n')\n"
+    "sys.stdout.flush()\n"
+    "try: os.unlink(path)\n"
+    "except OSError: pass\n"
+)
+
+
+def _spawn_watchdog_child():
+    global _WD_CHILD
+    import subprocess
+    _write_state("init")
+    budget = max(4.0, DEADLINE - 6.0 - (time.monotonic() - T0))
+    _WD_CHILD = subprocess.Popen(
+        [sys.executable, "-c", _WD_CODE, str(os.getpid()), STATE_PATH,
+         str(budget)])
+
+
+def _maybe_wedge():
+    """BENCH_WEDGE deliberately wedges the run (watchdog regression
+    harness): 'gil' blocks the main thread INSIDE a C call that never
+    releases the GIL — the timer thread starves and only the child
+    watchdog can produce the JSON line; any other value parks the
+    main thread GIL-free, exercising the in-process timer path."""
+    mode = os.environ.get("BENCH_WEDGE")
+    if not mode:
+        return
+    if mode == "gil":
+        import ctypes
+        libc = ctypes.PyDLL(None)  # PyDLL: calls DO hold the GIL
+        while True:
+            libc.sleep(1 << 20)
+    threading.Event().wait()
 
 # end of the CURRENT workload's budget slice (absolute monotonic time);
 # main() advances it section by section
@@ -673,6 +772,74 @@ def run_mixed():
     return py_runs, tpu_runs, stats
 
 
+def run_streaming():
+    """Streaming-ingestion section: the transfer chain through the
+    serve pipeline (feed -> prefetch -> execute -> commit), reporting
+    p50/p99/max enqueue->committed block latency and sustained txs/s —
+    once in backlog mode (feed released as fast as consumed: pipeline
+    capacity) and once paced at ~70% of that rate (service latency
+    under sustained arrival, the SLO-honest number)."""
+    from coreth_tpu.serve import ChainFeed, StreamingPipeline
+    from coreth_tpu.types import Block
+    genesis, blocks = build_or_load_chain("transfer")
+    n = min(len(blocks),
+            int(os.environ.get("BENCH_STREAM_BLOCKS", "512")))
+    wire = [b.encode() for b in blocks[:n]]
+    window = int(os.environ.get("BENCH_STREAM_WINDOW", "32"))
+    out = {"blocks": n, "window": window}
+
+    def one_run(rate=None):
+        fresh = [Block.decode(w) for w in wire]
+        engine = _fresh_engine(genesis, TXS_PER_BLOCK)
+        engine.window = window
+        pipe = StreamingPipeline(engine, ChainFeed(fresh, rate=rate),
+                                 window_wait=0.005)
+        rep = pipe.run()
+        assert engine.root == fresh[-1].header.root
+        assert engine.stats.blocks_fallback == 0, engine.stats.row()
+        return rep
+
+    rep = one_run()
+    out["backlog"] = rep.row()
+    if not _deadline_tight(margin=45.0):
+        bps = rep.blocks / max(rep.wall_s, 1e-9)
+        rate = round(0.7 * bps, 2)
+        out["paced_rate_blocks_s"] = rate
+        out["paced"] = one_run(rate=rate).row()
+    return out
+
+
+def run_multichip_section():
+    """Fold the virtual-mesh scaling curve (tools/mesh_scaling.py)
+    into the same deadline budget: a truncated shape in a subprocess
+    (the virtual device count must be set before jax initializes, so
+    it cannot run in-process), parsed from its stdout JSON."""
+    import subprocess
+    budget = max(20.0, min(_section_left(), _remaining() - 12.0))
+    env = dict(os.environ)
+    env.setdefault("SCALE_BLOCKS", "4")
+    env.setdefault("SCALE_TXS", "128")
+    env.setdefault("SCALE_REPS", "1")
+    # the truncated in-bench shape must not clobber the standalone
+    # harness's committed artifact
+    env["SCALE_OUT"] = os.path.join(_DIR, ".bench_cache",
+                                    "multichip_bench.json")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(_DIR, "tools",
+                                          "mesh_scaling.py")],
+            capture_output=True, text=True, timeout=budget, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": f"deadline: mesh_scaling exceeded {budget:.0f}s"}
+    if r.returncode != 0:
+        return {"error": f"rc={r.returncode}",
+                "tail": (r.stderr or r.stdout)[-300:]}
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as exc:
+        return {"error": f"parse: {exc}"}
+
+
 def _begin_section(frac_end):
     """Advance the section budget slice; its rep loops and chain build
     stop when the slice (T0 + frac_end * DEADLINE) is spent."""
@@ -694,10 +861,12 @@ def main():
                  "loadavg": [round(x, 2) for x in os.getloadavg()]},
     })
     _WATCHDOG.start()
+    _spawn_watchdog_child()
+    _maybe_wedge()  # BENCH_WEDGE: watchdog regression harness
     result = RESULT
     skipped = []
     try:
-        _begin_section(0.38)
+        _begin_section(0.30)
         commit_stats = {}
         py_runs, tpu_runs, native_runs = run_workload(
             "transfer", BASELINE_BLOCKS, commit_stats=commit_stats)
@@ -722,9 +891,10 @@ def main():
                 _spread(native_runs) if native_runs else None,
             "vs_py_host": round(tpu_tps / py_tps, 2),
         })
+        _section_done("transfer")
 
         erc20_native_tps = None
-        _begin_section(0.62)
+        _begin_section(0.48)
         if _remaining() > 45:
             e20_commit = {}
             erc20_py, erc20_tpu, erc20_native = run_workload(
@@ -745,10 +915,11 @@ def main():
                 "erc20_vs_py_host": round(
                     _median(erc20_tpu) / _median(erc20_py), 2),
             })
+            _section_done("erc20")
         else:
             skipped.append("erc20")
 
-        _begin_section(0.76)
+        _begin_section(0.60)
         if _remaining() > 45:
             # the SAME erc20 chain forced through the general step
             # machine (no fast-path classification): config[1] through
@@ -767,10 +938,11 @@ def main():
                     if erc20_native_tps else None),
                 "erc20_machine_stats": mstats,
             })
+            _section_done("erc20_machine")
         else:
             skipped.append("erc20_machine")
 
-        _begin_section(0.90)
+        _begin_section(0.72)
         if _remaining() > 45:
             # contention workload (config[3]): fully serial conflict
             # chains — the OCC rounds now run INSIDE one dispatch per
@@ -793,10 +965,11 @@ def main():
                     _median(swap_tpu) / _median(swap_py), 2),
                 "swap_stats": sstats,
             })
+            _section_done("swap")
         else:
             skipped.append("swap")
 
-        _begin_section(0.97)
+        _begin_section(0.82)
         if _remaining() > 45:
             # Avalanche-semantics segment (config[4]): atomic ExtData +
             # nativeAssetCall blocks fall back to the exact host path;
@@ -816,8 +989,26 @@ def main():
                     for k in ("t_classify", "t_sender", "t_device",
                               "t_trie", "t_fallback")},
             })
+            _section_done("mixed")
         else:
             skipped.append("mixed")
+
+        _begin_section(0.93)
+        if _remaining() > 45:
+            # streaming ingestion (serve/): sustained-rate p50/p99
+            # block latency through the bounded-queue pipeline — the
+            # SLO surface, next to the one-shot throughput above
+            result["streaming"] = run_streaming()
+            _section_done("streaming")
+        else:
+            skipped.append("streaming")
+
+        _begin_section(0.99)
+        if _remaining() > 40:
+            result["multichip"] = run_multichip_section()
+            _section_done("multichip")
+        else:
+            skipped.append("multichip")
     except Exception as exc:  # noqa: BLE001 — the JSON line must emit
         result["error"] = f"{type(exc).__name__}: {exc}"
     if skipped:

@@ -63,7 +63,8 @@ def load():
         ctypes.c_char_p]
     lib.coreth_recover_finish.restype = None
     lib.coreth_baseline_replay.argtypes = [
-        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_double)]
     lib.coreth_baseline_replay.restype = ctypes.c_int
@@ -72,10 +73,11 @@ def load():
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p]
     lib.coreth_receipt_root.restype = None
     lib.coreth_evm_replay.argtypes = [
-        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-        ctypes.POINTER(ctypes.c_double)]
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_double)]
     lib.coreth_evm_replay.restype = ctypes.c_int
     lib.coreth_keccak256_batch.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
@@ -143,14 +145,31 @@ def baseline_replay(tx_records: bytes, block_offsets, roots: bytes,
     """Run the compiled sequential transfer processor (native/baseline.cc
     — the Go-proxy baseline; see BASELINE.md).  Returns (rc, phases)
     where rc==0 means every block's state root matched and phases is
-    [t_sender, t_exec, t_trie] seconds."""
+    [t_sender, t_exec, t_trie] seconds.
+
+    The decoder is bounds-checked, not trusted: the wrapper validates
+    the fixed-stride blobs against the counts it passes, and the C
+    side validates the offsets against the explicit tx-blob length
+    (rc 5 = malformed; fuzzed under ASan in tests/test_sanitize.py)."""
     lib = _require()
+    if not block_offsets:
+        raise ValueError("block_offsets must hold at least [0]")
     n_blocks = len(block_offsets) - 1
+    if len(roots) != 32 * n_blocks:
+        raise ValueError(f"roots blob {len(roots)}B != 32*{n_blocks}")
+    if len(coinbases) != 20 * n_blocks:
+        raise ValueError(
+            f"coinbases blob {len(coinbases)}B != 20*{n_blocks}")
+    if len(accounts) != 60 * n_accounts:
+        raise ValueError(
+            f"accounts blob {len(accounts)}B != 60*{n_accounts}")
+    if any(o < 0 for o in block_offsets):
+        raise ValueError("negative block offset")
     off = (ctypes.c_uint64 * len(block_offsets))(*block_offsets)
     phases = (ctypes.c_double * 3)()
     rc = lib.coreth_baseline_replay(
-        tx_records, off, n_blocks, roots, coinbases, accounts,
-        n_accounts, phases)
+        tx_records, len(tx_records), off, n_blocks, roots, coinbases,
+        accounts, n_accounts, phases)
     return rc, list(phases)
 
 
@@ -159,14 +178,30 @@ def evm_replay(tx_records: bytes, block_offsets, block_env: bytes,
                n_contracts: int, chain_id: int):
     """Run the compiled sequential EVM processor (native/evm.cc — the
     contract-workload baseline; see BASELINE.md round 5).  Returns
-    (rc, phases); rc==0 means every block's state root matched."""
+    (rc, phases); rc==0 means every block's state root matched.
+
+    Like baseline_replay, the packed-blob decode is bounds-checked:
+    fixed-stride blobs validate here, and the variable-length tx and
+    contract records (dlen/clen/nslots prefixes) validate in C against
+    the explicit blob lengths (rc -10 = malformed)."""
     lib = _require()
+    if not block_offsets:
+        raise ValueError("block_offsets must hold at least [0]")
     n_blocks = len(block_offsets) - 1
+    if len(block_env) != 116 * n_blocks:
+        raise ValueError(
+            f"block_env blob {len(block_env)}B != 116*{n_blocks}")
+    if len(accounts) != 60 * n_accounts:
+        raise ValueError(
+            f"accounts blob {len(accounts)}B != 60*{n_accounts}")
+    if any(o < 0 for o in block_offsets):
+        raise ValueError("negative block offset")
     off = (ctypes.c_uint64 * len(block_offsets))(*block_offsets)
     phases = (ctypes.c_double * 3)()
     rc = lib.coreth_evm_replay(
-        tx_records, off, n_blocks, block_env, accounts, n_accounts,
-        contracts, n_contracts, chain_id, phases)
+        tx_records, len(tx_records), off, n_blocks, block_env,
+        accounts, n_accounts, contracts, len(contracts), n_contracts,
+        chain_id, phases)
     return rc, list(phases)
 
 

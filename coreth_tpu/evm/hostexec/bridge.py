@@ -72,6 +72,9 @@ def _backend_for(evm, fork: str):
         return evm.statedb.get_state(contract, key)
 
     def code_resolver(addr: bytes) -> Optional[bytes]:
+        # counted so tests can pin when cached verdicts actually
+        # short-circuit this callback (the EOA-verdict reuse path)
+        _bump("code_resolves")
         if evm.precompile(addr) is not None:
             return None  # precompile callees run on the host only
         db = evm.statedb
@@ -124,11 +127,19 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
     seen = getattr(evm, "_hostexec_seen", None)
     if (seen is not None and seen[0] is statedb
             and seen[1] == statedb.storage_gen):
-        # EOA verdicts still re-resolve per tx: account existence/
-        # emptiness can move through pure balance transfers, which
-        # storage_gen does not count — a stale kind would skip the
-        # code_resolver's EIP-158 exist-and-empty host guard
-        be.reset_eoa_kinds()
+        if seen[2] == statedb.account_gen:
+            # nothing changed any account's existence/emptiness either
+            # (statedb.account_gen counts creations, balance/nonce
+            # zero-crossings, deploys, suicides, EIP-158 deletions,
+            # reverts) — cached EOA verdicts are still exact, so the
+            # per-tx kind reset is skipped too (PR-4 follow-up)
+            _bump("eoa_cache_reuse")
+        else:
+            # account shape moved through something storage_gen cannot
+            # see (a pure balance transfer creating an account, say):
+            # drop ONLY the EOA verdicts so the code_resolver's
+            # EIP-158 exist-and-empty host guard re-fires
+            be.reset_eoa_kinds()
         _bump("storage_cache_reuse")
     else:
         be.reset_contracts()
@@ -167,10 +178,11 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
         elif res.refund < 0:
             statedb.sub_refund(-res.refund)
         # fold this call's writes into the session's committed cache
-        # and record the StateDB generation they correspond to — the
-        # next tx of this block reuses the cache iff it still matches
+        # and record the StateDB generations they correspond to — the
+        # next tx of this block reuses the cache iff both still match
         be.commit()
-        evm._hostexec_seen = (statedb, statedb.storage_gen)
+        evm._hostexec_seen = (statedb, statedb.storage_gen,
+                              statedb.account_gen)
         return res.ret, res.gas_left, None
     # REVERT: the payload + surviving gas carry all the information
     # the caller needs; no interpreter re-run required.  The session's
@@ -178,7 +190,8 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
     # revert restores exactly the entry state, so the cache stays
     # valid for the next tx.
     statedb.revert_to_snapshot(snapshot)
-    evm._hostexec_seen = (statedb, statedb.storage_gen)
+    evm._hostexec_seen = (statedb, statedb.storage_gen,
+                          statedb.account_gen)
     err = vmerrs.ErrExecutionReverted()
     err.data = res.ret
     return res.ret, res.gas_left, err

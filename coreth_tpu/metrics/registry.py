@@ -96,6 +96,19 @@ class Histogram:
             else:
                 self._ring.append(v)
 
+    def replace_from(self, other: "Histogram") -> None:
+        """Adopt another histogram's state wholesale — the publish
+        primitive for accumulating privately and exposing atomically
+        in a registered instrument (window adopted too, so the ring
+        invariant holds)."""
+        with self._lock:
+            self.count = other.count
+            self.sum = other.sum
+            self.min = other.min
+            self.max = other.max
+            self._window = other._window
+            self._ring = list(other._ring)
+
     def quantile(self, q: float) -> float:
         with self._lock:
             if not self._ring:

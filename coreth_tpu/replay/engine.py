@@ -96,6 +96,9 @@ class ReplayStats:
     t_device: float = 0.0
     t_trie: float = 0.0
     t_fallback: float = 0.0
+    # windows whose fetch-tensor download was started asynchronously at
+    # issue time (the windowed device-read prefetch; serve/prefetch.py)
+    reads_prefetched: int = 0
 
     def row(self) -> dict:
         return dict(self.__dict__)
@@ -1192,6 +1195,17 @@ class ReplayEngine:
         self.state.balances = new_bal
         self.state.nonces = new_non
         self.state.slot_vals = new_sv
+        # windowed device READ: start the whole window's fetch-tensor
+        # device->host copy now (async — it begins the moment the scan
+        # finishes), so _complete_window's np.asarray lands on an
+        # already-transferred host buffer instead of paying the tunnel
+        # round trip inside the validation phase.  One windowed read
+        # replaces what a per-block pipeline would pay per block.
+        try:
+            fetches.copy_to_host_async()
+            self.stats.reads_prefetched += 1
+        except AttributeError:
+            pass  # non-jax array (mesh path fetches are already np)
         self.stats.t_device += time.monotonic() - t0
         return dict(items=items, prev=prev, fetches=fetches,
                     touched_lists=touched_lists, slot_lists=slot_lists,

@@ -1,0 +1,34 @@
+"""Streaming block-ingestion service (the "heavy traffic" half of the
+north star).
+
+The batch engine proves throughput: hand ``ReplayEngine.replay`` a
+pre-built chain, get roots back.  This package proves *service*: blocks
+arrive continuously from a :class:`~coreth_tpu.serve.feed.BlockFeed`
+(a paced pre-built chain, or blocks assembled live from the
+txpool/miner machinery), flow through a bounded-queue pipeline —
+
+    feed -> prefetch -> execute -> commit
+
+— with explicit backpressure between stages (a stalled stage degrades
+latency measurably instead of deadlocking or buffering unboundedly),
+and every block's enqueue->committed latency lands in p50/p99/max
+histograms next to the sustained txs/s over the run (the FAFO
+observation: sustained-rate measurement, not one-shot throughput, is
+the honest metric once Merkleization is off the critical path).
+
+Execution reuses the engine's existing machinery unchanged — transfer
+windows with cross-window speculation, fused machine-OCC runs, the
+exact host fallback, and the window-batched commit pipeline — so a
+streamed chain produces bit-identical state roots to batch replay
+(pinned by tests/test_serve.py across both trie backends).
+"""
+
+from coreth_tpu.serve.feed import (
+    BlockFeed, ChainFeed, FeedExhausted, MempoolFeed,
+)
+from coreth_tpu.serve.pipeline import StreamingPipeline, StreamReport
+
+__all__ = [
+    "BlockFeed", "ChainFeed", "FeedExhausted", "MempoolFeed",
+    "StreamingPipeline", "StreamReport",
+]

@@ -1,0 +1,135 @@
+"""Block sources for the streaming pipeline.
+
+Two concrete feeds cover the two halves of "heavy traffic":
+
+- :class:`ChainFeed` drains a pre-built chain at a target rate — the
+  replay analog of a peer streaming accepted blocks, with a rate knob
+  so benches can measure latency under a *sustained* arrival rate
+  instead of an instantaneous backlog;
+- :class:`MempoolFeed` assembles blocks live from the existing
+  txpool/miner machinery: callers pump signed transactions in, the
+  miner's ``commitNewWork`` packs them against the builder chain's
+  head, and each produced block is accepted there before it is served
+  — so the stream the pipeline replays is exactly what a validator
+  would have built under load.
+
+Feeds are pull-based: the pipeline's feed stage calls
+:meth:`BlockFeed.next_block` with a timeout; ``None`` means "nothing
+available yet" (a stalled feed — the pipeline keeps draining its
+in-flight work instead of blocking), :class:`FeedExhausted` ends the
+stream.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from coreth_tpu.types import Block
+
+
+class FeedExhausted(Exception):
+    """The feed has no more blocks and never will."""
+
+
+class BlockFeed:
+    """Abstract block source (pull-based; see module docstring)."""
+
+    def next_block(self, timeout: float) -> Optional[Block]:
+        """Next block, or None if none became available within
+        ``timeout`` seconds.  Raises FeedExhausted at end of stream."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release feed resources (idempotent)."""
+
+
+class ChainFeed(BlockFeed):
+    """Pre-built chain drained at a target rate.
+
+    ``rate`` is blocks/second; None releases blocks as fast as the
+    consumer pulls them (backlog mode — measures pipeline capacity).
+    With a rate, block i is withheld until ``start + i/rate``, so the
+    enqueue->committed latency histogram measures service latency at
+    that arrival rate, not queue-drain throughput.
+    """
+
+    def __init__(self, blocks: List[Block], rate: Optional[float] = None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.blocks = blocks
+        self.rate = rate
+        self._clock = clock
+        self._sleep = sleep
+        self._i = 0
+        self._t0: Optional[float] = None
+
+    def next_block(self, timeout: float) -> Optional[Block]:
+        if self._i >= len(self.blocks):
+            raise FeedExhausted
+        if self.rate:
+            if self._t0 is None:
+                self._t0 = self._clock()
+            ready_at = self._t0 + self._i / self.rate
+            now = self._clock()
+            if now < ready_at:
+                wait = min(timeout, ready_at - now)
+                if wait > 0:
+                    self._sleep(wait)
+                if self._clock() < ready_at:
+                    return None  # still pacing: report a stall
+        b = self.blocks[self._i]
+        self._i += 1
+        return b
+
+
+class MempoolFeed(BlockFeed):
+    """Blocks assembled live from the txpool under sustained load.
+
+    ``chain``/``txpool``/``miner`` are the existing machinery
+    (chain.BlockChain, txpool.TxPool, miner.Miner) wired to the same
+    builder-side state; ``tx_source(pool) -> bool`` is called before
+    each block to pump more signed transactions into the pool and
+    returns False once the load generator is exhausted.  Each produced
+    block is inserted AND accepted on the builder chain (so the pool's
+    reset sees the new head), then served to the pipeline — whose
+    replica engine must reproduce the builder's state roots
+    bit-identically.
+    """
+
+    def __init__(self, chain, txpool, miner,
+                 tx_source: Optional[Callable[[object], bool]] = None):
+        self.chain = chain
+        self.txpool = txpool
+        self.miner = miner
+        self.tx_source = tx_source
+        self._source_done = tx_source is None
+        self.built = 0
+
+    def next_block(self, timeout: float) -> Optional[Block]:
+        if not self._source_done:
+            if not self.tx_source(self.txpool):
+                self._source_done = True
+        pending, _queued = self.txpool.stats()
+        if pending == 0:
+            if self._source_done:
+                raise FeedExhausted
+            # load generator lagging: honor the poll timeout so the
+            # feed thread doesn't busy-spin against an empty pool
+            time.sleep(timeout)
+            return None
+        block = self.miner.generate_block()
+        if not block.transactions:
+            # nothing executable made it in (all pending underpriced
+            # against the new base fee, say) — a stall, not the end
+            if self._source_done:
+                raise FeedExhausted
+            time.sleep(timeout)
+            return None
+        self.chain.insert_block(block)
+        self.chain.accept(block.hash())
+        self.txpool.reset()
+        self.built += 1
+        return block
+
+    def close(self) -> None:
+        self.chain.close()

@@ -1,0 +1,390 @@
+"""Bounded-queue streaming pipeline: feed -> prefetch -> execute -> commit.
+
+Stage model
+-----------
+
+- **feed** (thread): pulls blocks from the :class:`BlockFeed`, stamps
+  the enqueue time, and blocks on the bounded feed queue when the
+  pipeline is behind — backpressure propagates all the way to the
+  source instead of buffering unboundedly.
+- **prefetch** (thread): drains the feed queue in window-sized chunks,
+  warms them (serve/prefetch.py — batched sender recovery + bytecode
+  touches), and blocks on the bounded execute queue.
+- **execute** (the ``run()`` caller's thread): the streaming analog of
+  ``ReplayEngine.replay`` — classify arriving blocks into transfer
+  windows, issue window N+1's device dispatch BEFORE validating window
+  N (cross-window speculation survives streaming), route
+  unclassifiable runs through ``_machine_run`` (fused OCC windows /
+  host fallback), and rewind exactly like batch replay when a window
+  fails validation.  Runs on the caller's thread because every engine
+  structure it touches (tries, DeviceState mirrors, commit staging) is
+  single-owner by design.
+- **commit**: the engine's window-batched CommitPipeline, wrapped so
+  every ``flush()`` is timed (and can be fault-injected slow in
+  tests).  Commit work is interleaved on the execute thread AFTER the
+  next window's dispatch is in flight — the host/device overlap the
+  batch engine already proves — so a slow commit stage stretches the
+  execute stage, the bounded queues fill, and the feed blocks: latency
+  degrades measurably, queues stay bounded.
+
+Every block's enqueue->committed latency lands in a
+:class:`~coreth_tpu.metrics.Histogram` (p50/p99/max), and the report
+carries sustained txs/s over the wall of the run — the SLO surface the
+bench's streaming section publishes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from coreth_tpu.metrics import Gauge, Histogram, Meter, get_or_register
+from coreth_tpu.serve.feed import BlockFeed, FeedExhausted
+from coreth_tpu.serve.prefetch import Prefetcher
+from coreth_tpu.types import Block
+
+
+@dataclass
+class _Item:
+    block: Block
+    t_enqueue: float
+
+
+@dataclass
+class StreamReport:
+    """One streaming run's SLO surface (bench JSON shape)."""
+    blocks: int = 0
+    txs: int = 0
+    wall_s: float = 0.0
+    sustained_txs_s: float = 0.0
+    latency_ms: dict = field(default_factory=dict)   # p50/p99/max
+    prefetch: dict = field(default_factory=dict)
+    queues: dict = field(default_factory=dict)
+    stages_s: dict = field(default_factory=dict)
+    backpressure: dict = field(default_factory=dict)
+    feed_stalls: int = 0
+    shutdown: bool = False
+
+    def row(self) -> dict:
+        return dict(self.__dict__)
+
+
+class StreamingPipeline:
+    """Drive one engine from one feed until exhaustion or shutdown.
+
+    ``depth`` bounds each inter-stage queue in blocks (default 2x the
+    engine window): total in-flight work is capped at ~2*depth +
+    2*window blocks no matter how far ahead the feed could run.
+    ``window_wait`` is how long the execute stage waits to top up a
+    partial window before running it — the latency/throughput knob
+    (holding blocks hostage for a full window would trade p50 for
+    batch efficiency).  ``commit_delay`` injects a per-flush stall
+    (fault-injection tests only).
+    """
+
+    def __init__(self, engine, feed: BlockFeed,
+                 depth: Optional[int] = None,
+                 window_wait: float = 0.01,
+                 commit_delay: float = 0.0,
+                 registry=None):
+        self.engine = engine
+        self.feed = feed
+        self.depth = depth or 2 * engine.window
+        self.window_wait = window_wait
+        self.commit_delay = commit_delay
+        self._q_feed: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._q_exec: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._feed_done = threading.Event()
+        self._pre_done = threading.Event()
+        self._shutdown_called = False
+        self.prefetcher = Prefetcher(engine)
+        self.stats = StreamReport()
+        self._latency = Histogram(window=4096)
+        self._tx_meter = Meter()
+        self._registry = registry
+        self._enqueued = 0
+        self._committed_blocks = 0
+        self._max_inflight = 0
+        self._t_first_enqueue: Optional[float] = None
+        self._t_last_commit: Optional[float] = None
+        self._feed_blocked_s = 0.0
+        self._prefetch_blocked_s = 0.0
+        self._t_commit = 0.0
+        self._commit_flushes = 0
+        self._prefetch_hits = 0
+        self._errors: List[BaseException] = []
+
+    # ------------------------------------------------------- queue helpers
+    def _put(self, q: "queue.Queue", item) -> float:
+        """Stop-aware bounded put; returns seconds spent blocked.
+        Returns -1 if the pipeline stopped before the item fit (the
+        item is dropped — mid-stream shutdown sheds un-entered work)."""
+        t0 = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return time.monotonic() - t0
+            except queue.Full:
+                continue
+        return -1.0
+
+    # ------------------------------------------------------------ stages
+    def _feed_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    b = self.feed.next_block(timeout=0.05)
+                except FeedExhausted:
+                    break
+                if b is None:
+                    self.stats.feed_stalls += 1
+                    continue
+                it = _Item(block=b, t_enqueue=time.monotonic())
+                if self._t_first_enqueue is None:
+                    self._t_first_enqueue = it.t_enqueue
+                # the bounded put IS the backpressure: when the
+                # pipeline is behind, the feed parks here and the
+                # source (paced chain / mempool builder) stops draining
+                blocked = self._put(self._q_feed, it)
+                if blocked < 0:
+                    break
+                self._feed_blocked_s += blocked
+                self._enqueued += 1
+                inflight = self._enqueued - self._committed_blocks
+                if inflight > self._max_inflight:
+                    self._max_inflight = inflight
+        except BaseException as exc:  # noqa: BLE001 — surfaced by run()
+            self._errors.append(exc)
+            self._stop.set()
+        finally:
+            self._feed_done.set()
+
+    def _prefetch_loop(self) -> None:
+        window = self.engine.window
+        try:
+            while True:
+                chunk: List[_Item] = []
+                try:
+                    chunk.append(self._q_feed.get(timeout=0.05))
+                except queue.Empty:
+                    if self._feed_done.is_set() and self._q_feed.empty():
+                        break
+                    if self._stop.is_set():
+                        break
+                    continue
+                while len(chunk) < window:
+                    try:
+                        chunk.append(self._q_feed.get_nowait())
+                    except queue.Empty:
+                        break
+                self.prefetcher.warm([c.block for c in chunk])
+                for c in chunk:
+                    blocked = self._put(self._q_exec, c)
+                    if blocked < 0:
+                        return
+                    self._prefetch_blocked_s += blocked
+        except BaseException as exc:  # noqa: BLE001 — surfaced by run()
+            self._errors.append(exc)
+            self._stop.set()
+        finally:
+            self._pre_done.set()
+
+    # ----------------------------------------------------------- commit
+    def _wrap_commit(self):
+        """Time (and optionally fault-inject) every commit flush."""
+        pipe = self.engine.commit_pipe
+        orig = pipe.flush
+
+        def timed_flush():
+            t0 = time.monotonic()
+            if self.commit_delay:
+                time.sleep(self.commit_delay)
+            out = orig()
+            self._t_commit += time.monotonic() - t0
+            self._commit_flushes += 1
+            return out
+
+        pipe.flush = timed_flush
+        return lambda: setattr(pipe, "flush", orig)
+
+    def _mark_committed(self, items: List[_Item]) -> None:
+        now = time.monotonic()
+        for it in items:
+            self._latency.update(now - it.t_enqueue)
+            self._tx_meter.mark(len(it.block.transactions))
+            self.stats.txs += len(it.block.transactions)
+        self.stats.blocks += len(items)
+        self._committed_blocks += len(items)
+        if items:
+            self._t_last_commit = now
+
+    # ---------------------------------------------------------- execute
+    def _next_item(self, idle: bool) -> Optional[_Item]:
+        """One item from the execute queue, or None at end-of-stream /
+        when a partial window should run instead of waiting longer."""
+        deadline = time.monotonic() + (0.25 if idle else self.window_wait)
+        while True:
+            if self._pre_done.is_set() and self._q_exec.empty():
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                it = self._q_exec.get(timeout=min(0.05, remaining))
+            except queue.Empty:
+                continue
+            # first sight of the block on the execute stage: senders
+            # the prefetch stage already recovered count as hits
+            self._prefetch_hits += sum(
+                1 for tx in it.block.transactions
+                if tx.cached_sender() is not None)
+            return it
+
+    def _eos(self) -> bool:
+        return self._pre_done.is_set() and self._q_exec.empty()
+
+    def _drive(self) -> None:
+        """The execute stage — see the module docstring's stage model.
+        Mirrors ReplayEngine.replay()'s issue-ahead/retire-behind loop,
+        driven by arriving items instead of a fixed block list."""
+        e = self.engine
+        buf: List[_Item] = []
+        pending = None  # (win, its items) — issued, not yet validated
+        while True:
+            # top up the working buffer; wait only when idle, and only
+            # window_wait when a partial window could run instead
+            while len(buf) < e.window:
+                it = self._next_item(idle=not buf and pending is None)
+                if it is None:
+                    break
+                buf.append(it)
+            if not buf and pending is None:
+                if self._eos():
+                    break
+                continue
+            # classify a transfer run off the head of the buffer
+            run = []
+            k = 0
+            t0 = time.monotonic()
+            while k < len(buf) and len(run) < e.window:
+                batch = e._classify(buf[k].block)
+                if batch is None:
+                    break
+                run.append((buf[k].block, batch))
+                k += 1
+            e.stats.t_classify += time.monotonic() - t0
+            win = e._issue_window(run) if run else None
+            # retire the previous window while the chip runs this one
+            if pending is not None:
+                p_win, p_items = pending
+                pending = None
+                resume = e._complete_window(
+                    p_win, [it.block for it in p_items], 0)
+                if resume is not None:
+                    # prefix [0, resume) is committed (device blocks +
+                    # the host-fallback block); the tail re-enters the
+                    # buffer for fresh classification, and the window
+                    # speculatively issued above ran on a stale base
+                    self._mark_committed(p_items[:resume])
+                    if win is not None:
+                        e._discard_window(win)
+                    buf = p_items[resume:] + buf
+                    continue
+                self._mark_committed(p_items)
+            if win is not None:
+                pending = (win, buf[:k])
+                buf = buf[k:]
+                continue
+            if buf:
+                # head is not transfer-classifiable and nothing is in
+                # flight: machine-OCC run / exact host path, exactly
+                # like batch replay's hit_fallback branch
+                blocks = [it.block for it in buf]
+                n = e._machine_run(blocks, 0)
+                self._mark_committed(buf[:n])
+                buf = buf[n:]
+
+    # -------------------------------------------------------------- run
+    def run(self) -> StreamReport:
+        """Drive the pipeline until the feed exhausts (or shutdown()),
+        then drain in-flight work, flush the commit stage, and return
+        the SLO report.  The engine ends on the same root batch replay
+        would produce for the blocks that were committed."""
+        t_start = time.monotonic()
+        restore = self._wrap_commit()
+        feed_t = threading.Thread(target=self._feed_loop,
+                                  name="serve-feed", daemon=True)
+        pre_t = threading.Thread(target=self._prefetch_loop,
+                                 name="serve-prefetch", daemon=True)
+        feed_t.start()
+        pre_t.start()
+        try:
+            self._drive()
+        finally:
+            self._stop.set()
+            feed_t.join(timeout=10)
+            pre_t.join(timeout=10)
+            # anything still staged belongs to completed blocks
+            self.engine.commit_pipe.flush()
+            restore()
+        if self._errors:
+            raise self._errors[0]
+        wall = time.monotonic() - t_start
+        self._publish(wall)
+        return self.stats
+
+    def shutdown(self) -> None:
+        """Mid-stream stop: the feed stops pulling, in-flight queues
+        drain what fits, the pending window validates, staged commits
+        flush.  run() returns its report as usual."""
+        self._shutdown_called = True
+        self._stop.set()
+
+    # ------------------------------------------------------------ report
+    def _publish(self, wall: float) -> None:
+        s = self.stats
+        s.wall_s = round(wall, 3)
+        span = None
+        if self._t_first_enqueue is not None \
+                and self._t_last_commit is not None:
+            span = self._t_last_commit - self._t_first_enqueue
+        s.sustained_txs_s = round(s.txs / span, 1) if span else 0.0
+        snap = self._latency.snapshot()
+        s.latency_ms = {
+            "p50": round(1000 * snap["p50"], 3),
+            "p99": round(1000 * snap["p99"], 3),
+            "max": round(1000 * snap["max"], 3),
+        }
+        s.prefetch = {
+            "hits": self._prefetch_hits,
+            "sigs": self.prefetcher.sigs,
+            "code_touches": self.prefetcher.code_touches,
+            "overlap_s": round(self.prefetcher.busy_s, 3),
+            "reads_prefetched": self.engine.stats.reads_prefetched,
+        }
+        s.queues = {
+            "depth": self.depth,
+            "max_inflight": self._max_inflight,
+        }
+        s.stages_s = {
+            "prefetch": round(self.prefetcher.busy_s, 3),
+            "commit": round(self._t_commit, 3),
+        }
+        s.backpressure = {
+            "feed_blocked_s": round(self._feed_blocked_s, 3),
+            "prefetch_blocked_s": round(self._prefetch_blocked_s, 3),
+            "commit_flushes": self._commit_flushes,
+        }
+        s.shutdown = self._shutdown_called
+        # SLO surface in the metrics registry (scrapeable next to the
+        # engine's replay/* gauges)
+        reg = self._registry
+        get_or_register("serve/block_latency", Histogram,
+                        reg).replace_from(self._latency)
+        get_or_register("serve/sustained_txs_s", Gauge,
+                        reg).update(s.sustained_txs_s)
+        get_or_register("serve/blocks", Gauge, reg).update(s.blocks)

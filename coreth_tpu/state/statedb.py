@@ -124,6 +124,15 @@ class StateDB:
         # committed-storage cache alive within a block and invalidate
         # it the moment an interpreter-path tx moves state under it.
         self.storage_gen = 0
+        # companion counter for ACCOUNT-SHAPE changes storage_gen cannot
+        # see: existence/emptiness transitions (object creation, balance
+        # or nonce crossing zero, deploys, suicides, EIP-158 deletions,
+        # journal reverts).  A pure balance transfer that creates an
+        # account bumps this but not storage_gen — the hostexec bridge
+        # keeps its cached EOA verdicts alive across txs only while
+        # BOTH generations hold (PR-4 follow-up: EOA-verdict
+        # invalidation without the per-tx re-resolution).
+        self.account_gen = 0
 
     # ------------------------------------------------------------- journal
     def _append_journal(self, undo, addr: Optional[bytes] = None) -> None:
@@ -140,6 +149,7 @@ class StateDB:
                              f"(journal length {len(self._journal)})")
         if len(self._journal) > snap:
             self.storage_gen += 1  # undone writes may reappear changed
+            self.account_gen += 1  # undone creations/balances too
         while len(self._journal) > snap:
             undo, addr = self._journal.pop()
             undo()
@@ -192,6 +202,7 @@ class StateDB:
                 self._storage_tries.pop(addr, None)
 
         self._append_journal(undo, addr)
+        self.account_gen += 1  # a fresh object changes existence
         return obj
 
     def create_account(self, addr: bytes) -> None:
@@ -240,6 +251,9 @@ class StateDB:
             obj.account.balance = prev
 
         self._append_journal(undo, obj.address)
+        if prev == 0 or amount == 0:
+            # emptiness may flip (EIP-158): EOA verdicts go stale
+            self.account_gen += 1
         obj.account.balance = amount
 
     # ----------------------------------------------------------- multicoin
@@ -292,6 +306,8 @@ class StateDB:
             obj.account.nonce = prev
 
         self._append_journal(undo, addr)
+        if prev == 0 or nonce == 0:
+            self.account_gen += 1  # emptiness may flip
         obj.account.nonce = nonce
 
     # ---------------------------------------------------------------- code
@@ -320,6 +336,7 @@ class StateDB:
 
         self._append_journal(undo, addr)
         self.storage_gen += 1  # a deploy changes code resolution
+        self.account_gen += 1  # ... and the account's kind
         obj.code = code
         obj.account.code_hash = keccak256(code)
         obj.dirty_code = True
@@ -430,6 +447,7 @@ class StateDB:
 
         self._append_journal(undo, addr)
         self.storage_gen += 1  # storage of addr vanishes at finalise
+        self.account_gen += 1  # existence vanishes at finalise
         obj.suicided = True
         obj.account.balance = 0
         return True
@@ -562,6 +580,8 @@ class StateDB:
             if obj is None:
                 continue
             if obj.suicided or (delete_empty_objects and obj.empty()):
+                if not obj.deleted:
+                    self.account_gen += 1  # EIP-158 deletion
                 obj.deleted = True
                 self._destructed.add(addr)
             else:

@@ -648,13 +648,21 @@ void coreth_receipt_root(const uint64_t* cum_gas, const uint8_t* tx_types,
 //   => 221 bytes per record
 // accounts: addr20 | balance32 | nonce8 => 60 bytes
 // Returns 0 on success; 1 root mismatch; 2 invalid sig; 3 nonce/balance
-// check failed; 4 unsupported big value.
-int coreth_baseline_replay(const uint8_t* txs, const uint64_t* block_off,
+// check failed; 4 unsupported big value; 5 malformed input (offsets
+// not monotone, or a record extending past txs_len — the explicit
+// length makes the packed-blob decode bounds-checked instead of
+// trusted; fuzzed under ASan by tests/test_sanitize.py).
+int coreth_baseline_replay(const uint8_t* txs, uint64_t txs_len,
+                           const uint64_t* block_off,
                            uint64_t n_blocks, const uint8_t* roots,
                            const uint8_t* coinbases,
                            const uint8_t* genesis_accounts,
                            uint64_t n_accounts, double* phases) {
   constexpr size_t REC = 221;
+  for (uint64_t b = 0; b < n_blocks; ++b)
+    if (block_off[b] > block_off[b + 1]) return 5;
+  // overflow-safe: compare counts, not byte products
+  if (n_blocks > 0 && block_off[n_blocks] > txs_len / REC) return 5;
   std::unordered_map<std::string, Account, AddrHash> state;
   state.reserve(1 << 14);
   bool too_big = false;

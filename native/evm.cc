@@ -1110,7 +1110,10 @@ extern "C" {
 // Sequential compiled EVM replay over packed inputs; returns 0 on
 // success, 1000+i on a root mismatch at block i, negative on malformed
 // input (-5: a tx needed a host-only feature — never on the bench
-// workloads).  phases: [t_sender, t_exec, t_trie] seconds.
+// workloads; -10: offsets not monotone or a length-prefixed record
+// extending past its blob — txs_len/contracts_len make the decode
+// bounds-checked instead of trusted; fuzzed under ASan by
+// tests/test_sanitize.py).  phases: [t_sender, t_exec, t_trie].
 //
 // tx record: sighash32 r32 s32 recid1 to20 value32 gas8 price32
 //            required32 nonce8 dlen4 data
@@ -1119,11 +1122,15 @@ extern "C" {
 // accounts: addr20 bal32 nonce8
 // contracts: addr20 codehash32 bal32 nonce8 len4 code nslots4
 //            (key32 val32)*
-int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
+int coreth_evm_replay(const uint8_t* txs, uint64_t txs_len,
+                      const uint64_t* block_off,
                       uint64_t n_blocks, const uint8_t* block_env,
                       const uint8_t* accounts, uint64_t n_accounts,
-                      const uint8_t* contracts, uint64_t n_contracts,
+                      const uint8_t* contracts, uint64_t contracts_len,
+                      uint64_t n_contracts,
                       uint64_t chain_id, double* phases) {
+  for (uint64_t b = 0; b < n_blocks; ++b)
+    if (block_off[b] > block_off[b + 1]) return -10;
   std::unordered_map<std::string, Account> state;
   std::vector<Contract> pool(n_contracts);
   state.reserve(n_accounts * 2);
@@ -1144,7 +1151,11 @@ int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
     p += 60;
   }
   p = contracts;
+  const uint8_t* cend = contracts + contracts_len;
   for (uint64_t i = 0; i < n_contracts; ++i) {
+    // fixed header (addr20 hash32 bal32 nonce8 len4) must fit before
+    // its length prefixes are trusted
+    if (cend - p < 96) return -10;
     std::string addr((const char*)p, 20);
     Contract& c = pool[i];
     std::memcpy(c.code_hash, p + 20, 32);
@@ -1158,12 +1169,14 @@ int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
     for (int j = 0; j < 8; ++j) cnonce = (cnonce << 8) | p[84 + j];
     uint32_t clen;
     std::memcpy(&clen, p + 92, 4);
+    if ((uint64_t)(cend - p) < 96 + (uint64_t)clen + 4) return -10;
     c.code.assign(p + 96, p + 96 + clen);
     analyze_jumpdests(&c);
     p += 96 + clen;
     uint32_t nslots;
     std::memcpy(&nslots, p, 4);
     p += 4;
+    if ((uint64_t)(cend - p) < 64 * (uint64_t)nslots) return -10;
     for (uint32_t j = 0; j < nslots; ++j) {
       Key32 k;
       std::memcpy(k.b, p, 32);
@@ -1283,6 +1296,15 @@ int coreth_evm_replay(const uint8_t* txs, const uint64_t* block_off,
     std::unordered_set<uint64_t> dirty_contracts;
     touched.insert(std::string((const char*)env.coinbase, 20));
     for (uint64_t ti = block_off[bi]; ti < block_off[bi + 1]; ++ti) {
+      // the fixed record head (233 bytes through dlen) and then the
+      // dlen-prefixed calldata must both fit inside txs_len
+      if ((uint64_t)(txs + txs_len - tp) < 233) return -10;
+      {
+        uint32_t dl;
+        std::memcpy(&dl, tp + 229, 4);
+        if ((uint64_t)(txs + txs_len - tp) < 233 + (uint64_t)dl)
+          return -10;
+      }
       // --- sender recovery
       double t0 = now_s();
       uint8_t sender[20];

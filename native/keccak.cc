@@ -87,7 +87,9 @@ void coreth_keccak256(const uint8_t* data, uint64_t len, uint8_t* out32) {
   }
   uint8_t block[136];
   std::memset(block, 0, sizeof(block));
-  std::memcpy(block, data, len);
+  // len==0 with a null data pointer is a legal call (hash of the
+  // empty string); memcpy(dst, nullptr, 0) is formally UB, so guard
+  if (len) std::memcpy(block, data, len);
   block[len] = 0x01;
   block[135] ^= 0x80;
   for (int i = 0; i < 17; ++i) {

@@ -261,6 +261,66 @@ def test_bridge_cache_reuse_redrives_eoa_existence():
     assert hostexec.counters().get("native_calls", 0) == 1
 
 
+def test_bridge_eoa_verdict_survives_while_account_gen_holds():
+    """PR-4 follow-up closed: while statedb.account_gen proves no
+    account's existence/emptiness moved, cached EOA verdicts survive
+    across native txs (no per-tx kind reset, no code_resolver
+    re-resolution); a mid-block balance-transfer-created account bumps
+    account_gen — invisible to storage_gen — and forces the fresh
+    verdict the EIP-158 guard depends on."""
+    from coreth_tpu.evm import EVM, BlockContext, TxContext
+    from coreth_tpu.evm import hostexec
+    from coreth_tpu.mpt import EMPTY_ROOT
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.state import Database, StateDB
+    sender, a, b = b"\x0a" * 20, b"\x46" * 20, b"\x47" * 20
+    # A: zero-value CALL B, store the call's success flag in slot 1
+    code_a = (bytes([0x60, 0x00, 0x60, 0x00, 0x60, 0x00, 0x60, 0x00,
+                     0x60, 0x00, 0x73]) + b
+              + bytes([0x61, 0xFF, 0xFF, 0xF1,
+                       0x60, 0x01, 0x55, 0x00]))
+    db = StateDB(EMPTY_ROOT, Database())
+    db.set_code(a, code_a)
+    db.add_balance(sender, 10**20)
+    db.finalise(True)
+    db.intermediate_root(True)
+    rules = CFG.rules(1, 1)
+    ctx = BlockContext(coinbase=b"\xba" * 20, gas_limit=8_000_000,
+                       number=1, time=1, base_fee=25 * 10**9)
+    evm = EVM(ctx, TxContext(origin=sender, gas_price=25 * 10**9), db,
+              CFG)
+
+    def one_tx():
+        db.prepare(rules, sender, ctx.coinbase, a,
+                   list(rules.active_precompiles), [])
+        evm.call(sender, a, b"", 200_000, 0)
+        db.finalise(True)
+
+    hostexec.reset_counters()
+    one_tx()                      # B nonexistent: EOA verdict cached
+    resolves_tx1 = hostexec.counters().get("code_resolves", 0)
+    assert hostexec.counters().get("native_calls", 0) == 1
+    assert resolves_tx1 > 0
+    one_tx()                      # nothing moved: verdict SURVIVES
+    c = hostexec.counters()
+    assert c.get("eoa_cache_reuse", 0) == 1, c
+    # B's kind was served from the session cache — the resolver was
+    # not consulted again for it
+    assert c.get("code_resolves", 0) == resolves_tx1, c
+    assert c.get("native_calls", 0) == 2
+    # a pure balance transfer CREATES an account mid-block: invisible
+    # to storage_gen, but account_gen moves and the next tx must NOT
+    # take the no-reset path
+    gen_s = db.storage_gen
+    db.add_balance(b"\x99" * 20, 7)
+    assert db.storage_gen == gen_s
+    one_tx()
+    c = hostexec.counters()
+    assert c.get("eoa_cache_reuse", 0) == 1, c          # no new reuse
+    assert c.get("code_resolves", 0) > resolves_tx1, c  # fresh verdict
+    assert c.get("native_calls", 0) == 3
+
+
 # ------------------------------------------- corpus through the bridge
 
 def test_statetests_corpus_native_bit_identical(monkeypatch):

@@ -78,6 +78,20 @@ def test_smoke_helper_heap_overflow_traps():
     assert ("runtime error" in out or "AddressSanitizer" in out), out
 
 
+def test_replay_decoder_length_prefix_fuzz_under_asan():
+    """The packed-blob replay decoders (coreth_baseline_replay /
+    coreth_evm_replay) against the seeded hostile corpus — truncated
+    blobs, non-monotone offsets, lying dlen/clen/nslots length
+    prefixes — with ASan armed: any read past a blob aborts the run.
+    The script also asserts blatant truncations come back with the
+    malformed rc (5 / -10), so a decoder that silently "succeeds" off
+    a bad prefix fails even without a sanitizer hit."""
+    r = _run(["tests/fuzz_native_replay.py"])
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "OK baseline_rejected=" in r.stdout, out[-3000:]
+
+
 def test_hostexec_vectors_and_trie_differential_under_asan():
     """The real boundary drives: 13 hand-derived hostexec vectors
     (gas/refund/returndata/static-protection) + the randomized

@@ -119,7 +119,10 @@ def main():
             "txs_s_spread": [round(runs[0], 1), round(runs[-1], 1)],
         })
         print(f"n={n}: {runs}", file=sys.stderr)
-    out = os.path.join(_DIR, "MULTICHIP_SCALING.json")
+    # SCALE_OUT redirects the artifact (bench.py's deadline-budgeted
+    # truncated run must not clobber the standalone curve)
+    out = os.environ.get(
+        "SCALE_OUT", os.path.join(_DIR, "MULTICHIP_SCALING.json"))
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
