@@ -516,17 +516,9 @@ class MachineWindowRunner:
             self._synced = n
         return self.table, self.key_tab
 
-    # ------------------------------------------------------------- issue
-    def issue(self, items, discovered=None, attempt: int = 1) -> dict:
-        """Pack + dispatch one window; returns a handle for complete().
-
-        items: [(BlockEnv, [TxSpec, ...]), ...] in chain order.
-        The dispatch is ASYNC (jax queues it): callers overlap host
-        trie folding of the previous window with this one's execution
-        and only block in complete()'s fetch.
-        """
-        if discovered is None:
-            discovered = [[{} for _t in specs] for _env, specs in items]
+    def _premaps(self, items, discovered):
+        """Per-lane premapped key lists (common-key heuristic + seeded
+        storage + keys discovered by earlier attempts)."""
         premaps = []
         for (_env, specs), disc in zip(items, discovered):
             block_pre = []
@@ -540,6 +532,20 @@ class MachineWindowRunner:
                     keys[k] = None
                 block_pre.append(list(keys))
             premaps.append(block_pre)
+        return premaps
+
+    # ------------------------------------------------------------- issue
+    def issue(self, items, discovered=None, attempt: int = 1) -> dict:
+        """Pack + dispatch one window; returns a handle for complete().
+
+        items: [(BlockEnv, [TxSpec, ...]), ...] in chain order.
+        The dispatch is ASYNC (jax queues it): callers overlap host
+        trie folding of the previous window with this one's execution
+        and only block in complete()'s fetch.
+        """
+        if discovered is None:
+            discovered = [[{} for _t in specs] for _env, specs in items]
+        premaps = self._premaps(items, discovered)
         p, occ = self._occ_params(items, premaps)
         W, L, S, G = occ.blocks, p.batch, p.scache_cap, occ.table_cap
 

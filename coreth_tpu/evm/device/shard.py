@@ -1,0 +1,525 @@
+"""Device-sharded OCC machine windows: per-shard slot tables +
+per-shard OCC inside shard_map + a collective exchange step.
+
+The single-chip fused OCC kernel (machine.build_occ_machine via
+adapter.MachineWindowRunner) keeps ONE global (contract, key) -> gid
+map and ONE HBM slot table.  On a dp mesh that replication is what
+inverted the scaling curve: every chip would carry the whole table and
+re-execute every lane.  This module shards the machine path instead:
+
+- **per-shard state tables**: each shard owns the storage of the
+  contracts in its bucket (parallel/shard.py contract_bucket over
+  keccak(address)), with its own (contract, key) -> local-gid map,
+  host value mirror, and a shard-major device table row block — the
+  ``(n_shards * G, 16)`` value/key tables shard over ``dp`` so every
+  device holds (on real chips: in its own HBM) only its arena;
+
+- **shard-local OCC**: at window build time every call tx classifies
+  shard-local — a device-eligible tx touches exactly ONE contract's
+  storage, and a contract's storage lives wholly on one shard, so
+  cross-shard READ-WRITE conflicts are impossible by construction and
+  each shard's Block-STM round loop + sequential validation sweep runs
+  unmodified inside ``shard_map`` over its own lanes and table.  The
+  remaining genuinely cross-shard effects — a lane's CALLER living in
+  a different account bucket than its callee contract (value moves and
+  fees crossing shards) — are counted per window (``cross_shard``) and
+  settle in the host account sweep, which is exact and O(txs);
+
+- **the exchange step**: a separate collective program psums each
+  shard's per-block packed effect flags (all-lanes-committed,
+  any-escape) into one tiny replicated tensor.  The scheduler fetches
+  THAT — not the full packed result — to decide a window is clean, and
+  then dispatches the NEXT window's per-shard OCC before fetching this
+  window's (large) packed results: the cross-shard exchange overlaps
+  the next window's dispatch, the execute/fold-overlap idiom (PR 4)
+  applied to the exchange phase (pinned by the dispatch-ordering test
+  in tests/test_shard_replay.py against EVENT_LOG below).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as PS
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.evm.device import machine as M
+from coreth_tpu.evm.device import tables as T
+from coreth_tpu.evm.device.adapter import (
+    MachineWindowRunner, PackedOut, WindowResult, _count_dispatch,
+    _pow2, addr_word, miss_keys, result_from_row, word16,
+)
+from coreth_tpu.ops import u256
+from coreth_tpu.parallel import _shard_map, account_bucket, contract_bucket
+
+# Dispatch/fetch ordering trace for the overlap test: entries are
+# "dispatch:<seq>", "exchange_fetch:<seq>", "result_fetch:<seq>".
+# Bounded (a long-running mesh service appends a few entries per
+# window forever), and seq is MODULE-global so two runners in one
+# process (e.g. a mempool-fed builder + replica pair) never emit
+# colliding entries.
+EVENT_LOG: "deque[str]" = deque(maxlen=512)
+_SEQ = [0]
+
+
+def _next_seq() -> int:
+    _SEQ[0] += 1
+    return _SEQ[0]
+
+# blocks_in leaves whose axis 1 is the (sharded) lane axis
+_LANE_KEYS = ("code", "jdest", "code_len", "calldata", "data_len",
+              "start_gas", "active", "sgid", "callvalue", "caller_w",
+              "address_w", "origin_w", "gasprice_w")
+# per-block (replicated) leaves
+_BLOCK_KEYS = ("timestamp", "number", "gaslimit", "coinbase_w",
+               "basefee_w", "chainid_w")
+
+
+def _mesh_key(mesh):
+    return (tuple(mesh.devices.flat), mesh.axis_names)
+
+
+_OCC_SHARDED: Dict[Tuple, object] = {}
+_EXCHANGES: Dict[Tuple, object] = {}
+
+
+def build_sharded_occ_machine(params: M.MachineParams, occ: M.OccParams,
+                              mesh):
+    """Per-shard OCC: the single-chip fused kernel body runs unchanged
+    on every device over its lane slice and table arena.  params.batch
+    and occ.table_cap are PER-SHARD shapes; the caller passes
+    (n_shards * G, 16) tables and (W, n_shards * batch, ...) lanes."""
+    inner = M.build_occ_machine(params, occ)
+
+    def run(table, key_tab, blocks_in):
+        return inner(table, key_tab, blocks_in)
+
+    specs = {k: PS(None, "dp") for k in _LANE_KEYS}
+    specs.update({k: PS() for k in _BLOCK_KEYS})
+    sharded = _shard_map(
+        run, mesh=mesh,
+        in_specs=(PS("dp"), PS("dp"), specs),
+        out_specs={"table": PS("dp"), "packed": PS(None, "dp")},
+        # per-shard OCC is collective-free inside (the partition makes
+        # lanes shard-local); vma tracking has nothing to verify
+        check_vma=False)
+    return sharded
+
+
+def get_sharded_occ_machine(params: M.MachineParams, occ: M.OccParams,
+                            mesh):
+    key = (params, occ, _mesh_key(mesh))
+    fn = _OCC_SHARDED.get(key)
+    if fn is None:
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        fn = jax.jit(build_sharded_occ_machine(params, occ, mesh),
+                     donate_argnums=donate)
+        _OCC_SHARDED[key] = fn
+    return fn
+
+
+def get_shard_exchange(mesh):
+    """The collective exchange program: psum each shard's per-block
+    packed (all-committed, any-escape-or-pending) flags into one tiny
+    replicated (W, 2) tensor — what the scheduler needs to overlap the
+    next window's dispatch with this window's result fetch."""
+    key = _mesh_key(mesh)
+    fn = _EXCHANGES.get(key)
+    if fn is None:
+        def ex(packed, active):
+            committed = packed[:, :, -4] != 0
+            escape = (packed[:, :, -3] != 0) | (packed[:, :, -2] != 0)
+            clean_l = jnp.all(~active | committed, axis=1)
+            esc_l = jnp.any(active & escape, axis=1)
+            flags = jnp.stack([clean_l.astype(jnp.int32),
+                               esc_l.astype(jnp.int32)], axis=1)
+            return jax.lax.psum(flags, "dp")
+
+        fn = jax.jit(_shard_map(
+            ex, mesh=mesh,
+            in_specs=(PS(None, "dp"), PS(None, "dp")),
+            out_specs=PS(), check_vma=False))
+        _EXCHANGES[key] = fn
+    return fn
+
+
+class ShardedWindowRunner(MachineWindowRunner):
+    """MachineWindowRunner with per-shard gid maps/mirrors/tables and
+    the exchange-overlap scheduling hooks (poll_clean / can_pipeline).
+
+    Lane placement: block bi's call tx li goes to flat lane
+    ``shard * batch + local`` of its contract's shard; ``lane_map``
+    in the handle translates back to tx order for unpacking."""
+
+    def __init__(self, fork: str, storage_resolver, mesh,
+                 max_attempts: int = 6):
+        super().__init__(fork, storage_resolver, max_attempts)
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        n = self.n_shards
+        # per-shard twins of the parent's global structures
+        self.slot_gid = [dict() for _ in range(n)]
+        self.gid_keys = [[] for _ in range(n)]
+        self.vals = [[] for _ in range(n)]
+        self._synced = [0] * n
+        self._bucket_memo: Dict[bytes, int] = {}
+        self._abucket_memo: Dict[bytes, int] = {}
+        self.cross_shard = 0          # caller-bucket != callee-bucket
+        self.multi_shard_blocks = 0   # blocks spanning > 1 shard
+        self._probe = None            # can_pipeline's prepared shapes
+
+    # ------------------------------------------------------------ state
+    def shard_of(self, contract: bytes) -> int:
+        s = self._bucket_memo.get(contract)
+        if s is None:
+            s = contract_bucket(keccak256(contract), self.n_shards)
+            self._bucket_memo[contract] = s
+        return s
+
+    def _account_bucket(self, addr: bytes) -> int:
+        s = self._abucket_memo.get(addr)
+        if s is None:
+            s = account_bucket(keccak256(addr), self.n_shards)
+            self._abucket_memo[addr] = s
+        return s
+
+    def reset(self) -> None:
+        n = self.n_shards
+        self.slot_gid = [dict() for _ in range(n)]
+        self.gid_keys = [[] for _ in range(n)]
+        self.vals = [[] for _ in range(n)]
+        self._synced = [0] * n
+        self.common.clear()
+        self.table = None
+        self.key_tab = None
+        self.table_cap = 0
+        self._stale = True
+
+    def commit_block(self, writes) -> None:
+        for (contract, key), v in writes.items():
+            s = self.shard_of(contract)
+            g = self.slot_gid[s].get((contract, key))
+            if g is None:
+                g = len(self.vals[s])
+                self.slot_gid[s][(contract, key)] = g
+                self.gid_keys[s].append((contract, key))
+                self.vals[s].append(v)
+            else:
+                self.vals[s][g] = v
+
+    def _gid(self, contract: bytes, key: bytes) -> int:
+        """Shard-LOCAL gid (the kernel's table index within the owning
+        shard's arena)."""
+        s = self.shard_of(contract)
+        g = self.slot_gid[s].get((contract, key))
+        if g is None:
+            g = len(self.vals[s])
+            self.slot_gid[s][(contract, key)] = g
+            self.gid_keys[s].append((contract, key))
+            self.vals[s].append(self.resolver(contract, key))
+        return g
+
+    # ------------------------------------------------------------- shape
+    def _occ_params(self, items, premaps):
+        feats = set()
+        max_code = 64
+        max_data = 64
+        max_lanes = 1
+        max_slots = 4
+        unmapped = [0] * self.n_shards
+        for (_env, specs), block_pre in zip(items, premaps):
+            per_shard = [0] * self.n_shards
+            for t, pre in zip(specs, block_pre):
+                info = T.scan_code(t.code, self.fork)
+                if not info.eligible:
+                    raise ValueError(
+                        f"TxSpec code not device-eligible: {info.reason}")
+                feats |= set(info.features)
+                max_code = max(max_code, len(t.code))
+                max_data = max(max_data, len(t.calldata))
+                max_slots = max(max_slots, len(pre) + 8)
+                s = self.shard_of(t.address)
+                per_shard[s] += 1
+                for k in pre:
+                    if (t.address, k) not in self.slot_gid[s]:
+                        unmapped[s] += 1
+            max_lanes = max(max_lanes, max(per_shard))
+        p = M.MachineParams(
+            fork=self.fork,
+            batch=_pow2(max_lanes, 8),
+            code_cap=_pow2(max_code, 256),
+            data_cap=_pow2(max_data, 128),
+            scache_cap=_pow2(max_slots, 8),
+            features=frozenset(feats))
+        g_need = max(len(v) + u
+                     for v, u in zip(self.vals, unmapped))
+        occ = M.OccParams(
+            blocks=_pow2(len(items), 1),
+            table_cap=_pow2(g_need + 1, 64),
+            rounds=p.batch + 1)
+        return p, occ
+
+    def _device_tables(self, G: int):
+        n = self.n_shards
+        if self.table is None or self.table_cap != G or self._stale:
+            tv = np.zeros((n * G, u256.LIMBS), dtype=np.int32)
+            tk = np.zeros((n * G, u256.LIMBS), dtype=np.int32)
+            for s in range(n):
+                for g in range(len(self.vals[s])):
+                    tv[s * G + g] = word16(self.vals[s][g])
+                    tk[s * G + g] = word16(int.from_bytes(
+                        self.gid_keys[s][g][1], "big"))
+            self.table = jnp.asarray(tv)
+            self.key_tab = jnp.asarray(tk)
+            self.table_cap = G
+            self._synced = [len(v) for v in self.vals]
+            self._stale = False
+        else:
+            rows, tv, tk = [], [], []
+            for s in range(n):
+                for g in range(self._synced[s], len(self.vals[s])):
+                    rows.append(s * G + g)
+                    tv.append(word16(self.vals[s][g]))
+                    tk.append(word16(int.from_bytes(
+                        self.gid_keys[s][g][1], "big")))
+                self._synced[s] = len(self.vals[s])
+            if rows:
+                jidx = jnp.asarray(np.asarray(rows, dtype=np.int32))
+                self.table = self.table.at[jidx].set(
+                    jnp.asarray(np.stack(tv)))
+                self.key_tab = self.key_tab.at[jidx].set(
+                    jnp.asarray(np.stack(tk)))
+        return self.table, self.key_tab
+
+    # ---------------------------------------------------------- schedule
+    def poll_clean(self, handle: dict) -> bool:
+        """Fetch ONLY the exchange tensor (tiny) and decide whether the
+        window committed clean on every shard — cheap enough to gate
+        dispatching the next window before the packed-result fetch."""
+        clean = handle.get("clean")
+        if clean is None:
+            ex = np.asarray(handle["ex"])
+            EVENT_LOG.append(f"exchange_fetch:{handle['seq']}")
+            clean = bool((ex[:, 0] == self.n_shards).all()
+                         and (ex[:, 1] == 0).all())
+            handle["clean"] = clean
+        return clean
+
+    def can_pipeline(self, items) -> bool:
+        """True when issuing `items` now is provably rebuild-free: the
+        per-shard table caps hold and the device table is trusted, so
+        the dispatch cannot consult the (not-yet-updated) host mirror.
+        The derived premaps/shapes are cached for the issue() that
+        immediately follows (same items object) — the probe would
+        otherwise double the per-window host prep on the very path the
+        early dispatch exists to shrink."""
+        self._probe = None
+        if self._stale or self.table is None:
+            return False
+        discovered = [[{} for _t in specs] for _env, specs in items]
+        premaps = self._premaps(items, discovered)
+        try:
+            p, occ = self._occ_params(items, premaps)
+        except ValueError:
+            return False
+        if occ.table_cap != self.table_cap:
+            return False
+        self._probe = (items, discovered, premaps, p, occ)
+        return True
+
+    # ------------------------------------------------------------- issue
+    def issue(self, items, discovered=None, attempt: int = 1) -> dict:
+        probe, self._probe = self._probe, None
+        if (discovered is None and probe is not None
+                and probe[0] is items):
+            _items, discovered, premaps, p, occ = probe
+        else:
+            if discovered is None:
+                discovered = [[{} for _t in specs]
+                              for _env, specs in items]
+            premaps = self._premaps(items, discovered)
+            p, occ = self._occ_params(items, premaps)
+        n = self.n_shards
+        W, L, S, G = occ.blocks, p.batch, p.scache_cap, occ.table_cap
+        Lp = n * L
+
+        # lane placement by contract shard + cross-shard classification
+        lane_map: List[List[int]] = []
+        for (_env, specs), _pre in zip(items, premaps):
+            counters = [0] * n
+            slots = []
+            shards_used = set()
+            for t in specs:
+                s = self.shard_of(t.address)
+                shards_used.add(s)
+                slots.append(s * L + counters[s])
+                counters[s] += 1
+                if self._account_bucket(t.caller) != s:
+                    # value/fee effects cross account buckets; they
+                    # settle in the host account sweep (exact, O(txs))
+                    self.cross_shard += 1
+            if len(shards_used) > 1:
+                self.multi_shard_blocks += 1
+            lane_map.append(slots)
+
+        code = np.zeros((W, Lp, p.code_cap + 33), dtype=np.int32)
+        code_len = np.zeros((W, Lp), dtype=np.int32)
+        jdest = np.zeros((W, Lp, p.code_cap), dtype=np.int32)
+        calldata = np.zeros((W, Lp, p.data_cap), dtype=np.int32)
+        data_len = np.zeros((W, Lp), dtype=np.int32)
+        start_gas = np.zeros((W, Lp), dtype=np.int32)
+        active = np.zeros((W, Lp), dtype=bool)
+        sgid = np.full((W, Lp, S), G, dtype=np.int32)
+        words = {k: np.zeros((W, Lp, u256.LIMBS), dtype=np.int32)
+                 for k in ("callvalue", "caller_w", "address_w",
+                           "origin_w", "gasprice_w")}
+        timestamp = np.zeros((W,), dtype=np.int32)
+        number = np.zeros((W,), dtype=np.int32)
+        gaslimit = np.zeros((W,), dtype=np.int32)
+        coinbase_w = np.zeros((W, u256.LIMBS), dtype=np.int32)
+        basefee_w = np.zeros((W, u256.LIMBS), dtype=np.int32)
+        chain_id = 0
+        for bi, ((env, specs), block_pre) in enumerate(
+                zip(items, premaps)):
+            timestamp[bi] = env.timestamp
+            number[bi] = env.number
+            gaslimit[bi] = min(env.gas_limit, (1 << 31) - 1)
+            coinbase_w[bi] = word16(addr_word(env.coinbase))
+            basefee_w[bi] = word16(env.base_fee)
+            chain_id = env.chain_id
+            for li, t in enumerate(specs):
+                fl = lane_map[bi][li]
+                cb = np.frombuffer(t.code, dtype=np.uint8)
+                code[bi, fl, :len(cb)] = cb
+                code_len[bi, fl] = len(cb)
+                info = T.scan_code(t.code, self.fork)
+                for d in info.jumpdests:
+                    if d < p.code_cap:
+                        jdest[bi, fl, d] = 1
+                db = np.frombuffer(t.calldata, dtype=np.uint8)
+                calldata[bi, fl, :len(db)] = db
+                data_len[bi, fl] = len(db)
+                start_gas[bi, fl] = t.gas
+                active[bi, fl] = True
+                words["callvalue"][bi, fl] = word16(t.value)
+                words["caller_w"][bi, fl] = word16(addr_word(t.caller))
+                words["address_w"][bi, fl] = word16(addr_word(t.address))
+                words["origin_w"][bi, fl] = word16(addr_word(t.origin))
+                words["gasprice_w"][bi, fl] = word16(t.gas_price)
+                for j, key in enumerate(block_pre[li]):
+                    sgid[bi, fl, j] = self._gid(t.address, key)
+        table, key_tab = self._device_tables(G)
+        active_j = jnp.asarray(active)
+        inputs = dict(
+            code=jnp.asarray(code), jdest=jnp.asarray(jdest),
+            code_len=jnp.asarray(code_len),
+            calldata=jnp.asarray(calldata),
+            data_len=jnp.asarray(data_len),
+            start_gas=jnp.asarray(start_gas),
+            active=active_j, sgid=jnp.asarray(sgid),
+            callvalue=jnp.asarray(words["callvalue"]),
+            caller_w=jnp.asarray(words["caller_w"]),
+            address_w=jnp.asarray(words["address_w"]),
+            origin_w=jnp.asarray(words["origin_w"]),
+            gasprice_w=jnp.asarray(words["gasprice_w"]),
+            timestamp=jnp.asarray(timestamp),
+            number=jnp.asarray(number),
+            gaslimit=jnp.asarray(gaslimit),
+            coinbase_w=jnp.asarray(coinbase_w),
+            basefee_w=jnp.asarray(basefee_w),
+            chainid_w=jnp.asarray(word16(chain_id)),
+        )
+        fn = get_sharded_occ_machine(p, occ, self.mesh)
+        _count_dispatch()
+        seq = _next_seq()
+        EVENT_LOG.append(f"dispatch:{seq}")
+        out = fn(table, key_tab, inputs)
+        self.table = out["table"]
+        # the exchange rides the same device queue, right behind the
+        # window — its (tiny) result is what poll_clean fetches
+        ex = get_shard_exchange(self.mesh)(out["packed"], active_j)
+        return dict(out=out, ex=ex, items=items, discovered=discovered,
+                    p=p, occ=occ, premaps=premaps, attempt=attempt,
+                    lane_map=lane_map, seq=seq)
+
+    # ---------------------------------------------------------- complete
+    def complete(self, handle: dict) -> WindowResult:
+        while True:
+            p = handle["p"]
+            Lp = self.n_shards * p.batch
+            lane_map = handle["lane_map"]
+            packed = np.asarray(handle["out"]["packed"])
+            EVENT_LOG.append(f"result_fetch:{handle['seq']}")
+            pw = packed.shape[2] - 4
+            pout = PackedOut(packed[:, :, :pw].reshape(-1, pw), p)
+            extra = packed[:, :, pw:]
+            missing = False
+            for bi, (_env, specs) in enumerate(handle["items"]):
+                for li, t in enumerate(specs):
+                    fl = lane_map[bi][li]
+                    if not extra[bi, fl, 1]:
+                        continue  # escaped lanes only carry misses
+                    s = self.shard_of(t.address)
+                    disc = handle["discovered"][bi][li]
+                    for key in miss_keys(pout, bi * Lp + fl):
+                        if (t.address, key) not in self.slot_gid[s]:
+                            self._gid(t.address, key)
+                        if key not in disc:
+                            disc[key] = None
+                            missing = True
+            if missing and handle["attempt"] < self.max_attempts:
+                self._stale = True
+                handle = self.issue(handle["items"],
+                                    handle["discovered"],
+                                    attempt=handle["attempt"] + 1)
+                continue
+            break
+        results, committed, escape, clean, rounds = [], [], [], [], []
+        for bi, (_env, specs) in enumerate(handle["items"]):
+            slots = lane_map[bi]
+            res = [result_from_row(pout, bi * Lp + fl) for fl in slots]
+            if slots:
+                com = extra[bi, slots, 0].astype(bool)
+                esc = (extra[bi, slots, 1]
+                       | extra[bi, slots, 2]).astype(bool)
+            else:
+                com = np.zeros((0,), dtype=bool)
+                esc = np.zeros((0,), dtype=bool)
+            results.append(res)
+            committed.append(com)
+            escape.append(esc)
+            clean.append(bool(com.all()) if len(slots) else True)
+            # per-shard round counts may differ; report the max
+            rounds.append(int(extra[bi, :, 3].max()) if len(slots)
+                          else 0)
+        self._update_common(handle, pout, clean)
+        return WindowResult(results=results, committed=committed,
+                            escape=escape, clean=clean, rounds=rounds,
+                            attempts=handle["attempt"])
+
+    def _update_common(self, handle, pout: PackedOut,
+                       clean: List[bool]) -> None:
+        from coreth_tpu.evm.device.adapter import _key_bytes
+        Lp = self.n_shards * handle["p"].batch
+        lane_map = handle["lane_map"]
+        for bi, (_env, specs) in enumerate(handle["items"]):
+            if not clean[bi]:
+                continue
+            for li, t in enumerate(specs):
+                row = bi * Lp + lane_map[bi][li]
+                touched: Dict[bytes, None] = {}
+                for j in range(int(pout.scnt[row])):
+                    fl = int(pout.sflag[row, j])
+                    if fl & (M.F_READ | M.F_WRITTEN):
+                        touched[_key_bytes(pout.skey[row, j])] = None
+                cur = self.common.get(t.address)
+                if cur is None:
+                    keep = list(touched)[:self.COMMON_CAP]
+                    self.common[t.address] = dict.fromkeys(keep)
+                else:
+                    self.common[t.address] = {
+                        k: None for k in cur if k in touched}

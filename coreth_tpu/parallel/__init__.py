@@ -8,8 +8,14 @@ per-account reductions cross shards with psum_scatter.
 """
 
 from coreth_tpu.parallel.mesh import (  # noqa: F401
+    _shard_map,
     make_mesh,
     sharded_recover,
     sharded_slot_step,
     sharded_transfer_step,
+)
+from coreth_tpu.parallel.shard import (  # noqa: F401
+    account_bucket,
+    contract_bucket,
+    remap_rows,
 )

@@ -1,0 +1,47 @@
+"""Shard-placement helpers for device-sharded replay state.
+
+One definition of "which shard owns this state row" shared by every
+sharded table in the system (DeviceState account/slot rows, the OCC
+machine runner's per-shard slot tables, the windowed transfer kernel):
+
+- **accounts** bucket by the first byte of keccak(address) — the same
+  hash the secure trie keys by, so placement is uniform even for
+  adversarially sequential addresses;
+- **contracts** bucket the same way (one contract's storage lives
+  wholly on one shard — the Reddio-style partition that makes machine
+  lanes shard-local by construction, since a device-eligible tx touches
+  exactly one contract's storage).
+
+Rows are allocated shard-major: shard ``s`` owns rows
+``[s*arena, (s+1)*arena)`` of a table with ``n_shards`` uniform arenas,
+matching a ``PartitionSpec("dp")`` block sharding of the table, so a
+device can translate a global row to its local row with one subtract.
+
+Everything here is consensus-critical (bucket placement feeds the
+packed effect exchange whose sums must be bit-identical at every mesh
+width) and deliberately allocation-order-free: the bucket depends only
+on the address, never on discovery order.
+"""
+
+from __future__ import annotations
+
+
+def account_bucket(addr_hash: bytes, n_shards: int) -> int:
+    """Owning shard of an account row, from keccak256(address)."""
+    if n_shards <= 1:
+        return 0
+    return addr_hash[0] % n_shards
+
+
+def contract_bucket(addr_hash: bytes, n_shards: int) -> int:
+    """Owning shard of a contract's storage (same rule as accounts —
+    kept separate so a future asymmetric placement changes one line)."""
+    return account_bucket(addr_hash, n_shards)
+
+
+def remap_rows(rows, old_arena: int, new_arena: int):
+    """Row ids after an arena doubling: shard-major layout means every
+    row moves to ``shard*new_arena + local`` (shard = row//old_arena,
+    local = row % old_arena)."""
+    return [(r // old_arena) * new_arena + (r % old_arena)
+            for r in rows]

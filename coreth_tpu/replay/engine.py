@@ -272,13 +272,26 @@ class DeviceState:
     """Account- and storage-slot-indexed device arrays (the flat-state /
     snapshot analog, reference core/state/snapshot/ — here resident in
     HBM).  Slot index 0 is a reserved dummy that native-transfer and
-    padding rows target with amount 0."""
+    padding rows target with amount 0.
+
+    With ``n_shards > 1`` (a dp mesh is driving replay) the device
+    arrays become PER-SHARD tables: host indices (gids) stay contiguous
+    in discovery order, but each gid's DEVICE ROW is allocated inside
+    the arena of its owning shard — accounts bucket by
+    keccak(address)[0], contract storage by the contract's bucket
+    (parallel/shard.py), so placement is uniform and independent of
+    discovery order.  ``row_of``/``slot_row_of`` carry the gid -> row
+    indirection (identity when unsharded); every device-array
+    scatter/gather goes through it."""
 
     def __init__(self, capacity: int = 1 << 14,
-                 slot_capacity: int = 1 << 14):
+                 slot_capacity: int = 1 << 14, n_shards: int = 1):
         self.index: Dict[bytes, int] = {}
         self.addrs: List[bytes] = []
         self.capacity = capacity
+        self.n_shards = n_shards
+        self.row_of: List[int] = []
+        self._arow = [0] * n_shards           # next local row per shard
         self.balances = jnp.zeros((capacity, u256.LIMBS), dtype=jnp.int32)
         self.nonces = jnp.zeros((capacity,), dtype=jnp.int32)
         # host-side metadata that gates device replay; roots/code_hashes
@@ -295,6 +308,9 @@ class DeviceState:
         self.slot_capacity = slot_capacity
         self.slot_index: Dict[Tuple[bytes, bytes], int] = {}
         self.slot_keys: List[Tuple[bytes, bytes]] = [(b"", b"")]  # dummy 0
+        self.slot_row_of: List[int] = [0]     # dummy -> shard 0 row 0
+        self._srow = [1 if s == 0 else 0 for s in range(n_shards)]
+        self._cbucket: Dict[bytes, int] = {}  # contract -> owning shard
         self.slot_vals = jnp.zeros((slot_capacity, u256.LIMBS),
                                    dtype=jnp.int32)
         # host mirror of slot values as of the last VALIDATED block —
@@ -320,17 +336,85 @@ class DeviceState:
             (self.slot_capacity, u256.LIMBS), dtype=jnp.int32
         ).at[:self.slot_vals.shape[0]].set(self.slot_vals)
 
+    def _grow_sharded(self) -> None:
+        """Double every shard's arena: shard-major rows all move
+        (row = shard*arena + local), so the device tables rebuild from
+        a host round trip — rare (amortized doubling) and the ONLY
+        point where sharded rows are remapped."""
+        from coreth_tpu.parallel import remap_rows
+        old = self.capacity // self.n_shards
+        self.capacity *= 2
+        new_rows = remap_rows(self.row_of, old,
+                              self.capacity // self.n_shards)
+        bal = np.asarray(self.balances)
+        non = np.asarray(self.nonces)
+        nb = np.zeros((self.capacity, u256.LIMBS), dtype=np.int32)
+        nn = np.zeros((self.capacity,), dtype=np.int32)
+        nb[new_rows] = bal[self.row_of]
+        nn[new_rows] = non[self.row_of]
+        self.balances = jnp.asarray(nb)
+        self.nonces = jnp.asarray(nn)
+        self.row_of = new_rows
+
+    def _grow_slots_sharded(self) -> None:
+        from coreth_tpu.parallel import remap_rows
+        old = self.slot_capacity // self.n_shards
+        self.slot_capacity *= 2
+        new_rows = remap_rows(self.slot_row_of, old,
+                              self.slot_capacity // self.n_shards)
+        sv = np.asarray(self.slot_vals)
+        nsv = np.zeros((self.slot_capacity, u256.LIMBS), dtype=np.int32)
+        nsv[new_rows] = sv[self.slot_row_of]
+        self.slot_vals = jnp.asarray(nsv)
+        self.slot_row_of = new_rows
+
+    def _alloc_row(self, addr_hash: bytes) -> int:
+        """Device-table row for a new account gid (bucketed arena in
+        shard mode, identity otherwise)."""
+        if self.n_shards <= 1:
+            row = len(self.row_of)
+            if row >= self.capacity:
+                self._grow(row + 1)
+            return row
+        from coreth_tpu.parallel import account_bucket
+        s = account_bucket(addr_hash, self.n_shards)
+        if self._arow[s] >= self.capacity // self.n_shards:
+            self._grow_sharded()
+        row = s * (self.capacity // self.n_shards) + self._arow[s]
+        self._arow[s] += 1
+        return row
+
+    def _alloc_slot_row(self, contract: bytes) -> int:
+        if self.n_shards <= 1:
+            row = len(self.slot_row_of)
+            if row >= self.slot_capacity:
+                self._grow_slots(row + 1)
+            return row
+        s = self._cbucket.get(contract)
+        if s is None:
+            from coreth_tpu.crypto import keccak256
+            from coreth_tpu.parallel import contract_bucket
+            s = contract_bucket(keccak256(contract), self.n_shards)
+            self._cbucket[contract] = s
+        if self._srow[s] >= self.slot_capacity // self.n_shards:
+            self._grow_slots_sharded()
+        row = s * (self.slot_capacity // self.n_shards) + self._srow[s]
+        self._srow[s] += 1
+        return row
+
     def ensure(self, addr: bytes, account: Optional[StateAccount]) -> int:
         idx = self.index.get(addr)
         if idx is not None:
             return idx
         idx = len(self.addrs)
-        if idx >= self.capacity:
-            self._grow(idx + 1)
         self.index[addr] = idx
         self.addrs.append(addr)
         from coreth_tpu.crypto import keccak256
         self.addr_hashes.append(keccak256(addr))
+        # two statements: _alloc_row may REPLACE row_of (arena growth
+        # remaps rows into a fresh list), so the append must bind after
+        row = self._alloc_row(self.addr_hashes[idx])
+        self.row_of.append(row)
         if account is None:
             self.has_code.append(False)
             self.multicoin.append(False)
@@ -352,10 +436,10 @@ class DeviceState:
         if s_idx is not None:
             return s_idx
         s_idx = len(self.slot_keys)
-        if s_idx >= self.slot_capacity:
-            self._grow_slots(s_idx + 1)
         self.slot_index[(contract, key)] = s_idx
         self.slot_keys.append((contract, key))
+        row = self._alloc_slot_row(contract)  # may replace slot_row_of
+        self.slot_row_of.append(row)
         self.slot_host.append(value)
         self.slots_by_contract.setdefault(contract, []).append(s_idx)
         if value:
@@ -384,7 +468,7 @@ class DeviceState:
             n = len(self._staged)
             pad = self._pad_pow2(n)
             idx = np.full(pad, self.capacity, dtype=np.int32)
-            idx[:n] = [s[0] for s in self._staged]
+            idx[:n] = [self.row_of[s[0]] for s in self._staged]
             bal = u256.pack_np([s[1] for s in self._staged]
                                + [0] * (pad - n))
             non = np.zeros(pad, dtype=np.int32)
@@ -398,7 +482,8 @@ class DeviceState:
             n = len(self._staged_slots)
             pad = self._pad_pow2(n)
             idx = np.full(pad, self.slot_capacity, dtype=np.int32)
-            idx[:n] = [s[0] for s in self._staged_slots]
+            idx[:n] = [self.slot_row_of[s[0]]
+                       for s in self._staged_slots]
             val = u256.pack_np([s[1] for s in self._staged_slots]
                                + [0] * (pad - n))
             self.slot_vals = self.slot_vals.at[jnp.asarray(idx)].set(
@@ -408,7 +493,8 @@ class DeviceState:
 
     def read_accounts(self, indices: List[int]) -> List[Tuple[int, int]]:
         """Pull (balance, nonce) for given indices to host."""
-        idx = np.asarray(indices, dtype=np.int32)
+        idx = np.asarray([self.row_of[i] for i in indices],
+                         dtype=np.int32)
         bal = np.asarray(self.balances[jnp.asarray(idx)])
         non = np.asarray(self.nonces[jnp.asarray(idx)])
         balances = u256.to_ints(bal)
@@ -545,9 +631,10 @@ class ReplayEngine:
         self.config = config
         self.db = db
         self.mesh = None
+        self._n_shards = 1
         if mesh is not None and mesh.devices.size > 1:
-            from coreth_tpu.parallel import (
-                sharded_recover, sharded_slot_step, sharded_transfer_step)
+            from coreth_tpu.parallel import sharded_recover
+            from coreth_tpu.replay.shard import sharded_transfer_window
             cap = capacity
             scap = slot_capacity or capacity
             n_dev = mesh.devices.size
@@ -560,10 +647,8 @@ class ReplayEngine:
                         "doubling growth preserves divisibility, so fix "
                         "the initial value")
             self.mesh = mesh
-            self._mesh_cap = cap
-            self._mesh_scap = scap
-            self._mesh_transfer = sharded_transfer_step(mesh, cap)
-            self._mesh_slot = sharded_slot_step(mesh, scap)
+            self._n_shards = n_dev
+            self._mesh_window = sharded_transfer_window(mesh)
             self._mesh_recover = sharded_recover(mesh)
         from coreth_tpu.mpt import native_trie
         # commit-path backend: CORETH_TRIE=native|py (default: native
@@ -580,7 +665,8 @@ class ReplayEngine:
             else:
                 self.trie = native_trie.NativeSecureTrie \
                     .from_python_trie(self.trie)
-        self.state = DeviceState(capacity, slot_capacity or capacity)
+        self.state = DeviceState(capacity, slot_capacity or capacity,
+                                 n_shards=self._n_shards)
         self.signer = LatestSigner(config.chain_id)
         # a DummyEngine with ConsensusCallbacks makes the host fallback
         # path apply atomic ExtData txs (onExtraStateChange,
@@ -1063,12 +1149,14 @@ class ReplayEngine:
             SL *= 2
         cap = self.state.capacity
         scap = self.state.slot_capacity
-        acct_gids = np.full(L, cap, dtype=np.int32)  # OOB pad: fill/drop
+        # device-table ROWS of the window-locals (row == gid unsharded;
+        # bucketed arena row on a mesh); OOB pad: fill/drop
+        acct_gids = np.full(L, cap, dtype=np.int32)
         for g, l in acct_local.items():
-            acct_gids[l] = g
+            acct_gids[l] = self.state.row_of[g]
         slot_gids = np.full(SL, scap, dtype=np.int32)
         for g, l in slot_local.items():
-            slot_gids[l] = g
+            slot_gids[l] = self.state.slot_row_of[g]
         txds = np.zeros((K, pad, TXD_COLS), dtype=np.int32)
         t_idxs = np.zeros((K, t_pad), dtype=np.int32)
         s_idxs = np.zeros((K, s_pad), dtype=np.int32)
@@ -1082,92 +1170,43 @@ class ReplayEngine:
         return (txds, t_idxs, s_idxs, acct_gids, slot_gids,
                 touched_lists, slot_lists, flushed)
 
-    def _mesh_fns(self):
-        """Mesh step functions, rebuilt if the account table grew past
-        the capacity they were compiled for."""
-        if (self.state.capacity != self._mesh_cap
-                or self.state.slot_capacity != self._mesh_scap):
-            from coreth_tpu.parallel import (
-                sharded_slot_step, sharded_transfer_step)
-            self._mesh_cap = self.state.capacity
-            self._mesh_scap = self.state.slot_capacity
-            self._mesh_transfer = sharded_transfer_step(
-                self.mesh, self._mesh_cap)
-            self._mesh_slot = sharded_slot_step(self.mesh, self._mesh_scap)
-        return self._mesh_transfer, self._mesh_slot
-
     def _issue_window_mesh(self, items: List[Tuple[Block, dict]],
                            fetch: bool = True) -> dict:
-        """Mesh-sharded execution of a window (parallel/mesh.py): per
-        block, the tx batch shards over ``dp``, each device segment-sums
-        full-width partial totals from its tx shard, and psum_scatter
-        reduces them onto the account/slot row sharding over ICI.
-
-        Blocks dispatch individually — on a locally-attached mesh the
-        per-dispatch cost the single-chip tunnel amortizes with its
-        window scan is negligible next to the collective latency, and
-        per-block fetches are what the host trie fold needs anyway.
-        Returns the same win dict shape as _issue_window."""
+        """Mesh-sharded execution of a whole window in ONE dispatch
+        (replay/shard.py): the persistent balance/nonce/slot tables are
+        per-shard row arenas sharded over ``dp``, txs round-robin over
+        devices, and each block's cross-shard effects (remote credits,
+        coinbase fees, remote slot debits/credits) exchange with a
+        single psum of packed effect tensors sized by the window's
+        touched set.  The fetch tensor comes back in exactly the
+        single-device layout, so _complete_window is shared — and the
+        old per-block dispatch + per-block blocking sync that inverted
+        the scaling curve is gone."""
+        from coreth_tpu.replay.shard import interleave_txs
         t0 = time.monotonic()
-        flushed = self.state.flush_staged()
+        (txds, t_idxs, s_idxs, acct_rows, slot_rows, touched_lists,
+         slot_lists, flushed) = self._prepare_window(items)
         prev = (self.state.balances, self.state.nonces,
                 self.state.slot_vals)
-        step_fn, slot_fn = self._mesh_fns()
-        t_pad, s_pad = 256, 8
-        touched_lists, slot_lists = [], []
-        for block, batch in items:
-            touched = sorted(set(batch["senders"]) | set(batch["recips"])
-                             | {batch["coinbase"]})
-            touched_lists.append(touched)
-            while t_pad < len(touched):
-                t_pad *= 2
-            slots = sorted((set(batch["from_slots"])
-                            | set(batch["to_slots"])) - {0})
-            slot_lists.append(slots)
-            while s_pad < len(slots):
-                s_pad *= 2
-        K = len(items)
-        fetches = np.zeros((K, t_pad + s_pad + 1, u256.LIMBS + 1),
-                           dtype=np.int32)
-        failed = False
-        for k, (block, batch) in enumerate(items):
-            if failed:
-                break  # ok=0 rows already zeroed; rewind handles rest
-            B = len(block.transactions)
-            pad = self.batch_pad
-            while pad < B:
-                pad *= 2
-            txd = pack_txd(batch, B, pad)  # global indices: no remap
-            txj = jnp.asarray(txd)
-            (senders, recips, values, fees, required, tx_nonce, offsets,
-             mask, _cb, from_slots, to_slots, amounts) = txd_cols(txj)
-            nb, nn, ok1 = step_fn(
-                self.state.balances, self.state.nonces, senders, recips,
-                values, fees, required, tx_nonce, offsets, mask,
-                int(txd[0, 5]))
-            sv, ok2 = slot_fn(self.state.slot_vals, from_slots,
-                              to_slots, amounts, mask)
-            self.state.balances = nb
-            self.state.nonces = nn
-            self.state.slot_vals = sv
-            if not fetch:
-                continue  # rewind re-apply: state only, no downloads
-            ok = bool(ok1) and bool(ok2)
-            tl, sl = touched_lists[k], slot_lists[k]
-            if tl:
-                ti = jnp.asarray(np.asarray(tl, dtype=np.int32))
-                fetches[k, :len(tl), :u256.LIMBS] = np.asarray(nb[ti])
-                fetches[k, :len(tl), u256.LIMBS] = np.asarray(nn[ti])
-            if sl:
-                si = jnp.asarray(np.asarray(sl, dtype=np.int32))
-                fetches[k, t_pad:t_pad + len(sl), :u256.LIMBS] = \
-                    np.asarray(sv[si])
-            fetches[k, -1, 0] = 1 if ok else 0
-            failed = not ok
+        perm = interleave_txs(txds.shape[1], self._n_shards)
+        new_bal, new_non, new_sv, fetches = self._mesh_window(
+            prev[0], prev[1], prev[2], jnp.asarray(acct_rows),
+            jnp.asarray(slot_rows), jnp.asarray(txds[:, perm]),
+            jnp.asarray(t_idxs), jnp.asarray(s_idxs))
+        self.state.balances = new_bal
+        self.state.nonces = new_non
+        self.state.slot_vals = new_sv
+        if fetch:
+            # windowed device read, same as the single-device path
+            try:
+                fetches.copy_to_host_async()
+                self.stats.reads_prefetched += 1
+            except AttributeError:
+                pass
         self.stats.t_device += time.monotonic() - t0
         return dict(items=items, prev=prev, fetches=fetches,
                     touched_lists=touched_lists, slot_lists=slot_lists,
-                    t_pad=t_pad, flushed=flushed)
+                    t_pad=t_idxs.shape[1], flushed=flushed)
 
     def _issue_window(self, items: List[Tuple[Block, dict]]) -> dict:
         """One device call for a whole run of transfer blocks: upload the
@@ -1262,6 +1301,34 @@ class ReplayEngine:
         # from the trie.
         return None
 
+    def _rebuild_device_rows(self) -> None:
+        """Rebuild every device-table row from the authoritative host
+        state (engine trie + slot_host): used on rewind when a capacity
+        growth landed while a window was speculatively in flight — the
+        failed window's array snapshot then has a stale shape (and, on
+        a mesh, stale shard-arena rows, which move on growth)."""
+        st = self.state
+        st._staged = []
+        st._staged_slots = []
+        bal = np.zeros((st.capacity, u256.LIMBS), dtype=np.int32)
+        non = np.zeros((st.capacity,), dtype=np.int32)
+        for idx, addr in enumerate(st.addrs):
+            raw = self.trie.get(addr)
+            if raw is None:
+                continue
+            a = StateAccount.from_rlp(raw)
+            if a.balance or a.nonce:
+                bal[st.row_of[idx]] = u256.pack_np([a.balance])[0]
+                non[st.row_of[idx]] = a.nonce
+        st.balances = jnp.asarray(bal)
+        st.nonces = jnp.asarray(non)
+        sv = np.zeros((st.slot_capacity, u256.LIMBS), dtype=np.int32)
+        for s_idx in range(1, len(st.slot_keys)):
+            v = st.slot_host[s_idx]
+            if v:
+                sv[st.slot_row_of[s_idx]] = u256.pack_np([v])[0]
+        st.slot_vals = jnp.asarray(sv)
+
     def _recover_window(self, win, arr, k: int, blocks, start_idx: int) -> int:
         """Block k of the window failed the device validation: the valid
         prefix [0, k) has already been folded into the trie by the loop
@@ -1269,24 +1336,32 @@ class ReplayEngine:
         valid prefix on device, then run block k through the exact host
         path.  Returns the next block index to resume issuing from."""
         self._slot_overlay.clear()  # discard the pending window's sim
-        (self.state.balances, self.state.nonces,
-         self.state.slot_vals) = win["prev"]
-        if k > 0:
-            items = win["items"][:k]
-            if self.mesh is not None:
-                # state-only re-apply; no per-block host downloads
-                self._issue_window_mesh(items, fetch=False)
-            else:
-                (txds, t_idxs, s_idxs, acct_gids, slot_gids, _,
-                 _, _) = self._prepare_window(items)
-                new_bal, new_non, new_sv, _ = _transfer_window(
-                    self.state.balances, self.state.nonces,
-                    self.state.slot_vals, jnp.asarray(acct_gids),
-                    jnp.asarray(slot_gids), jnp.asarray(txds),
-                    jnp.asarray(t_idxs), jnp.asarray(s_idxs))
-                self.state.balances = new_bal
-                self.state.nonces = new_non
-                self.state.slot_vals = new_sv
+        if (win["prev"][0].shape[0] != self.state.capacity
+                or win["prev"][2].shape[0] != self.state.slot_capacity):
+            # a table growth landed after this window was issued: the
+            # snapshot's layout is stale — rebuild the rows from the
+            # host state at the already-folded valid prefix instead of
+            # restoring + replaying it on device
+            self._rebuild_device_rows()
+        else:
+            (self.state.balances, self.state.nonces,
+             self.state.slot_vals) = win["prev"]
+            if k > 0:
+                items = win["items"][:k]
+                if self.mesh is not None:
+                    # state-only re-apply; no per-block host downloads
+                    self._issue_window_mesh(items, fetch=False)
+                else:
+                    (txds, t_idxs, s_idxs, acct_gids, slot_gids, _,
+                     _, _) = self._prepare_window(items)
+                    new_bal, new_non, new_sv, _ = _transfer_window(
+                        self.state.balances, self.state.nonces,
+                        self.state.slot_vals, jnp.asarray(acct_gids),
+                        jnp.asarray(slot_gids), jnp.asarray(txds),
+                        jnp.asarray(t_idxs), jnp.asarray(s_idxs))
+                    self.state.balances = new_bal
+                    self.state.nonces = new_non
+                    self.state.slot_vals = new_sv
         self._fallback(blocks[start_idx + k])
         return start_idx + k + 1
 

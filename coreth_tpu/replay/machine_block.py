@@ -617,8 +617,19 @@ class MachineBlockExecutor:
         e = self.e
         if (self._runner is None or self._runner_fork != self._fork
                 or self._runner_epoch != e.storage_epoch):
-            self._runner = MachineWindowRunner(
-                self._fork, self._base_value)
+            if (getattr(e, "mesh", None) is not None and bool(int(
+                    os.environ.get("CORETH_SHARD_OCC", "1")))):
+                # dp mesh: per-shard slot tables + per-shard OCC inside
+                # shard_map, with the collective exchange step
+                # (evm/device/shard.py); CORETH_SHARD_OCC=0 keeps the
+                # replicated single-chip runner for A/B comparison
+                from coreth_tpu.evm.device.shard import (
+                    ShardedWindowRunner)
+                self._runner = ShardedWindowRunner(
+                    self._fork, self._base_value, e.mesh)
+            else:
+                self._runner = MachineWindowRunner(
+                    self._fork, self._base_value)
             self._runner_fork = self._fork
         self._runner_epoch = e.storage_epoch
         return self._runner
@@ -687,12 +698,35 @@ class MachineBlockExecutor:
         e.stats.t_device += time.monotonic() - t0
         while ci < len(chunks):
             chunk = chunks[ci]
+            # sharded runner: the collective exchange tensor (tiny) is
+            # fetched FIRST; if every shard committed clean and the
+            # next window provably needs no table rebuild, its
+            # per-shard dispatch goes out BEFORE this window's packed
+            # results are fetched — the cross-shard exchange overlaps
+            # the next window's dispatch (pinned by the EVENT_LOG
+            # ordering test).  The mirror still learns this window's
+            # writes before any future rebuild: can_pipeline proved
+            # the early dispatch itself cannot rebuild.
+            early = None
+            next_items = self._window_items(chunks[ci + 1]) \
+                if ci + 1 < len(chunks) else None
+            if next_items is not None and hasattr(runner, "poll_clean"):
+                t0 = time.monotonic()
+                if (runner.poll_clean(inflight)
+                        and runner.can_pipeline(next_items)):
+                    early = runner.issue(next_items)
+                e.stats.t_device += time.monotonic() - t0
             t0 = time.monotonic()
             wres = runner.complete(inflight)
             e.stats.t_device += time.monotonic() - t0
             inflight = None
             self.windows += 1
             self.window_attempts += wres.attempts
+            if early is not None and not all(wres.clean):
+                # cannot happen (a clean exchange implies clean packed
+                # results); distrust the device table if it ever does
+                runner.invalidate()
+                early = None
             # pipeline: issue the NEXT chunk before folding this one —
             # its base state is the device-resident table, so the
             # dispatch needs nothing from the folds below.  The
@@ -714,10 +748,12 @@ class MachineBlockExecutor:
                                 writes[(pl.to, key)] = v
                     runner.commit_block(writes)
                 pre_committed = True
-                t0 = time.monotonic()
-                inflight = runner.issue(
-                    self._window_items(chunks[ci + 1]))
-                e.stats.t_device += time.monotonic() - t0
+                if early is not None:
+                    inflight = early
+                else:
+                    t0 = time.monotonic()
+                    inflight = runner.issue(next_items)
+                    e.stats.t_device += time.monotonic() - t0
             for k, (block, plans) in enumerate(chunk):
                 if wres.clean[k]:
                     call_idx = [i for i, pl in enumerate(plans)

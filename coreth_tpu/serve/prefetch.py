@@ -26,6 +26,7 @@ streaming bench is "prefetch-hit or overlap counter > 0"):
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List
 
@@ -38,6 +39,7 @@ class Prefetcher:
     def __init__(self, engine):
         self.e = engine
         self.sigs = 0
+        self.shard_sigs = 0   # recovered via the mesh-sharded ladder
         self.code_touches = 0
         self.busy_s = 0.0
 
@@ -46,10 +48,49 @@ class Prefetcher:
         todo = sum(1 for b in blocks for tx in b.transactions
                    if tx.cached_sender() is None)
         if todo:
-            self.e.warm_senders(blocks)
+            if not self._shard_recover(blocks):
+                self.e.warm_senders(blocks)
             self.sigs += todo
         self._touch_code(blocks)
         self.busy_s += time.monotonic() - t0
+
+    def _shard_recover(self, blocks: List[Block]) -> bool:
+        """CORETH_SHARD_RECOVER=1 + a dp mesh: recover this chunk's
+        senders on the device-sharded ECDSA ladder (parallel/mesh.py
+        sharded_recover — the signature batch fans out across shards)
+        instead of the native host batch.  Falls back (returns False)
+        whenever the mesh path cannot serve the batch, so recovery
+        semantics never change — only the engine doing the work.
+        Parity with the native path is pinned by tests/test_shard_replay."""
+        if not bool(int(os.environ.get("CORETH_SHARD_RECOVER", "0"))):
+            return False
+        e = self.e
+        # _recover_kernel owns the eligibility rule (mesh present,
+        # pad-floor divisibility): None means no sharded ladder
+        kernel = e._recover_kernel() if hasattr(e, "_recover_kernel") \
+            else None
+        if kernel is None:
+            return False
+        t0 = time.monotonic()
+        try:
+            todo, hashes, rs, ss, recids = e._pack_sigs(blocks)
+            if not todo:
+                return True
+            from coreth_tpu.crypto.secp_device import (
+                complete_recover, issue_recover)
+            ctxs = issue_recover(hashes, rs, ss, recids, kernel=kernel)
+            out, ok = complete_recover(ctxs)
+            if out is None:
+                return False
+            e._apply_recovered(todo, out, ok)
+            self.shard_sigs += len(todo)
+            return True
+        except Exception:  # noqa: BLE001 — advisory: host path recovers
+            return False
+        finally:
+            # keep the engine's phase attribution honest: this IS
+            # sender-recovery time, same as warm_senders accounts it
+            e.stats.t_sender += time.monotonic() - t0
 
     def _touch_code(self, blocks: List[Block]) -> None:
         """Pull callee bytecode for call-shaped txs into the rawdb read

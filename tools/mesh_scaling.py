@@ -87,7 +87,21 @@ def run_once(genesis, wire, mesh):
     dt = time.monotonic() - t0
     assert root == blocks[-1].header.root
     assert eng.stats.blocks_fallback == 0
-    return N_BLOCKS * TXS / dt
+    return N_BLOCKS * TXS / dt, dt
+
+
+def _emit_partial(result, out):
+    """Unconditional per-point emission (the bench.py pattern, PR 6): a
+    wedged later point cannot lose the already-measured curve — each
+    completed point flushes a partial JSON line to stderr AND the state
+    file next to the artifact."""
+    line = json.dumps(dict(result, partial=True))
+    print(line, file=sys.stderr, flush=True)
+    try:
+        with open(out + ".partial", "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
 
 
 def main():
@@ -105,26 +119,40 @@ def main():
         "reps": REPS,
         "points": [],
     }
+    out = os.environ.get(
+        "SCALE_OUT", os.path.join(_DIR, "MULTICHIP_SCALING.json"))
     for n in (1, 2, 4, 8):
         mesh = make_mesh(devices[:n]) if n > 1 else None
         runs = []
+        cold_s = 0.0
         for r in range(REPS + 1):
-            tps = run_once(genesis, wire, mesh)
-            if r > 0:          # rep 0 = compile warm-up
+            tps, dt = run_once(genesis, wire, mesh)
+            if r > 0:          # rep 0 = compile warm-up, excluded
                 runs.append(tps)
+            else:
+                cold_s = dt
         runs.sort()
+        median = runs[len(runs) // 2]
+        # compile cost = the cold rep's wall time beyond a warm rep
+        warm_s = N_BLOCKS * TXS / median
         result["points"].append({
             "n_devices": n,
-            "txs_s_median": round(runs[len(runs) // 2], 1),
+            "txs_s_median": round(median, 1),
             "txs_s_spread": [round(runs[0], 1), round(runs[-1], 1)],
+            "compile_ms": round(max(0.0, cold_s - warm_s) * 1000, 1),
         })
         print(f"n={n}: {runs}", file=sys.stderr)
+        _emit_partial(result, out)
     # SCALE_OUT redirects the artifact (bench.py's deadline-budgeted
     # truncated run must not clobber the standalone curve)
-    out = os.environ.get(
-        "SCALE_OUT", os.path.join(_DIR, "MULTICHIP_SCALING.json"))
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
+    try:
+        # the final artifact supersedes the crash-recovery state; a
+        # leftover .partial would read as a live truncated curve
+        os.remove(out + ".partial")
+    except OSError:
+        pass
     print(json.dumps(result))
 
 
