@@ -607,9 +607,19 @@ def run_tpu(genesis, wire_blocks, txs_per_block, machine_stats=None):
         if machine_stats is not None and hasattr(engine, "_machine"):
             mx = engine._machine
             disp = _adapter.DISPATCH_COUNT - d0
+            mc = mx.machine_counters()
             machine_stats.update(
                 occ_rounds=mx.rounds,
                 host_txs=mx.host_txs,
+                # predicted premaps + recompile-free growth (the CI
+                # gates pin kernel_retraces == 0 and the erc20
+                # dispatches_per_block bound in tier-1)
+                discovery_dispatches=mc["discovery_dispatches"],
+                premap_predicted=mc["premap_predicted"],
+                premap_hit_rate=round(
+                    mc["premap_hits"]
+                    / max(1, mc["premap_predicted"]), 3),
+                kernel_retraces=mc["kernel_retraces"],
                 # which executor served host-side txs: native_txs ran
                 # on the compiled backend (evm/hostexec — serial
                 # short-circuit blocks + natively-served conflict

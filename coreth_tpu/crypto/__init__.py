@@ -4,7 +4,9 @@ Pure-Python reference implementations with a C++ native fast path (built from
 native/, loaded via ctypes).  Device-batched variants live in coreth_tpu.ops.
 """
 
-from coreth_tpu.crypto.keccak import keccak256, keccak256_py, EMPTY_KECCAK
+from coreth_tpu.crypto.keccak import (
+    keccak256, keccak256_many, keccak256_py, EMPTY_KECCAK,
+)
 
 # Try to activate the native fast path; harmless if the library isn't built.
 try:  # pragma: no cover - exercised when native lib present
@@ -13,4 +15,5 @@ try:  # pragma: no cover - exercised when native lib present
 except Exception:  # noqa: BLE001 - any failure leaves the pure-py path active
     pass
 
-__all__ = ["keccak256", "keccak256_py", "EMPTY_KECCAK"]
+__all__ = ["keccak256", "keccak256_many", "keccak256_py",
+           "EMPTY_KECCAK"]

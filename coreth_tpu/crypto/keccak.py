@@ -106,3 +106,23 @@ def set_impl(fn) -> None:
 
 EMPTY_KECCAK = bytes.fromhex(
     "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+
+
+def keccak256_many(msgs) -> list:
+    """Digests for a batch of equal-length messages in ONE native call
+    (coreth_keccak256_batch) when the C++ runtime is loaded, else the
+    per-message path.  The premap predictor hashes every predicted
+    (source-word || slot) pair of a window through this, so prediction
+    costs one ctypes crossing per window instead of one keccak call per
+    candidate key."""
+    msgs = list(msgs)
+    if not msgs:
+        return []
+    from coreth_tpu.crypto import native
+    if native.load() is not None and len(msgs) > 1:
+        stride = max(len(m) for m in msgs)
+        blob = b"".join(m.ljust(stride, b"\x00") for m in msgs)
+        out = native.keccak256_batch(blob, [len(m) for m in msgs],
+                                     stride)
+        return [out[32 * i:32 * i + 32] for i in range(len(msgs))]
+    return [keccak256(m) for m in msgs]

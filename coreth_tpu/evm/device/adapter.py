@@ -13,12 +13,14 @@ against the pre-states it is handed.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax.numpy as jnp
 
+from coreth_tpu.crypto.keccak import keccak256_many
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as T
 from coreth_tpu.ops import u256
@@ -45,6 +47,41 @@ def word16(v: int) -> np.ndarray:
     """u256 int -> 16 little-endian int32 limbs (the machine layout)."""
     return np.frombuffer(
         v.to_bytes(32, "little"), dtype=np.uint16).astype(np.int32)
+
+
+def _norm_slot_key(key: bytes) -> bytes:
+    """Normal-storage partition of a raw 32-byte slot key: bit 0 of
+    byte 0 cleared — the twin of statedb.normalize_state_key and of the
+    machine's limb-15 `& 0xFEFF` mask, applied host-side to predicted
+    keccak keys so they compare equal to the keys the kernel reports."""
+    return bytes([key[0] & 0xFE]) + key[1:]
+
+
+def _cd_word(data: bytes, w: int) -> bytes:
+    """ABI calldata word `w` (32 bytes past the 4-byte selector),
+    zero-padded exactly like CALLDATALOAD past the end."""
+    word = data[4 + 32 * w:4 + 32 * w + 32]
+    return word + b"\x00" * (32 - len(word))
+
+
+_STATIC_PREMAP: Dict[bytes, Tuple[bytes, ...]] = {}
+
+
+def _static_premap(code: bytes) -> Tuple[bytes, ...]:
+    """PUSH-constant storage footprint of `code` as normalized premap
+    keys (census.static_storage_keys — the swap pool's reserve slots),
+    () when any key is computed.  Statically-footprinted contracts
+    premap with no discovery cycle at all."""
+    cached = _STATIC_PREMAP.get(code)
+    if cached is None:
+        from coreth_tpu.evm.census import static_storage_keys
+        ks = static_storage_keys(code)
+        out: Dict[bytes, None] = {}
+        if ks is not None:
+            for k in ks[0] + ks[1]:
+                out[_norm_slot_key(k)] = None
+        cached = _STATIC_PREMAP[code] = tuple(out)
+    return cached
 
 
 @dataclass
@@ -381,14 +418,27 @@ class MachineWindowRunner:
     - ``table``/``key_tab``: the device-resident value/key tables; the
       value table is DONATED through each dispatch so the
       window-to-window handoff aliases HBM instead of copying;
+    - ``recipes``: per-contract, selector-scoped PREMAP PREDICTORS
+      learned from misses — a recipe (selector, "caller"|"data"+word,
+      slot) says lanes calling `selector` touch
+      ``keccak(pad32(source) || pad32(slot))`` (the Solidity
+      mapping rule); applying a lane's recipes to ITS OWN calldata
+      derives the keccak-keyed slots it will touch BEFORE dispatch, so
+      erc20-style fresh recipients no longer pay the miss-and-rerun
+      second dispatch every window.  PUSH-constant footprints
+      (census.static_storage_keys — the swap reserves) premap with no
+      learning at all;
     - ``common``: per-contract keys observed in every lane so far (the
-      premap heuristic — e.g. the swap pool's two reserve slots — that
-      lets steady-state windows run in ONE dispatch; keys outside the
-      premap surface as F_MISS escapes and are resolved by a bounded
-      re-dispatch loop, the window-level miss-and-rerun idiom).
+      residual heuristic for keys neither static nor keccak-derivable;
+      anything still outside the premap surfaces as an F_MISS escape
+      and resolves through the bounded re-dispatch loop, counted in
+      ``discovery_dispatches``).
     """
 
-    COMMON_CAP = 8  # premapped common keys per contract
+    COMMON_CAP = 8   # premapped common keys per contract
+    RECIPE_CAP = 8   # learned keccak recipes per contract
+    SLOT_SCAN = 4    # mapping slot indices a miss is explained against
+    DATA_WORDS = 4   # calldata words considered as mapping sources
 
     def __init__(self, fork: str,
                  storage_resolver: Callable[[bytes, bytes], int],
@@ -402,16 +452,47 @@ class MachineWindowRunner:
         # contract -> {key32: None} (dict-as-ordered-set: deterministic
         # iteration, unlike a set)
         self.common: Dict[bytes, Dict[bytes, None]] = {}
+        # contract -> {recipe: None}; recipe =
+        # (selector, "caller", slot) | (selector, "data", word, slot)
+        # — selector-scoped so one function's mapping pattern never
+        # predicts (and permanently maps) keys for another's lanes
+        self.recipes: Dict[bytes, Dict[tuple, None]] = {}
         self.table = None
         self.key_tab = None
         self.table_cap = 0
         self._synced = 0          # gids present in the device tables
         self._stale = True        # device table != mirror: full rebuild
+        # predicted premaps + pre-bucketed recompile-free growth are
+        # each independently A/B-able (the equivalence tests pin the
+        # legacy miss-and-rerun / rebuild-and-retrace paths)
+        self._predict = bool(int(os.environ.get(
+            "CORETH_PREMAP_PREDICT", "1")))
+        self._prebucket = bool(int(os.environ.get(
+            "CORETH_GROWTH_PREBUCKET", "1")))
+        self._hw: Dict[str, int] = {}   # sticky pow2 shape high-water
+        self._hw_feats: frozenset = frozenset()
+        self._dispatched = 0
+        # kernel buckets this runner has used or pre-warmed; a dispatch
+        # outside the set after the first window is a mid-run retrace
+        self._buckets_used: set = set()
+        # arena floor projected from a short lead window (see _prewarm)
+        self._table_floor = 0
+        # cold start spans the FIRST window including its discovery
+        # attempts (their scache/shape buckets are first-compile cost,
+        # not regressions); retraces count from the second window on
+        self._cold = True
+        # ---- counters (surfaced via machine stats + bench)
+        self.premap_predicted = 0   # predicted keys seeded into premaps
+        self.premap_hits = 0        # predicted keys lanes then touched
+        self.discovery_dispatches = 0  # re-dispatches for missed keys
+        self.kernel_retraces = 0    # mid-run compiles at dispatch time
 
     # ------------------------------------------------------------ state
     def reset(self) -> None:
         """Drop every mapping and device buffer (another execution path
-        rewrote storage: mirror values can no longer be trusted)."""
+        rewrote storage: mirror values can no longer be trusted).
+        Learned recipes survive — they derive keys from code+calldata
+        shape, not from any storage value."""
         self.slot_gid.clear()
         self.gid_keys = []
         self.vals = []
@@ -454,6 +535,51 @@ class MachineWindowRunner:
             self.vals.append(self.resolver(contract, key))
         return g
 
+    def _key_mapped(self, contract: bytes, key: bytes) -> bool:
+        return (contract, key) in self.slot_gid
+
+    def _mapped_rows(self) -> int:
+        """Rows the (largest) table arena must hold right now."""
+        return len(self.vals)
+
+    # -------------------------------------------------------- prediction
+    def _learn_recipes(self, t: TxSpec, missed: List[bytes]) -> None:
+        """Explain a lane's missed keys as
+        ``keccak(pad32(source) || pad32(slot))`` over the lane's caller
+        and calldata words (the Solidity mapping rule); every match
+        becomes a recipe that derives FUTURE lanes' keys from their own
+        inputs before dispatch.  One erc20 discovery cycle teaches
+        ("caller", 0) and ("data", 0, 0) — from then on fresh
+        recipients premap without a second dispatch."""
+        if not self._predict or not missed:
+            return
+        recipes = self.recipes.setdefault(t.address, {})
+        if len(recipes) >= self.RECIPE_CAP:
+            return
+        # recipes are scoped to the calldata SELECTOR they were learned
+        # from: a transfer()-derived mapping recipe must not predict
+        # keys for approve()/burn() lanes of the same contract (each
+        # wrong prediction would claim a permanent table row)
+        sel = bytes(t.calldata[:4])
+        srcs: List[Tuple[tuple, bytes]] = [
+            (("caller",), b"\x00" * 12 + t.caller)]
+        n_words = min(self.DATA_WORDS,
+                      max(0, (len(t.calldata) - 4 + 31) // 32))
+        for w in range(n_words):
+            srcs.append((("data", w), _cd_word(t.calldata, w)))
+        msgs = [src + slot.to_bytes(32, "big")
+                for _tag, src in srcs
+                for slot in range(self.SLOT_SCAN)]
+        digs = keccak256_many(msgs)
+        want = dict.fromkeys(missed)
+        i = 0
+        for tag, _src in srcs:
+            for slot in range(self.SLOT_SCAN):
+                if _norm_slot_key(digs[i]) in want \
+                        and len(recipes) < self.RECIPE_CAP:
+                    recipes[(sel,) + tag + (slot,)] = None
+                i += 1
+
     # ------------------------------------------------------------- shape
     def _occ_params(self, items, premaps):
         feats = set()
@@ -487,10 +613,56 @@ class MachineWindowRunner:
             blocks=_pow2(len(items), 1),
             table_cap=_pow2(len(self.vals) + unmapped + 1, 64),
             rounds=p.batch + 1)
+        return self._apply_buckets(p, occ)
+
+    def _apply_buckets(self, p: M.MachineParams,
+                       occ: M.OccParams) -> Tuple:
+        """Sticky pow2 shape buckets (CORETH_GROWTH_PREBUCKET): every
+        bucket dimension only ratchets UP across a runner's lifetime —
+        a shrinking tail window (fewer blocks/lanes, a feature-free
+        batch) reuses the already-compiled kernel instead of tracing a
+        smaller sibling, and the table arena never re-buckets downward
+        (growth pads the donated HBM tables on device, see
+        _device_tables).  Extra features / inactive lanes are
+        semantically free: features only add compiled op families, and
+        inactive lanes exit the OCC loop immediately."""
+        if not self._prebucket:
+            return p, occ
+        hw = self._hw
+        feats = frozenset(p.features | self._hw_feats)
+        self._hw_feats = feats
+        p = M.MachineParams(
+            fork=p.fork,
+            batch=max(p.batch, hw.get("batch", 0)),
+            code_cap=max(p.code_cap, hw.get("code_cap", 0)),
+            data_cap=max(p.data_cap, hw.get("data_cap", 0)),
+            scache_cap=max(p.scache_cap, hw.get("scache_cap", 0)),
+            features=feats)
+        occ = M.OccParams(
+            blocks=max(occ.blocks, hw.get("blocks", 0)),
+            table_cap=max(occ.table_cap, self.table_cap,
+                          self._table_floor),
+            rounds=p.batch + 1)
+        hw.update(batch=p.batch, code_cap=p.code_cap,
+                  data_cap=p.data_cap, scache_cap=p.scache_cap,
+                  blocks=occ.blocks)
         return p, occ
 
     def _device_tables(self, G: int):
         n = len(self.vals)
+        if (self._prebucket and self.table is not None
+                and not self._stale and G > self.table_cap):
+            # recompile-free cap re-bucket: PAD the resident (donated)
+            # tables on device — no host-mirror round trip, and the
+            # pre-warmed bigger-bucket kernel (see _prewarm) takes the
+            # next dispatch without a trace
+            pad = G - self.table_cap
+            z = jnp.zeros((pad, u256.LIMBS), dtype=jnp.int32)
+            self.table = jnp.concatenate([self.table, z])
+            self.key_tab = jnp.concatenate(
+                [self.key_tab, jnp.zeros((pad, u256.LIMBS),
+                                         dtype=jnp.int32)])
+            self.table_cap = G
         if self.table is None or self.table_cap != G or self._stale:
             tv = np.zeros((G, u256.LIMBS), dtype=np.int32)
             tk = np.zeros((G, u256.LIMBS), dtype=np.int32)
@@ -517,22 +689,67 @@ class MachineWindowRunner:
         return self.table, self.key_tab
 
     def _premaps(self, items, discovered):
-        """Per-lane premapped key lists (common-key heuristic + seeded
-        storage + keys discovered by earlier attempts)."""
+        """Per-lane premapped key lists: PREDICTED keys first (the
+        static PUSH-constant footprint + learned keccak recipes applied
+        to the lane's own caller/calldata), then the seeded storage
+        view, the common-key residue, and keys discovered by earlier
+        attempts.  Every recipe keccak of the whole window goes through
+        ONE batched call (crypto.keccak256_many ->
+        coreth_keccak256_batch).  Returns (premaps, predicted) where
+        ``predicted[bi][li]`` is the prediction-only key set (hit-rate
+        accounting in _update_common)."""
+        msgs: List[bytes] = []
+        meta: List[List[List[tuple]]] = []
+        if self._predict:
+            for _env, specs in items:
+                block_meta = []
+                for t in specs:
+                    sel = bytes(t.calldata[:4])
+                    lane = [rc for rc
+                            in self.recipes.get(t.address, ())
+                            if rc[0] == sel]
+                    for rc in lane:
+                        if rc[1] == "caller":
+                            src, slot = b"\x00" * 12 + t.caller, rc[2]
+                        else:
+                            src, slot = _cd_word(t.calldata,
+                                                 rc[2]), rc[3]
+                        msgs.append(src + slot.to_bytes(32, "big"))
+                    block_meta.append(lane)
+                meta.append(block_meta)
+        digs = keccak256_many(msgs)
+        di = 0
         premaps = []
-        for (_env, specs), disc in zip(items, discovered):
+        predicted = []
+        for bi, ((_env, specs), disc) in enumerate(
+                zip(items, discovered)):
             block_pre = []
+            block_predicted = []
             for li, t in enumerate(specs):
                 keys: Dict[bytes, None] = {}
+                pred: Dict[bytes, None] = {}
+                if self._predict:
+                    for k in _static_premap(t.code):
+                        keys[k] = None
+                        pred[k] = None
+                    for _rc in meta[bi][li]:
+                        k = _norm_slot_key(digs[di])
+                        di += 1
+                        keys[k] = None
+                        pred[k] = None
                 for k in self.common.get(t.address, ()):
                     keys[k] = None
                 for k in t.storage:
                     keys[k] = None
+                    pred.pop(k, None)
                 for k in disc[li]:
                     keys[k] = None
+                    pred.pop(k, None)
                 block_pre.append(list(keys))
+                block_predicted.append(pred)
             premaps.append(block_pre)
-        return premaps
+            predicted.append(block_predicted)
+        return premaps, predicted
 
     # ------------------------------------------------------------- issue
     def issue(self, items, discovered=None, attempt: int = 1) -> dict:
@@ -545,7 +762,7 @@ class MachineWindowRunner:
         """
         if discovered is None:
             discovered = [[{} for _t in specs] for _env, specs in items]
-        premaps = self._premaps(items, discovered)
+        premaps, predicted = self._premaps(items, discovered)
         p, occ = self._occ_params(items, premaps)
         W, L, S, G = occ.blocks, p.batch, p.scache_cap, occ.table_cap
 
@@ -614,24 +831,153 @@ class MachineWindowRunner:
             basefee_w=jnp.asarray(basefee_w),
             chainid_w=jnp.asarray(word16(chain_id)),
         )
-        fn = M.get_occ_machine(p, occ)
+        fn = self._get_kernel(p, occ)
         _count_dispatch()
         out = fn(table, key_tab, inputs)
         # the input table was donated into the dispatch; the output
         # handle (post-window committed state) replaces it
         self.table = out["table"]
+        self._dispatched += 1
+        self._prewarm(p, occ, n_blocks=len(items))
         return dict(out=out, items=items, discovered=discovered, p=p,
-                    occ=occ, premaps=premaps, attempt=attempt)
+                    occ=occ, premaps=premaps, predicted=predicted,
+                    attempt=attempt)
+
+    # ------------------------------------------------------------ kernels
+    def seed_window_hint(self, blocks: int) -> None:
+        """Executor hint: steady-state windows hold `blocks` machine
+        blocks — bucket the scan axis there from the FIRST dispatch so
+        a short leading window (replay_block's single block) doesn't
+        compile a small sibling that the first full window then
+        re-buckets.  Inactive trailing blocks exit the OCC loop on the
+        first condition check, so over-bucketing costs ~nothing."""
+        if self._prebucket:
+            self._hw["blocks"] = max(self._hw.get("blocks", 0),
+                                     _pow2(max(1, blocks), 1))
+
+    def _kernel(self, p: M.MachineParams, occ: M.OccParams):
+        return M.get_occ_machine(p, occ)
+
+    def _kernel_compiled(self, p: M.MachineParams,
+                         occ: M.OccParams) -> bool:
+        return M.occ_compiled(p, occ)
+
+    def _get_kernel(self, p: M.MachineParams, occ: M.OccParams):
+        """Kernel for a dispatch, accounting retraces: a shape bucket
+        this runner first reaches AFTER its first dispatch — without
+        having pre-warmed it — is a mid-run retrace (the
+        recompile-regression test pins this at zero on the pre-bucketed
+        path; the legacy path pays one per cap bucket).  Tracked
+        per-runner, not via the process-global kernel cache, so the
+        count is deterministic across bench reps and test order."""
+        key = (p, occ)
+        if key not in self._buckets_used:
+            self._buckets_used.add(key)
+            if not self._cold:
+                self.kernel_retraces += 1
+        return self._kernel(p, occ)
+
+    def _lane_count(self, p: M.MachineParams) -> int:
+        return p.batch
+
+    def _table_rows(self, G: int) -> int:
+        return G
+
+    def _warm_args(self, p: M.MachineParams, occ: M.OccParams):
+        """All-inactive zero inputs of a (p, occ) bucket: dispatching
+        them compiles the bucket while costing ~no device time (every
+        while_loop exits on the first condition check)."""
+        W, S, G = occ.blocks, p.scache_cap, occ.table_cap
+        L = self._lane_count(p)
+        rows = self._table_rows(G)
+        i32 = jnp.int32
+        word = jnp.zeros((W, L, u256.LIMBS), dtype=i32)
+        inputs = dict(
+            code=jnp.zeros((W, L, p.code_cap + 33), dtype=i32),
+            jdest=jnp.zeros((W, L, p.code_cap), dtype=i32),
+            code_len=jnp.zeros((W, L), dtype=i32),
+            calldata=jnp.zeros((W, L, p.data_cap), dtype=i32),
+            data_len=jnp.zeros((W, L), dtype=i32),
+            start_gas=jnp.zeros((W, L), dtype=i32),
+            active=jnp.zeros((W, L), dtype=bool),
+            sgid=jnp.full((W, L, S), G, dtype=i32),
+            callvalue=word, caller_w=word, address_w=word,
+            origin_w=word, gasprice_w=word,
+            timestamp=jnp.zeros((W,), dtype=i32),
+            number=jnp.zeros((W,), dtype=i32),
+            gaslimit=jnp.zeros((W,), dtype=i32),
+            coinbase_w=jnp.zeros((W, u256.LIMBS), dtype=i32),
+            basefee_w=jnp.zeros((W, u256.LIMBS), dtype=i32),
+            chainid_w=jnp.zeros((u256.LIMBS,), dtype=i32),
+        )
+        table = jnp.zeros((rows, u256.LIMBS), dtype=i32)
+        key_tab = jnp.zeros((rows, u256.LIMBS), dtype=i32)
+        return table, key_tab, inputs
+
+    def _prewarm(self, p: M.MachineParams, occ: M.OccParams,
+                 n_blocks: Optional[int] = None) -> None:
+        """Compile the NEXT table bucket's kernel while the current
+        window executes: once the arena is half full a cap re-bucket is
+        imminent, and pre-tracing now means the growth dispatch later
+        finds a ready executable — zero mid-run retraces.  A LEAD
+        window shorter than the steady bucket (replay_block's single
+        block ahead of full windows) maps only a fraction of a full
+        window's keys, so the first full window can jump the cap with
+        no half-full warning — prewarm unconditionally behind it.  The
+        warm dispatch runs all-inactive lanes, so it costs one compile
+        (once per bucket), not a window of compute."""
+        if not self._prebucket:
+            return
+        mapped = self._mapped_rows()
+        steady = self._hw.get("blocks", occ.blocks)
+        lead = _pow2(max(1, n_blocks), 1) if n_blocks else steady
+        if lead < steady and mapped:
+            # a lead window maps ~lead/steady of a full window's keys:
+            # project the full-size arena linearly and PIN it as the
+            # arena floor, so the first full window lands exactly on
+            # the bucket warmed here (projection overshoot costs rows,
+            # never a retrace; clamp bounds the HBM bet)
+            self._table_floor = max(self._table_floor, min(
+                _pow2(mapped * (steady // lead) + 1, 64), 1 << 20))
+        if self._table_floor <= occ.table_cap \
+                and 2 * mapped < occ.table_cap:
+            return
+        nxt = M.OccParams(blocks=occ.blocks,
+                          table_cap=max(occ.table_cap * 2,
+                                        self._table_floor),
+                          rounds=occ.rounds)
+        if (p, nxt) in self._buckets_used:
+            return
+        self._buckets_used.add((p, nxt))
+        if self._kernel_compiled(p, nxt):
+            return  # cache-warm from an earlier runner/rep
+        fn = self._kernel(p, nxt)
+        fn(*self._warm_args(p, nxt))
 
     # ---------------------------------------------------------- complete
+    def _block_stride(self, handle: dict) -> int:
+        """Flat packed rows per block (lane axis width)."""
+        return handle["p"].batch
+
+    def _lane_idx(self, handle: dict, bi: int, li: int) -> int:
+        """In-block lane index of tx li (identity here; the sharded
+        runner places lanes by contract shard via its lane_map)."""
+        return li
+
+    def _on_result_fetch(self, handle: dict) -> None:
+        """Hook for the sharded runner's dispatch-ordering trace."""
+
     def complete(self, handle: dict) -> WindowResult:
         """Fetch a window's results; resolve any storage keys that
-        escaped the premap and re-dispatch (bounded attempts) until the
-        window needs no further key resolution."""
+        escaped the premap, LEARN keccak recipes from them (so future
+        windows predict instead of rediscovering), and re-dispatch
+        (bounded attempts, counted in ``discovery_dispatches``) until
+        the window needs no further key resolution."""
         while True:
             p = handle["p"]
-            L = p.batch
+            Lp = self._block_stride(handle)
             packed = np.asarray(handle["out"]["packed"])
+            self._on_result_fetch(handle)
             pw = packed.shape[2] - 4
             pout = PackedOut(
                 packed[:, :, :pw].reshape(-1, pw), p)
@@ -639,36 +985,50 @@ class MachineWindowRunner:
             missing = False
             for bi, (_env, specs) in enumerate(handle["items"]):
                 for li, t in enumerate(specs):
-                    if not extra[bi, li, 1]:
+                    fl = self._lane_idx(handle, bi, li)
+                    if not extra[bi, fl, 1]:
                         continue  # escaped lanes only carry misses
                     disc = handle["discovered"][bi][li]
-                    for key in miss_keys(pout, bi * L + li):
-                        if (t.address, key) not in self.slot_gid:
+                    fresh: List[bytes] = []
+                    for key in miss_keys(pout, bi * Lp + fl):
+                        if not self._key_mapped(t.address, key):
                             self._gid(t.address, key)
                         if key not in disc:
                             disc[key] = None
+                            fresh.append(key)
                             missing = True
+                    self._learn_recipes(t, fresh)
             if missing and handle["attempt"] < self.max_attempts:
                 # re-run the WHOLE window from the host mirror (the
                 # failed attempt's device table holds partial commits)
+                self.discovery_dispatches += 1
                 self._stale = True
                 handle = self.issue(handle["items"],
                                     handle["discovered"],
                                     attempt=handle["attempt"] + 1)
                 continue
             break
+        self._cold = False
         results, committed, escape, clean, rounds = [], [], [], [], []
         for bi, (_env, specs) in enumerate(handle["items"]):
-            nl = len(specs)
-            res = [result_from_row(pout, bi * L + li)
-                   for li in range(nl)]
-            com = extra[bi, :nl, 0].astype(bool)
-            esc = (extra[bi, :nl, 1] | extra[bi, :nl, 2]).astype(bool)
+            slots = [self._lane_idx(handle, bi, li)
+                     for li in range(len(specs))]
+            res = [result_from_row(pout, bi * Lp + fl) for fl in slots]
+            if slots:
+                com = extra[bi, slots, 0].astype(bool)
+                esc = (extra[bi, slots, 1]
+                       | extra[bi, slots, 2]).astype(bool)
+                # per-shard round counts may differ; report the max
+                rnd = int(extra[bi, slots, 3].max())
+            else:
+                com = np.zeros((0,), dtype=bool)
+                esc = np.zeros((0,), dtype=bool)
+                rnd = 0
             results.append(res)
             committed.append(com)
             escape.append(esc)
-            clean.append(bool(com.all()) if nl else True)
-            rounds.append(int(extra[bi, 0, 3]) if nl else 0)
+            clean.append(bool(com.all()) if slots else True)
+            rounds.append(rnd)
         self._update_common(handle, pout, clean)
         return WindowResult(results=results, committed=committed,
                             escape=escape, clean=clean, rounds=rounds,
@@ -676,20 +1036,28 @@ class MachineWindowRunner:
 
     def _update_common(self, handle, pout: PackedOut,
                        clean: List[bool]) -> None:
-        """Narrow each contract's premap heuristic to the keys EVERY
-        lane touched (the shared-slot contention shape: e.g. a swap
-        pool's reserves) so the next window premaps them up front."""
-        L = handle["p"].batch
+        """Count predicted-premap keys and hits (both against the
+        FINAL attempt's prediction sets, so premap_hit_rate pairs a
+        window's numerator and denominator even when discovery
+        re-dispatched it), and narrow each contract's residual
+        common-key set to the keys EVERY lane touched (the shared-slot
+        contention shape prediction cannot derive)."""
+        Lp = self._block_stride(handle)
+        predicted = handle.get("predicted")
         for bi, (_env, specs) in enumerate(handle["items"]):
             if not clean[bi]:
                 continue
             for li, t in enumerate(specs):
-                row = bi * L + li
+                row = bi * Lp + self._lane_idx(handle, bi, li)
                 touched: Dict[bytes, None] = {}
                 for j in range(int(pout.scnt[row])):
                     fl = int(pout.sflag[row, j])
                     if fl & (M.F_READ | M.F_WRITTEN):
                         touched[_key_bytes(pout.skey[row, j])] = None
+                if predicted is not None:
+                    self.premap_predicted += len(predicted[bi][li])
+                    self.premap_hits += sum(
+                        1 for k in predicted[bi][li] if k in touched)
                 cur = self.common.get(t.address)
                 if cur is None:
                     keep = list(touched)[:self.COMMON_CAP]

@@ -1104,6 +1104,24 @@ def build_occ_machine(params: MachineParams, occ: OccParams):
 
 _OCC_MACHINES: Dict[Tuple[MachineParams, OccParams], object] = {}
 
+# Fused-OCC kernel builds this process has paid (each new
+# (MachineParams, OccParams) bucket = one jax trace + XLA compile).
+# The recompile-regression test pins this across a forced table-cap
+# growth: the pre-bucketed growth path must add ZERO builds mid-run.
+OCC_BUILD_COUNT = 0
+
+
+def count_occ_build() -> None:
+    global OCC_BUILD_COUNT
+    OCC_BUILD_COUNT += 1
+
+
+def occ_compiled(params: MachineParams, occ: OccParams) -> bool:
+    """Whether the (params, occ) kernel bucket is already built — the
+    window runner distinguishes cold compiles (first dispatch of a
+    bucket) from mid-run retraces with this."""
+    return (params, occ) in _OCC_MACHINES
+
 
 def get_occ_machine(params: MachineParams, occ: OccParams):
     """Jitted OCC kernel memoized by (machine, occ) params.  The table
@@ -1117,4 +1135,5 @@ def get_occ_machine(params: MachineParams, occ: OccParams):
         fn = jax.jit(build_occ_machine(params, occ),
                      donate_argnums=donate)
         _OCC_MACHINES[key] = fn
+        count_occ_build()
     return fn

@@ -99,6 +99,11 @@ class ReplayStats:
     # windows whose fetch-tensor download was started asynchronously at
     # issue time (the windowed device-read prefetch; serve/prefetch.py)
     reads_prefetched: int = 0
+    # where batched sender recovery ran: the device ECDSA ladder
+    # (single-chip or mesh-sharded — overlapping window execution in
+    # the replay loop) vs the native host batch
+    sigs_device: int = 0
+    sigs_host: int = 0
 
     def row(self) -> dict:
         return dict(self.__dict__)
@@ -511,11 +516,17 @@ class _SenderPipeline:
 
     - device segments dispatch asynchronously into the same FIFO device
       queue as the window scans, so the chip alternates recovery chunks
-      and scans without idling;
+      and scans without idling — a window's senders recover ON DEVICE
+      while the previous window executes;
     - host segments run whole in the engine's recovery worker thread
       (the ctypes C++ batch releases the GIL), sized by the measured
       device/host split — routing whole segments avoids the pow2
       padding waste of splitting each one;
+    - with a dp mesh and ``CORETH_SHARD_RECOVER=1`` the device segments
+      ride the MESH-SHARDED ECDSA ladder (parallel/mesh.py
+      sharded_recover via engine._recover_kernel) even without a real
+      accelerator — batch replay's analog of the serve prefetcher's
+      sharded recovery, with the same parity contract;
     - ensure(i) blocks only until block i's segment is applied.
     """
 
@@ -526,9 +537,17 @@ class _SenderPipeline:
         from coreth_tpu.crypto.secp_device import MAX_CHUNK
         self.engine = engine
         self.have_native = native.load() is not None
-        self.use_device = _has_accelerator()
-        self.split = engine._default_recover_split() if self.use_device \
-            else 0.0
+        # opt-in mesh-sharded recovery in the replay loop (parity with
+        # the native batch pinned by tests/test_batch_recovery.py)
+        self.force_shard = engine._force_shard_recover()
+        self.use_device = _has_accelerator() or self.force_shard
+        if self.force_shard and not _has_accelerator():
+            # virtual mesh on CPU: the point is the sharded ladder, so
+            # give it the whole batch instead of the host-rate split
+            self.split = 1.0
+        else:
+            self.split = engine._default_recover_split() \
+                if self.use_device else 0.0
         self.block_seg: List[int] = []
         self.segments: List[List[Block]] = []
         cur: List[Block] = []
@@ -557,14 +576,17 @@ class _SenderPipeline:
             n = len(recids)
             h["todo"] = todo
             if n:
+                # the sharded-ladder opt-in skips the min-batch/split
+                # gates: its segments must actually exercise the mesh
                 small = n < eng.DEVICE_RECOVER_MIN
-                to_host = self.have_native and (
-                    not self.use_device or small
-                    or self.host_sigs + n <= (1 - self.split)
-                    * (self.dev_sigs + self.host_sigs + n))
+                to_host = self.have_native and not self.force_shard \
+                    and (not self.use_device or small
+                         or self.host_sigs + n <= (1 - self.split)
+                         * (self.dev_sigs + self.host_sigs + n))
                 if to_host:
                     from coreth_tpu.crypto import native
                     self.host_sigs += n
+                    eng.stats.sigs_host += n
                     h["kind"] = "host"
                     h["fut"] = eng._recover_pool_get().submit(
                         native.recover_addresses_batch, hashes, rs, ss,
@@ -573,6 +595,7 @@ class _SenderPipeline:
                     from coreth_tpu.crypto.secp_device import (
                         issue_recover)
                     self.dev_sigs += n
+                    eng.stats.sigs_device += n
                     h["kind"] = "device"
                     h["ctxs"] = issue_recover(
                         hashes, rs, ss, recids,
@@ -839,13 +862,21 @@ class ReplayEngine:
         from coreth_tpu.crypto import native
         n = len(recids)
         have_native = native.load() is not None
-        use_device = n >= self.DEVICE_RECOVER_MIN and _has_accelerator()
+        force_shard = self._force_shard_recover()
+        use_device = force_shard or (
+            n >= self.DEVICE_RECOVER_MIN and _has_accelerator())
         if not use_device:
             if not have_native:
                 return None, None  # per-tx python path in signer.sender
+            self.stats.sigs_host += n
             return native.recover_addresses_batch(hashes, rs, ss, recids)
-        n_dev = n if not have_native \
+        # the sharded opt-in routes the WHOLE batch to the ladder
+        # (matching _SenderPipeline — stats.sigs_device == packed count
+        # is the test/verify contract); otherwise the measured split
+        n_dev = n if (not have_native or force_shard) \
             else int(n * self._default_recover_split())
+        self.stats.sigs_device += n_dev
+        self.stats.sigs_host += n - n_dev
         host_fut = None
         if n_dev < n:
             host_fut = self._recover_pool_get().submit(
@@ -867,6 +898,16 @@ class ReplayEngine:
             from concurrent.futures import ThreadPoolExecutor
             self._recover_pool = ThreadPoolExecutor(max_workers=1)
         return self._recover_pool
+
+    def _force_shard_recover(self) -> bool:
+        """CORETH_SHARD_RECOVER=1 + a usable mesh ladder: the ONE
+        definition of the sharded-recovery opt-in, shared by the replay
+        loop's _SenderPipeline and the packed warm_senders path (the
+        serve prefetcher routes through its own counter but honors the
+        same env)."""
+        return bool(int(os.environ.get(
+            "CORETH_SHARD_RECOVER", "0"))) \
+            and self._recover_kernel() is not None
 
     def _recover_kernel(self):
         """The device recovery kernel: mesh-sharded fan-out when a mesh

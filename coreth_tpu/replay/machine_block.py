@@ -101,6 +101,22 @@ class MachineBlockExecutor:
         self._runner: Optional[MachineWindowRunner] = None
         self._runner_fork: Optional[str] = None
         self._runner_epoch = -1
+        # premap-prediction / recompile-free-growth counters accumulate
+        # across runner rebuilds (an epoch bump discards the runner)
+        self._runner_totals = dict(
+            premap_predicted=0, premap_hits=0,
+            discovery_dispatches=0, kernel_retraces=0)
+
+    def machine_counters(self) -> dict:
+        """Predicted-premap + kernel-retrace counters over every
+        window runner this executor has owned (bench machine section;
+        the CI gates pin kernel_retraces and the discovery rate)."""
+        out = dict(self._runner_totals)
+        r = self._runner
+        if r is not None:
+            for k in out:
+                out[k] += getattr(r, k)
+        return out
 
     # ------------------------------------------------------------ classify
     def classify(self, block: Block) -> Optional[List[TxPlan]]:
@@ -617,6 +633,9 @@ class MachineBlockExecutor:
         e = self.e
         if (self._runner is None or self._runner_fork != self._fork
                 or self._runner_epoch != e.storage_epoch):
+            if self._runner is not None:
+                for k in self._runner_totals:
+                    self._runner_totals[k] += getattr(self._runner, k)
             if (getattr(e, "mesh", None) is not None and bool(int(
                     os.environ.get("CORETH_SHARD_OCC", "1")))):
                 # dp mesh: per-shard slot tables + per-shard OCC inside
@@ -630,6 +649,7 @@ class MachineBlockExecutor:
             else:
                 self._runner = MachineWindowRunner(
                     self._fork, self._base_value)
+            self._runner.seed_window_hint(self.WINDOW)
             self._runner_fork = self._fork
         self._runner_epoch = e.storage_epoch
         return self._runner

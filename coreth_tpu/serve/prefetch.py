@@ -9,7 +9,11 @@ streaming bench is "prefetch-hit or overlap counter > 0"):
   ladder) on the prefetch thread, so by the time the execute stage
   classifies a block its senders are already cached.  ``sigs`` counts
   signatures recovered here; the pipeline's ``prefetch_hits`` counts
-  the txs whose sender the execute stage found pre-cached.
+  the txs whose sender the execute stage found pre-cached.  The
+  device/mesh-sharded ladder is no longer serve-only: batch replay's
+  ``_SenderPipeline`` honors the same ``CORETH_SHARD_RECOVER`` opt-in
+  and overlaps a window's recovery with the previous window's
+  execution (replay/engine.py).
 
 - **Bytecode** : call-shaped txs touch ``db.contract_code`` for their
   callee's code hash so the machine classifier's first read hits the

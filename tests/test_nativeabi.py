@@ -113,6 +113,8 @@ def test_parse_array_params_decay_and_named_params():
 
 
 def test_parse_funcptr_typedef_params():
+    """Callback typedefs parse to FULL signatures (return + params),
+    not just the funcptr kind."""
     exps = c_exports("""
         typedef int (*FetchSlotCb)(const uint8_t* addr20,
                                    const uint8_t* key32, uint8_t* out);
@@ -123,7 +125,8 @@ def test_parse_funcptr_typedef_params():
         }
         }  // extern "C"
     """)
-    assert exps["coreth_sess_new"].params == [U64, FUNCPTR, PTR_BYTES, I32]
+    cb = ("funcptr", I32, (PTR_BYTES, PTR_BYTES, PTR_BYTES))
+    assert exps["coreth_sess_new"].params == [U64, cb, PTR_BYTES, I32]
 
 
 def test_parse_definition_wins_over_declaration():
@@ -195,7 +198,8 @@ def test_parse_ctypes_pointer_cfunctype_and_replication():
                 ctypes.POINTER(ctypes.c_double)]
     """)
     by = {b.symbol: b for b in bs}
-    assert by["coreth_new"].argtypes == [U64, FUNCPTR, PTR_BYTES]
+    cb = ("funcptr", I32, (PTR_BYTES,))
+    assert by["coreth_new"].argtypes == [U64, cb, PTR_BYTES]
     assert by["coreth_test_fe_mul"].argtypes == [PTR_BYTES] * 3
     assert by["coreth_replay"].argtypes == \
         [PTR_BYTES, ("ptr", U64), ("ptr", F64)]
@@ -298,6 +302,65 @@ def test_abi003_wrong_restype():
     assert fs[0].detail == "coreth_open:ret"
 
 
+# ---------------------------------------- callback signature cross-checks
+
+_CB_C = """
+    typedef int (*FetchCb)(const uint8_t* addr20, uint8_t* out32,
+                           uint64_t n);
+    extern "C" {
+    void* coreth_cb_new(FetchCb cb) { return 0; }
+    }  // extern "C"
+"""
+
+
+def _cb_py(sig: str) -> str:
+    return """
+        import ctypes
+        _CB = ctypes.CFUNCTYPE(%s)
+        def load(lib):
+            lib.coreth_cb_new.argtypes = [_CB]
+            lib.coreth_cb_new.restype = ctypes.c_void_p
+    """ % sig
+
+
+def test_callback_signature_match_passes():
+    """CFUNCTYPE matching the C typedef field by field: no findings."""
+    py = _cb_py("ctypes.c_int, ctypes.POINTER(ctypes.c_uint8), "
+                "ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64")
+    assert cross_check(c_exports(_CB_C), bindings(py)) == []
+
+
+def test_abi003_callback_arity_mismatch():
+    """A trampoline one parameter short of the C typedef corrupts the
+    callback frame — kind-level matching used to wave this through."""
+    py = _cb_py("ctypes.c_int, ctypes.POINTER(ctypes.c_uint8), "
+                "ctypes.POINTER(ctypes.c_uint8)")
+    fs = cross_check(c_exports(_CB_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+    assert "funcptr" in fs[0].message
+
+
+def test_abi003_callback_param_width_mismatch():
+    py = _cb_py("ctypes.c_int, ctypes.POINTER(ctypes.c_uint8), "
+                "ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32")
+    fs = cross_check(c_exports(_CB_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+
+
+def test_abi003_callback_return_mismatch():
+    py = _cb_py("None, ctypes.POINTER(ctypes.c_uint8), "
+                "ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64")
+    fs = cross_check(c_exports(_CB_C), bindings(py))
+    assert codes(fs) == ["ABI003"]
+
+
+def test_callback_unparsed_side_degrades_to_kind_level():
+    """A CFUNCTYPE the parser cannot read (keyword args) still counts
+    as a callback — kind-level match, no false positive."""
+    py = _cb_py("ctypes.c_int, use_errno=True")
+    assert cross_check(c_exports(_CB_C), bindings(py)) == []
+
+
 def test_abi004_missing_restype_on_pointer_return():
     py = _GOOD_PY.replace(
         "        lib.coreth_open.restype = ctypes.c_void_p\n", "")
@@ -370,7 +433,8 @@ def test_real_tree_exports_parse():
     assert all(s.startswith("coreth_") for s in exps)
     sess = exps["coreth_hostexec_new"]
     assert sess.ret == PTR_VOID
-    assert FUNCPTR in sess.params
+    cbs = [p for p in sess.params if p[0] == "funcptr"]
+    assert cbs and all(len(cb) == 3 for cb in cbs)  # full signatures
     fold = exps["coreth_trie_fold_storage"]
     assert fold.params == [PTR_VOID, PTR_BYTES, PTR_BYTES, U64, PTR_BYTES]
 

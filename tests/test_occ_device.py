@@ -281,6 +281,85 @@ def test_occ_table_growth_across_pipelined_windows(monkeypatch):
     assert runner.table_cap >= 128               # the cap DID grow
 
 
+def test_occ_predicted_premap_erc20(monkeypatch):
+    """Tentpole CI gate (discovery): erc20-machine blocks with FRESH
+    recipients every block must not pay the miss-and-rerun discovery
+    dispatch per window.  One discovery cycle teaches the keccak
+    recipes ((caller, 0) and (data-word-0, 0)); every later window
+    derives its lanes' mapping keys from their own calldata BEFORE
+    dispatch.  Pins dispatches_per_block <= 1.1 and bit-identical
+    roots vs the prediction-disabled miss-and-rerun path."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen(i, nonces):
+        # fresh recipients every block: computed keccak keys the
+        # common-key heuristic could never premap
+        return [_tx(k, nonces, TOKEN,
+                    transfer_calldata(
+                        bytes([0x80 + i]) + bytes([k]) * 19, 3 + k))
+                for k in range(6)]
+
+    gblock, blocks = _build_chain(8, gen)
+    d0 = ADP.DISPATCH_COUNT
+    eng = _replay(gblock, blocks)
+    disp = ADP.DISPATCH_COUNT - d0
+    mx = eng._machine
+    assert mx.blocks == 8
+    mc = mx.machine_counters()
+    assert mc["premap_predicted"] > 0
+    assert mc["premap_hits"] > 0
+    # only the FIRST window's discovery cycle re-dispatches (two
+    # chained recipes: the sender-slot balance gates reaching the
+    # recipient-slot SSTORE, so learning takes two rounds)
+    assert mc["discovery_dispatches"] <= 2
+    assert disp / mx.blocks <= 1.1
+
+    # equivalence: the miss-and-rerun path lands the same root, paying
+    # a discovery re-dispatch for (almost) every window
+    monkeypatch.setenv("CORETH_PREMAP_PREDICT", "0")
+    legacy = _replay(gblock, blocks)
+    assert legacy.root == eng.root == blocks[-1].root
+    lc = legacy._machine.machine_counters()
+    assert lc["premap_predicted"] == 0
+    assert lc["discovery_dispatches"] > mc["discovery_dispatches"]
+
+
+def test_occ_recompile_free_table_growth(monkeypatch):
+    """Tentpole CI gate (recompiles): a forced table-cap growth
+    (64 -> 128 rows) mid-run.  The pre-bucketed path pads the donated
+    tables ON DEVICE and dispatches through the pre-warmed
+    bigger-bucket kernel — ZERO mid-run retraces.  The legacy path
+    (CORETH_GROWTH_PREBUCKET=0) rebuilds the table from the host
+    mirror and retraces at dispatch time — at most once per pow2
+    bucket crossed.  Roots bit-identical either way."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen(i, nonces):
+        # 8 reused sender slots + 8 fresh recipient slots per block:
+        # past the 64-row table floor by block 8
+        return [_tx(k, nonces, TOKEN,
+                    transfer_calldata(
+                        bytes([0x90 + i]) + bytes([k]) * 19, 3 + k))
+                for k in range(8)]
+
+    gblock, blocks = _build_chain(8, gen)
+    eng = _replay(gblock, blocks)
+    mx = eng._machine
+    assert mx.blocks == 8
+    assert mx.dirty_blocks == 0
+    assert mx._runner.table_cap >= 128           # the cap DID grow
+    assert mx.machine_counters()["kernel_retraces"] == 0
+
+    monkeypatch.setenv("CORETH_GROWTH_PREBUCKET", "0")
+    legacy = _replay(gblock, blocks)
+    assert legacy.root == eng.root == blocks[-1].root
+    lr = legacy._machine.machine_counters()["kernel_retraces"]
+    assert lr >= 1          # growth retraced at dispatch time
+    assert lr <= 2          # bounded: once per pow2 bucket crossed
+
+
 def test_occ_ineligible_spec_raises():
     """MachineRunner.run refuses ineligible code outright: scan_code
     gives it empty jumpdests, so silent acceptance would turn a taken

@@ -296,6 +296,40 @@ def test_shard_recover_disabled_without_env(monkeypatch):
 
 
 # --------------------------------------------------- row-arena growth
+def test_sharded_occ_table_growth_pads_on_device():
+    """A per-shard table-cap re-bucket pads the resident arenas IN
+    PLACE on device (rows s*G_old+g -> s*G+g) — the grown tables must
+    be bit-identical to a from-scratch host rebuild at the new cap."""
+    import numpy as np
+    from coreth_tpu.evm.device.shard import ShardedWindowRunner
+    mesh = make_mesh(jax.devices("cpu")[:2])
+    vals = {}
+    contracts = [bytes([0x10 + i]) * 20 for i in range(6)]
+
+    def fill(runner, per_contract):
+        for c in contracts:
+            for j in range(per_contract):
+                key = bytes([j]) + b"\x01" * 31
+                vals[(c, key)] = 1 + j + c[0]
+                runner._gid(c, key)
+
+    runner = ShardedWindowRunner(
+        "durango", lambda c, k: vals.get((c, k), 0), mesh)
+    fill(runner, 10)                       # worst shard <= 60 rows
+    runner._device_tables(64)
+    assert runner.table_cap == 64 and not runner._stale
+    fill(runner, 20)                       # worst shard may exceed 64
+    t, k = runner._device_tables(128)      # pad path (not a rebuild)
+    assert runner.table_cap == 128
+    t, k = np.asarray(t).copy(), np.asarray(k).copy()
+
+    # reference: a full host rebuild of the SAME runner state
+    runner._stale = True
+    tf, kf = runner._device_tables(128)
+    np.testing.assert_array_equal(t, np.asarray(tf))
+    np.testing.assert_array_equal(k, np.asarray(kf))
+
+
 def test_sharded_row_arena_growth_remaps():
     """Arena growth in shard mode moves every row (shard-major layout);
     values must survive the device-table rebuild."""

@@ -32,8 +32,13 @@ Checks:
 - ABI003  per-position width / signedness / pointer-ness mismatch
           (``c_uint64``↔``uint64_t``, ``c_size_t``↔``size_t``,
           ``POINTER(c_uint64)``↔``uint64_t*``, ``c_char_p``↔
-          ``uint8_t*``, CFUNCTYPE↔function-pointer typedef), and a
-          *set-but-wrong* ``restype``.
+          ``uint8_t*``), and a *set-but-wrong* ``restype``.  CFUNCTYPE
+          ↔ function-pointer-typedef callbacks compare FIELD BY FIELD:
+          return type, arity, and every parameter's width/signedness/
+          pointer-ness (a trampoline whose signature drifts from the C
+          typedef corrupts the callback frame just as silently as a
+          direct-call mismatch); a side whose signature cannot be
+          parsed degrades to the kind-level check.
 - ABI004  ``argtypes`` declared but no ``restype`` for a symbol whose
           C return type is not plain ``int`` — ctypes defaults to
           ``c_int`` and truncates ``void*``/``uint64_t`` returns (a
@@ -78,7 +83,10 @@ DEFAULT_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 #   ("ptr", "bytes")         -- byte buffer (uint8_t*/char* <-> c_char_p)
 #   ("ptr", "void")          -- opaque handle (void* <-> c_void_p)
 #   ("ptr", <scalar>)        -- typed pointer (uint64_t* <-> POINTER(c_uint64))
-#   ("funcptr",)             -- callback (typedef'd fn ptr <-> CFUNCTYPE)
+#   ("funcptr", ret, (params...)) -- callback with a parsed signature
+#                               (typedef'd fn ptr <-> CFUNCTYPE)
+#   ("funcptr",)             -- callback whose signature could not be
+#                               parsed (kind-level compare only)
 #   ("unknown", text)        -- unparseable; always a finding, never a pass
 
 VOID = ("void",)
@@ -138,8 +146,11 @@ def type_name(t: Tuple) -> str:
         return "byte-ptr"
     if t == PTR_VOID:
         return "void*"
-    if t == FUNCPTR:
-        return "funcptr"
+    if t[0] == "funcptr":
+        if len(t) == 1:
+            return "funcptr"
+        return (f"funcptr[{type_name(t[1])} ("
+                + ", ".join(type_name(x) for x in t[2]) + ")]")
     if t[0] == "int":
         return f"{'' if t[2] else 'u'}int{t[1]}"
     if t[0] == "float":
@@ -167,7 +178,7 @@ class CExport:
 _LINE_COMMENT_RE = re.compile(r"//[^\n]*")
 _BLOCK_COMMENT_RE = re.compile(r"/\*.*?\*/", re.S)
 _TYPEDEF_FNPTR_RE = re.compile(
-    r"typedef\s+[\w\s\*]+?\(\s*\*\s*(\w+)\s*\)\s*\(")
+    r"typedef\s+(?P<ret>[\w\s\*]+?)\(\s*\*\s*(?P<name>\w+)\s*\)\s*\(")
 _EXTERN_DECL_RE = re.compile(
     r'extern\s*"C"\s*(?!\s*\{)(?P<ret>[A-Za-z_][\w\s]*?[\w\*])\s*'
     r"(?P<name>\w+)\s*\(")
@@ -215,8 +226,28 @@ def _extern_block_spans(text: str) -> List[Tuple[int, int]]:
     return spans
 
 
-def normalize_c_type(text: str, fnptr_typedefs=frozenset()) -> Tuple:
-    """One C parameter or return type -> normalized ABI type."""
+def _collect_fnptr_typedefs(clean: str) -> Dict[str, Tuple]:
+    """Callback typedef name -> full normalized signature
+    ("funcptr", ret, (params...)) from comment-stripped C text."""
+    out: Dict[str, Tuple] = {}
+    for m in _TYPEDEF_FNPTR_RE.finditer(clean):
+        name = m.group("name")
+        end = _match_paren(clean, m.end() - 1)
+        if end < 0:
+            out[name] = FUNCPTR
+            continue
+        params = _split_params(clean[m.end():end - 1])
+        out[name] = ("funcptr", normalize_c_type(m.group("ret")),
+                     tuple(normalize_c_type(p) for p in params))
+    return out
+
+
+def normalize_c_type(text: str, fnptr_typedefs=None) -> Tuple:
+    """One C parameter or return type -> normalized ABI type.
+    ``fnptr_typedefs`` maps callback typedef names to their full
+    normalized signatures (see _collect_fnptr_typedefs)."""
+    if fnptr_typedefs is None:
+        fnptr_typedefs = {}
     t = text.strip()
     # arrays decay: `uint8_t out32[32]` / `uint8_t nib[]` are pointers
     arr = re.search(r"(\w+)?\s*\[[^\]]*\]\s*$", t)
@@ -248,7 +279,7 @@ def normalize_c_type(text: str, fnptr_typedefs=frozenset()) -> Tuple:
         if base == "void":
             return VOID
         if base in fnptr_typedefs:
-            return FUNCPTR
+            return fnptr_typedefs[base]
         if base in _C_SCALARS:
             return _C_SCALARS[base]
         return ("unknown", text.strip())
@@ -283,14 +314,13 @@ def parse_c_exports(text: str, path: str,
                     fnptr_typedefs=None) -> List[CExport]:
     """Every extern-"C"-linkage function (declaration or definition)
     in one C++ source.  ``fnptr_typedefs`` may carry callback typedef
-    names collected across files; the file's own typedefs are always
-    included."""
+    signatures collected across files; the file's own typedefs are
+    always included (and win)."""
     clean = _strip_c_comments(text)
-    typedefs = set(fnptr_typedefs or ())
-    typedefs.update(m.group(1)
-                    for m in _TYPEDEF_FNPTR_RE.finditer(clean))
+    typedefs: Dict[str, Tuple] = dict(fnptr_typedefs or {})
+    typedefs.update(_collect_fnptr_typedefs(clean))
     exports: List[CExport] = []
-    tdset = frozenset(typedefs)
+    tdset = typedefs
 
     def _add(ret_text: str, name: str, open_idx: int) -> None:
         end = _match_paren(clean, open_idx)
@@ -343,16 +373,16 @@ def collect_c_exports(
     for fn in files:
         with open(os.path.join(native_dir, fn), encoding="utf-8") as fh:
             texts[fn] = fh.read()
-    # callback typedefs are shared across translation units
-    typedefs = set()
+    # callback typedefs (full signatures) are shared across
+    # translation units
+    typedefs: Dict[str, Tuple] = {}
     for text in texts.values():
-        typedefs.update(m.group(1) for m in
-                        _TYPEDEF_FNPTR_RE.finditer(_strip_c_comments(text)))
+        typedefs.update(_collect_fnptr_typedefs(_strip_c_comments(text)))
     out: Dict[str, CExport] = {}
     for fn, text in texts.items():
         rel = os.path.relpath(os.path.join(native_dir, fn),
                               _REPO_ROOT).replace(os.sep, "/")
-        for exp in parse_c_exports(text, rel, frozenset(typedefs)):
+        for exp in parse_c_exports(text, rel, typedefs):
             cur = out.get(exp.symbol)
             if cur is None or (exp.is_definition and not cur.is_definition):
                 out[exp.symbol] = exp
@@ -373,9 +403,21 @@ class CtypesBinding:
     restype_line: int = 0
 
 
-def _funcptr_names(tree: ast.AST) -> set:
-    """Module-level names bound to a ctypes.CFUNCTYPE(...) factory."""
-    names = set()
+def _cfunctype_sig(call: ast.Call, funcptrs) -> Tuple:
+    """A CFUNCTYPE(restype, *argtypes) call -> full normalized
+    ("funcptr", ret, (params...)) signature."""
+    if not call.args or call.keywords:
+        return FUNCPTR
+    ret = _normalize_py_type(call.args[0], funcptrs)
+    params = tuple(_normalize_py_type(a, funcptrs)
+                   for a in call.args[1:])
+    return ("funcptr", ret, params)
+
+
+def _funcptr_sigs(tree: ast.AST) -> Dict[str, Tuple]:
+    """Names bound to a ctypes.CFUNCTYPE(...) factory -> their full
+    normalized callback signatures."""
+    sigs: Dict[str, Tuple] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
@@ -384,11 +426,12 @@ def _funcptr_names(tree: ast.AST) -> set:
             leaf = leaf.attr if isinstance(leaf, ast.Attribute) else \
                 getattr(leaf, "id", "")
             if leaf in ("CFUNCTYPE", "WINFUNCTYPE", "PYFUNCTYPE"):
-                names.add(node.targets[0].id)
-    return names
+                sigs[node.targets[0].id] = _cfunctype_sig(
+                    node.value, sigs)
+    return sigs
 
 
-def _normalize_py_type(node: ast.AST, funcptrs: frozenset) -> Tuple:
+def _normalize_py_type(node: ast.AST, funcptrs) -> Tuple:
     if isinstance(node, ast.Constant) and node.value is None:
         return VOID
     leaf = None
@@ -411,7 +454,7 @@ def _normalize_py_type(node: ast.AST, funcptrs: frozenset) -> Tuple:
             return ("unknown", f"{leaf} (platform-width; use a fixed-"
                                f"width c_int64/c_uint64)")
         if leaf in funcptrs:
-            return FUNCPTR
+            return funcptrs[leaf]
         return ("unknown", leaf)
     if isinstance(node, ast.Call):
         fleaf = node.func.attr if isinstance(node.func, ast.Attribute) \
@@ -426,7 +469,7 @@ def _normalize_py_type(node: ast.AST, funcptrs: frozenset) -> Tuple:
             # closed so it can never satisfy a T* parameter
             return ("unknown", ast.unparse(node))
         if fleaf in ("CFUNCTYPE", "WINFUNCTYPE", "PYFUNCTYPE"):
-            return FUNCPTR
+            return _cfunctype_sig(node, funcptrs)
     return ("unknown", ast.unparse(node))
 
 
@@ -456,7 +499,7 @@ def parse_ctypes_bindings(source: Source,
                           prefix: str = "coreth_") -> List[CtypesBinding]:
     """All ``<expr>.<symbol>.argtypes/restype`` assignments for
     symbols carrying the native prefix, merged per symbol."""
-    funcptrs = frozenset(_funcptr_names(source.tree))
+    funcptrs = _funcptr_sigs(source.tree)
     by_symbol: Dict[str, CtypesBinding] = {}
     for node in ast.walk(source.tree):
         if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
@@ -494,6 +537,20 @@ _INT_RET = _C_SCALARS["int"]
 def _compatible(c_type: Tuple, py_type: Tuple) -> bool:
     if c_type[0] == "unknown" or py_type[0] == "unknown":
         return False
+    if c_type[0] == "funcptr" or py_type[0] == "funcptr":
+        if c_type[0] != py_type[0]:
+            return False
+        # field-by-field callback comparison: return type, arity, and
+        # every parameter position; a side without a parsed signature
+        # degrades to the kind-level match
+        if len(c_type) == 1 or len(py_type) == 1:
+            return True
+        _k, c_ret, c_params = c_type
+        _k, p_ret, p_params = py_type
+        if len(c_params) != len(p_params):
+            return False
+        return _compatible(c_ret, p_ret) and all(
+            _compatible(a, b) for a, b in zip(c_params, p_params))
     return c_type == py_type
 
 
