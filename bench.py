@@ -819,6 +819,68 @@ def run_streaming():
     return out
 
 
+def run_faults():
+    """Fault-tolerance section: canned fault plans over a small
+    transfer chain, reporting what the supervisor DID about them —
+    demotion counts, retry counts, the demote latency (wall seconds
+    from first strike to routing around the dead backend), the
+    recovery wall (completing the whole chain on the host ladder), and
+    the quarantine path's behavior on a poison block."""
+    from coreth_tpu import faults as F
+    from coreth_tpu.serve import ChainFeed, StreamingPipeline
+    from coreth_tpu.types import Block
+    genesis, blocks = build_or_load_chain("transfer")
+    n = min(len(blocks), int(os.environ.get("BENCH_FAULT_BLOCKS", "64")))
+    wire = [b.encode() for b in blocks[:n]]
+    out = {"blocks": n}
+
+    def one_run(plan, **pipe_kw):
+        fresh = [Block.decode(w) for w in wire]
+        engine = _fresh_engine(genesis, TXS_PER_BLOCK)
+        with F.armed(plan):
+            pipe = StreamingPipeline(engine, ChainFeed(fresh),
+                                     window_wait=0.005, **pipe_kw)
+            t0 = time.monotonic()
+            rep = pipe.run()
+            wall = time.monotonic() - t0
+        assert engine.root == fresh[-1].header.root, "faulted run root"
+        return engine, rep, wall
+
+    # persistent device-dispatch failure: demote, finish on the host
+    eng, rep, wall = one_run(F.FaultPlan(
+        {"device/dispatch": F.FaultSpec()}))
+    sup = rep.supervisor
+    out["persistent_device"] = {
+        "wall_s": round(wall, 3),
+        "demotions": sup["demotions"],
+        "retries": sup["retries"],
+        "demote_latency_s": sup["demote_latency_s"].get("device"),
+        "blocks_fallback": eng.stats.blocks_fallback,
+        "sustained_txs_s": rep.sustained_txs_s,
+    }
+
+    # transient fault: retries absorb it, no demotion, device path kept
+    eng, rep, wall = one_run(F.FaultPlan(
+        {"device/dispatch": F.FaultSpec(times=2, transient=True)}))
+    out["transient_device"] = {
+        "wall_s": round(wall, 3),
+        "retries": rep.supervisor["retries"],
+        "demotions": rep.supervisor["demotions"],
+        "blocks_device": eng.stats.blocks_device,
+    }
+
+    # poison block: quarantined + the stream keeps moving
+    eng, rep, wall = one_run(F.FaultPlan(
+        {"serve/malformed_block": F.FaultSpec(after=n // 2, times=1)}))
+    out["poison_block"] = {
+        "wall_s": round(wall, 3),
+        "quarantined": len(rep.quarantined),
+        "halted": rep.halted,
+        "blocks": rep.blocks,
+    }
+    return out
+
+
 def run_multichip_section():
     """Fold the virtual-mesh scaling curve (tools/mesh_scaling.py)
     into the same deadline budget: a truncated shape in a subprocess
@@ -1003,7 +1065,7 @@ def main():
         else:
             skipped.append("mixed")
 
-        _begin_section(0.93)
+        _begin_section(0.90)
         if _remaining() > 45:
             # streaming ingestion (serve/): sustained-rate p50/p99
             # block latency through the bounded-queue pipeline — the
@@ -1012,6 +1074,15 @@ def main():
             _section_done("streaming")
         else:
             skipped.append("streaming")
+
+        _begin_section(0.95)
+        if _remaining() > 30:
+            # fault tolerance: demotion counts + recovery latency
+            # under canned fault plans (supervisor + quarantine)
+            result["faults"] = run_faults()
+            _section_done("faults")
+        else:
+            skipped.append("faults")
 
         _begin_section(0.99)
         if _remaining() > 40:

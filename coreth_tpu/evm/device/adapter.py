@@ -20,10 +20,37 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 import jax.numpy as jnp
 
+from coreth_tpu import faults
 from coreth_tpu.crypto.keccak import keccak256_many
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as T
 from coreth_tpu.ops import u256
+
+# Same seam the transfer path's supervised _issue_window fires
+# (replay/engine.py declares the doc for it): a fused-OCC window
+# dispatch raising mid-run.  Fired BEFORE any packing mutates the
+# runner, so a faulted issue() is safe to retry.
+PT_DISPATCH = faults.declare(
+    "device/dispatch", "raise at window dispatch (transfer + fused OCC)")
+
+
+# One shared background compile thread for pre-warm traces: on CPU
+# hosts the pre-bucket compile was SYNCHRONOUS inside issue() (ROADMAP
+# PR-9 follow-up), serializing a full XLA trace behind the dispatch it
+# was supposed to hide.  A single worker keeps compile order
+# deterministic; _get_kernel joins any in-flight warm for the bucket
+# it is about to dispatch, so the retrace accounting (and the
+# kernel_retraces == 0 gate) is unchanged.
+_COMPILE_POOL = None
+
+
+def _compile_pool():
+    global _COMPILE_POOL
+    if _COMPILE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _COMPILE_POOL = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="coreth-compile")
+    return _COMPILE_POOL
 
 WORD_ZERO = b"\x00" * 32
 
@@ -469,6 +496,13 @@ class MachineWindowRunner:
             "CORETH_PREMAP_PREDICT", "1")))
         self._prebucket = bool(int(os.environ.get(
             "CORETH_GROWTH_PREBUCKET", "1")))
+        # pre-warm compiles ride the background compile thread by
+        # default; CORETH_COMPILE_THREAD=0 restores the synchronous
+        # compile for A/B (and the legacy CORETH_GROWTH_PREBUCKET=0
+        # path never pre-warms at all)
+        self._compile_async = bool(int(os.environ.get(
+            "CORETH_COMPILE_THREAD", "1")))
+        self._warm_pending: Dict[tuple, object] = {}
         self._hw: Dict[str, int] = {}   # sticky pow2 shape high-water
         self._hw_feats: frozenset = frozenset()
         self._dispatched = 0
@@ -760,6 +794,7 @@ class MachineWindowRunner:
         trie folding of the previous window with this one's execution
         and only block in complete()'s fetch.
         """
+        faults.fire(PT_DISPATCH)
         if discovered is None:
             discovered = [[{} for _t in specs] for _env, specs in items]
         premaps, predicted = self._premaps(items, discovered)
@@ -875,6 +910,15 @@ class MachineWindowRunner:
             self._buckets_used.add(key)
             if not self._cold:
                 self.kernel_retraces += 1
+        fut = self._warm_pending.pop(key, None)
+        if fut is not None:
+            # a background pre-warm of THIS bucket is in flight: join
+            # it — the trace lands in the kernel cache exactly once
+            # and the dispatch below finds a ready executable
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001 — warm compile is advisory; the dispatch below compiles synchronously if it failed
+                pass
         return self._kernel(p, occ)
 
     def _lane_count(self, p: M.MachineParams) -> int:
@@ -951,8 +995,22 @@ class MachineWindowRunner:
         self._buckets_used.add((p, nxt))
         if self._kernel_compiled(p, nxt):
             return  # cache-warm from an earlier runner/rep
+        if self._compile_async:
+            # the trace runs on the compile thread while the CURRENT
+            # window executes on the main thread — on CPU hosts this
+            # hides the whole compile instead of serializing it here
+            self._warm_pending[(p, nxt)] = _compile_pool().submit(
+                self._warm_compile, p, nxt)
+            return
         fn = self._kernel(p, nxt)
         fn(*self._warm_args(p, nxt))
+
+    def _warm_compile(self, p: M.MachineParams,
+                      occ: M.OccParams) -> None:
+        """Body of one background pre-warm: build + trace + dispatch
+        the all-inactive warm batch for a bucket (compile-thread)."""
+        fn = self._kernel(p, occ)
+        fn(*self._warm_args(p, occ))
 
     # ---------------------------------------------------------- complete
     def _block_stride(self, handle: dict) -> int:

@@ -46,14 +46,24 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as PS
 
+from coreth_tpu import faults
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as T
 from coreth_tpu.evm.device.adapter import (
-    MachineWindowRunner, _count_dispatch, _pow2, addr_word, word16,
+    PT_DISPATCH, MachineWindowRunner, _count_dispatch, _pow2, addr_word,
+    word16,
 )
 from coreth_tpu.ops import u256
 from coreth_tpu.parallel import _shard_map, account_bucket, contract_bucket
+
+# Injection point: the cross-shard collective exchange fails (ICI
+# flake, a device dropping out of the mesh).  Armed plans raise at the
+# exchange dispatch inside issue(); the machine executor's fault
+# containment invalidates the runner and routes the run down the
+# ladder.
+PT_EXCHANGE = faults.declare(
+    "device/shard_exchange", "cross-shard collective exchange failure")
 
 # Dispatch/fetch ordering trace for the overlap test: entries are
 # "dispatch:<seq>", "exchange_fetch:<seq>", "result_fetch:<seq>".
@@ -390,6 +400,7 @@ class ShardedWindowRunner(MachineWindowRunner):
 
     # ------------------------------------------------------------- issue
     def issue(self, items, discovered=None, attempt: int = 1) -> dict:
+        faults.fire(PT_DISPATCH)  # same seam as the base runner
         probe, self._probe = self._probe, None
         if (discovered is None and probe is not None
                 and probe[0] is items):
@@ -498,7 +509,11 @@ class ShardedWindowRunner(MachineWindowRunner):
         self.table = out["table"]
         self._dispatched += 1
         # the exchange rides the same device queue, right behind the
-        # window — its (tiny) result is what poll_clean fetches
+        # window — its (tiny) result is what poll_clean fetches.
+        # PT_EXCHANGE is the cross-shard collective's failure seam: a
+        # raise here is contained by execute_run (the runner is
+        # invalidated and rebuilt from the host mirror).
+        faults.fire(PT_EXCHANGE)
         ex = get_shard_exchange(self.mesh)(out["packed"], active_j)
         self._prewarm(p, occ, n_blocks=len(items))
         return dict(out=out, ex=ex, items=items, discovered=discovered,

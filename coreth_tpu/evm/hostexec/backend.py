@@ -15,10 +15,18 @@ from __future__ import annotations
 import ctypes
 from typing import Callable, Dict, List, Optional, Tuple
 
+from coreth_tpu import faults
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.hostexec.eligibility import (
     REFUND_FORKS, native_optable,
 )
+
+# Injection point: the session returns an error rc mid-call (the ABI's
+# failure mode for a corrupted session).  Armed plans raise here; the
+# bridge and the serial short-circuit both treat it as a per-tx escape
+# plus a native-scope strike.
+PT_ERROR_RC = faults.declare(
+    "native/error_rc", "hostexec session call returns a fault rc")
 
 _FETCH_SLOT = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
@@ -236,6 +244,7 @@ class HostExecBackend:
     def call(self, caller: bytes, to: bytes, value: int,
              gas_price: int, data: bytes, gas: int,
              warm_addrs=(), warm_slots=()) -> NativeCallResult:
+        faults.fire(PT_ERROR_RC)
         lib = self._lib
         for a in warm_addrs:
             lib.coreth_hostexec_warm_addr(self._h, a)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional
 
+from coreth_tpu import faults
 from coreth_tpu.evm import vmerrs
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device.tables import fork_key
@@ -33,6 +34,25 @@ from coreth_tpu.evm.hostexec.eligibility import native_eligible
 
 # which executor served depth-0 calls (bench.py reports these)
 _COUNTERS: Dict[str, int] = {}
+
+# Injection points on the native boundary (coreth_tpu/faults):
+PT_SESSION_LOSS = faults.declare(
+    "native/session_loss",
+    "hostexec session unavailable at bridge setup")
+PT_DIVERGE = faults.declare(
+    "native/oracle_divergence",
+    "armed differential oracle reports a native/interpreter divergence")
+
+# The supervisor observing native-scope faults (replay/supervisor.py
+# BackendSupervisor; set by ReplayEngine construction).  Module-level
+# by the same argument as the native session itself: one process, one
+# native library — a backend sick for one engine is sick for all.
+_OBSERVER = None
+
+
+def set_fault_observer(observer) -> None:
+    global _OBSERVER
+    _OBSERVER = observer
 
 
 def counters() -> Dict[str, int]:
@@ -100,6 +120,12 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
     """Native execution of one root call; None -> interpreter path."""
     if _mode() != "native":
         return None
+    obs = _OBSERVER
+    if obs is not None and not obs.allows("native"):
+        # supervisor demoted the native engine: the interpreter serves
+        # until the cooldown lapses (then the next call is the probe)
+        _bump("supervisor_demoted")
+        return None
     fork = fork_key(evm.rules)
     if fork is None:
         return None
@@ -113,7 +139,14 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
     if not eligible:
         _bump("py_ineligible")
         return None
-    be = _backend_for(evm, fork)
+    try:
+        faults.fire(PT_SESSION_LOSS)
+        be = _backend_for(evm, fork)
+    except faults.FaultInjected as exc:
+        if obs is not None:
+            obs.strike("native", exc)
+        _bump("session_faults")
+        return None
     if be is None:
         return None
     ctx = evm.block_ctx
@@ -147,15 +180,38 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
     be.set_env(ctx.coinbase, ctx.time, ctx.number, ctx.gas_limit,
                ctx.base_fee or 0, ctx.difficulty)
     be.set_code(addr, code)
-    res = be.call(
-        caller, addr, value, evm.tx_ctx.gas_price, input_, gas,
-        warm_addrs=sorted(statedb.access_list_addresses),
-        warm_slots=sorted(statedb.access_list_slots))
+    try:
+        res = be.call(
+            caller, addr, value, evm.tx_ctx.gas_price, input_, gas,
+            warm_addrs=sorted(statedb.access_list_addresses),
+            warm_slots=sorted(statedb.access_list_slots))
+    except faults.FaultInjected as exc:
+        # the native/error_rc seam (backend.py): an error rc from the
+        # session is a per-tx interpreter fallback + a native strike —
+        # repeated rcs demote the scope through the observer
+        if obs is not None:
+            obs.strike("native", exc)
+        _bump("native_faults")
+        return None
     if res.needs_host:
         _bump("host_escapes")
         return None
     if os.environ.get("CORETH_HOST_EXEC_CHECK"):
-        _differential_check(evm, caller, addr, input_, gas, value, res)
+        try:
+            faults.fire(PT_DIVERGE)
+            _differential_check(evm, caller, addr, input_, gas, value,
+                                res)
+        except (faults.FaultInjected, AssertionError) as exc:
+            if obs is None:
+                raise  # unsupervised oracle mode: fail loudly (tests)
+            # a backend that DISAGREES with the interpreter is wrong,
+            # not slow: hard-demote immediately and let the
+            # interpreter (whose result is authoritative) serve the tx
+            obs.strike("native", exc, hard=True)
+            _bump("oracle_divergences")
+            return None
+    if obs is not None:
+        obs.note_ok("native")  # consecutive-strike reset + probe win
     if res.status == M.ERR:
         # the outcome (all gas burned, status-0 receipt) is already
         # proven equal, but callers pin the exact error TAXONOMY

@@ -1,0 +1,26 @@
+"""Deterministic fault injection for the serve/replay stack.
+
+A :class:`FaultPlan` arms named injection points that production code
+threads through its failure seams (device dispatch, the native
+boundary, the commit pipeline, the serve feed, sender recovery).
+Unarmed — the production state — every point is ONE module-global
+``None`` check; armed, the plan decides per hit (seeded, so a plan
+replays identically) whether the point fires and what it does: raise a
+:class:`FaultInjected`, SIGKILL the process (crash-consistency tests),
+stall, or hand a site-interpreted spec back to the caller (drop a
+block, corrupt a header).
+
+``CORETH_FAULT_PLAN`` arms a plan from the environment (inline JSON or
+``@/path/to/plan.json``) — the seam the SIGKILL-resume subprocess
+tests and the bench fault section use.
+"""
+
+from coreth_tpu.faults.registry import (
+    FaultInjected, FaultPlan, FaultSpec, arm, arm_from_env, armed,
+    check, declare, declared, disarm, fire, fired,
+)
+
+__all__ = [
+    "FaultInjected", "FaultPlan", "FaultSpec", "arm", "arm_from_env",
+    "armed", "check", "declare", "declared", "disarm", "fire", "fired",
+]

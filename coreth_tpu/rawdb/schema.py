@@ -31,6 +31,7 @@ RECEIPTS_PREFIX = b"r"
 CODE_PREFIX = b"c"
 LAST_ACCEPTED_KEY = b"LastAcceptedKey"
 LAST_ROOT_KEY = b"LastRoot"
+REPLAY_CHECKPOINT_KEY = b"ReplayCheckpoint"
 
 
 def _num8(n: int) -> bytes:
@@ -139,3 +140,22 @@ def read_last_flushed_root(kv: KVStore):
     if raw is None:
         return None, None
     return raw[:32], int.from_bytes(raw[32:], "big")
+
+
+def write_replay_checkpoint(kv: KVStore, number: int, block_hash: bytes,
+                            root: bytes, header_rlp: bytes) -> None:
+    """The replay-resume record (replay/checkpoint.py): last committed
+    block number/hash, the state root the engine trie sits on, and the
+    full header RLP (the resumed engine's parent_header — AP4 fee
+    validation needs block_gas_cost/time from the REAL parent)."""
+    kv.put(REPLAY_CHECKPOINT_KEY, rlp.encode([
+        rlp.encode_uint(number), block_hash, root, header_rlp]))
+
+
+def read_replay_checkpoint(kv: KVStore):
+    """(number, block_hash, root, header_rlp) or None."""
+    raw = kv.get(REPLAY_CHECKPOINT_KEY)
+    if raw is None:
+        return None
+    number, block_hash, root, header_rlp = rlp.decode(raw)
+    return rlp.decode_uint(number), block_hash, root, header_rlp

@@ -1,0 +1,139 @@
+"""Crash-consistent checkpoint/resume for replay.
+
+The commit pipeline decouples execution from commitment
+(replay/commit.py); this module makes the committed prefix a *durable
+restart point* (the Reddio decoupling carried to its conclusion): at
+window-commit boundaries the engine persists
+
+  1. its trie nodes — account trie + every live per-contract storage
+     trie — through the existing rawdb state-manager path
+     (``Database.node_db`` over a :class:`PersistentNodeDict`, flushed
+     to the append-only KV log), then
+  2. one small checkpoint record (last committed block number + hash,
+     the state root, and the full header RLP — the resumed engine's
+     ``parent_header``, which AP4+ fee validation requires).
+
+Write order IS the crash-consistency argument: nodes are fsynced
+before the record, so whichever record a reader finds, its root's
+entire node closure is already durable.  A crash between the two
+leaves the *previous* record pointing at a complete trie (the new
+nodes are unreachable orphans — tries are content-addressed, orphans
+are harmless).  The torn-tail truncation in rawdb.kv covers a kill
+mid-write.
+
+A restarted :class:`~coreth_tpu.replay.ReplayEngine` /
+:class:`~coreth_tpu.serve.StreamingPipeline` resumes from the record
+and reaches bit-identical final roots (tests/test_checkpoint_resume.py
+SIGKILLs a streaming run mid-window in a subprocess to prove it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from coreth_tpu import faults
+from coreth_tpu.rawdb import schema
+from coreth_tpu.types.block import Header
+
+# fired between the node flush and the checkpoint-record write: the
+# torn-checkpoint seam (a crash here must leave the PREVIOUS record
+# valid — pinned by tests/test_checkpoint_resume.py)
+PT_CRASH_GAP = faults.declare(
+    "checkpoint/crash_gap",
+    "crash window between trie-node flush and checkpoint-record write")
+
+
+@dataclass
+class Checkpoint:
+    number: int
+    block_hash: bytes
+    root: bytes
+    header: Header
+
+
+def load_checkpoint(kv) -> Optional[Checkpoint]:
+    """The durable checkpoint record, or None on a fresh store."""
+    rec = schema.read_replay_checkpoint(kv)
+    if rec is None:
+        return None
+    number, block_hash, root, header_rlp = rec
+    return Checkpoint(number=number, block_hash=block_hash, root=root,
+                      header=Header.decode(header_rlp))
+
+
+def resume_engine(config, db, kv, engine_cls=None, **engine_kw):
+    """(engine, checkpoint) resumed from ``kv``'s record, or
+    (None, None) when no checkpoint exists (caller starts from
+    genesis).  ``db`` must be backed by the same store the crashed run
+    wrote through (rawdb PersistentNodeDict / PersistentCodeDict)."""
+    ckpt = load_checkpoint(kv)
+    if ckpt is None:
+        return None, None
+    if engine_cls is None:
+        from coreth_tpu.replay.engine import ReplayEngine
+        engine_cls = ReplayEngine
+    eng = engine_cls(config, db, ckpt.root,
+                     parent_header=ckpt.header, **engine_kw)
+    return eng, ckpt
+
+
+class CheckpointManager:
+    """Owns the checkpoint cadence for one engine.
+
+    ``every`` is in committed blocks (the ``CORETH_CHECKPOINT`` knob);
+    callers feed :meth:`on_committed` from their commit path — the
+    streaming pipeline's ``_mark_committed`` — and the manager writes
+    at block-``every`` boundaries.  Writing is synchronous on the
+    execute thread (the engine's tries are single-owner) but cheap:
+    ``engine.commit()`` exports only nodes newer than the last export,
+    and the record itself is ~600 bytes.
+    """
+
+    def __init__(self, engine, kv, every: int):
+        if every <= 0:
+            raise ValueError("checkpoint interval must be positive")
+        self.engine = engine
+        self.kv = kv
+        self.every = every
+        self.written = 0
+        self.last_number: Optional[int] = None
+        self._since = 0
+
+    def on_committed(self, n_blocks: int) -> bool:
+        """Account ``n_blocks`` newly committed blocks; write a
+        checkpoint when the interval fills.  Returns True iff one was
+        written."""
+        self._since += n_blocks
+        if self._since < self.every:
+            return False
+        self._since = 0
+        self.write()
+        return True
+
+    def write(self) -> Checkpoint:
+        """Persist the current committed state as the restart point."""
+        eng = self.engine
+        eng.commit_pipe.flush()
+        header = eng.parent_header
+        if header is None or not isinstance(header, Header):
+            raise ValueError(
+                "checkpointing needs the engine's parent_header (the "
+                "last committed block's real header)")
+        root = eng.commit()  # trie nodes -> db.node_db
+        node_db = eng.db.node_db
+        if hasattr(node_db, "flush"):
+            node_db.flush()  # PersistentNodeDict -> kv pending drain
+        self.kv.flush()
+        faults.fire(PT_CRASH_GAP)
+        schema.write_replay_checkpoint(
+            self.kv, header.number, header.hash(), root, header.encode())
+        self.kv.flush()
+        self.written += 1
+        self.last_number = header.number
+        return Checkpoint(number=header.number, block_hash=header.hash(),
+                          root=root, header=header)
+
+    def snapshot(self) -> dict:
+        return {"every": self.every, "written": self.written,
+                "last_number": self.last_number}

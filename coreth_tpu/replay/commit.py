@@ -36,10 +36,17 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
-from coreth_tpu import rlp
+from coreth_tpu import faults, rlp
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.mpt.rehash import device_rehash
 from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
+
+# Injection point: the window fold fails (a device rehash hiccup, an
+# I/O error in the native trie).  Transient plans retry with the
+# supervisor's backoff; a persistent flush failure is fatal — there is
+# no alternative commit backend, so it surfaces to the caller.
+PT_FLUSH = faults.declare(
+    "commit/flush_fail", "window trie-fold flush failure")
 
 
 class CommitPipeline:
@@ -171,6 +178,13 @@ class CommitPipeline:
         if not self.staged_blocks:
             return e.root
         from coreth_tpu.replay.engine import ReplayError
+        sup = getattr(e, "supervisor", None)
+        if sup is not None:
+            # the injected gate retries transient faults with backoff
+            # BEFORE the fold runs (the fold itself must not re-run)
+            sup.retry_point("commit", PT_FLUSH)
+        else:
+            faults.fire(PT_FLUSH)
         t0 = time.monotonic()
         self._fold_storage()
         root = self._fold_accounts()

@@ -38,6 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from coreth_tpu import faults
 from coreth_tpu.consensus.engine import DummyEngine
 from coreth_tpu.ops import u256
 from coreth_tpu.params import ChainConfig
@@ -58,6 +59,22 @@ from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
 
 class ReplayError(Exception):
     pass
+
+
+def _block_error(msg: str, block) -> ReplayError:
+    """ReplayError carrying the failing block, so a streaming caller
+    can quarantine exactly that block instead of losing the run."""
+    err = ReplayError(msg)
+    err.block = block
+    return err
+
+
+# Injection points on the replay engine's failure seams (armed only by
+# a FaultPlan — coreth_tpu/faults; a no-op dict miss in production):
+PT_DISPATCH = faults.declare(
+    "device/dispatch", "raise at window dispatch (transfer + fused OCC)")
+PT_RECOVER = faults.declare(
+    "recover/fault", "batched sender recovery failure (device or host)")
 
 
 # Measured on the tunneled v5e: blocking on uploads at issue time syncs
@@ -99,6 +116,9 @@ class ReplayStats:
     # windows whose fetch-tensor download was started asynchronously at
     # issue time (the windowed device-read prefetch; serve/prefetch.py)
     reads_prefetched: int = 0
+    # blocks applied tolerantly after failing validation on every
+    # backend (supervisor quarantine — streaming callers only)
+    blocks_quarantined: int = 0
     # where batched sender recovery ran: the device ECDSA ladder
     # (single-chip or mesh-sharded — overlapping window execution in
     # the replay loop) vs the native host batch
@@ -571,6 +591,7 @@ class _SenderPipeline:
         t0 = time.monotonic()
         h = {"todo": [], "kind": "empty"}
         try:
+            faults.fire(PT_RECOVER)  # degrade: lazy per-tx recovery
             todo, hashes, rs, ss, recids = eng._pack_sigs(
                 self.segments[s])
             n = len(recids)
@@ -726,6 +747,17 @@ class ReplayEngine:
         # blocks stage deduped writes; flush() folds once per window
         from coreth_tpu.replay.commit import CommitPipeline
         self.commit_pipe = CommitPipeline(self)
+        # fault supervision: retry/demote/probe over the execution
+        # ladder (replay/supervisor.py); CORETH_FAULT_PLAN arms the
+        # injection registry for this process if nothing armed it yet
+        faults.arm_from_env()
+        from coreth_tpu.replay.supervisor import BackendSupervisor
+        self.supervisor = BackendSupervisor(self)
+        # the hostexec bridge consults the newest engine's supervisor
+        # for native-scope routing (module-level by the same argument
+        # as the native session itself: one process, one native lib)
+        from coreth_tpu.evm.hostexec import bridge as _hx_bridge
+        _hx_bridge.set_fault_observer(self.supervisor)
 
     # ---------------------------------------------------------------- index
     def _account(self, addr: bytes) -> int:
@@ -859,6 +891,7 @@ class ReplayEngine:
     def _recover_packed(self, hashes: bytes, rs: bytes, ss: bytes,
                         recids: bytes):
         """Hybrid batched recovery over packed buffers -> (addrs, ok)."""
+        faults.fire(PT_RECOVER)  # callers degrade to per-tx recovery
         from coreth_tpu.crypto import native
         n = len(recids)
         have_native = native.load() is not None
@@ -934,6 +967,11 @@ class ReplayEngine:
         if block.ext_data():
             # atomic ExtData applies through the engine callbacks on
             # the exact host path only
+            return None
+        if not self.supervisor.allows("device"):
+            # supervisor demoted the device scope: every block routes
+            # through the host ladder until the cooldown lapses (the
+            # first allowed classify after that IS the probe)
             return None
         base_fee = block.base_fee
         rules = self.config.rules(block.number, block.time)
@@ -1250,6 +1288,14 @@ class ReplayEngine:
                     t_pad=t_idxs.shape[1], flushed=flushed)
 
     def _issue_window(self, items: List[Tuple[Block, dict]]) -> dict:
+        """Supervised window dispatch: transient faults retry with
+        backoff, persistent ones strike toward device demotion and
+        surface as BackendFault (replay()/_drive route the run through
+        the exact host path).  The injected seam is PT_DISPATCH."""
+        return self.supervisor.run("device", PT_DISPATCH,
+                                   self._issue_window_run, items)
+
+    def _issue_window_run(self, items: List[Tuple[Block, dict]]) -> dict:
         """One device call for a whole run of transfer blocks: upload the
         stacked batches, lax.scan the steps, download one stacked fetch
         tensor.  Round-trip latency amortizes over the window."""
@@ -1326,10 +1372,23 @@ class ReplayEngine:
                 # rewind: _fallback opens a StateDB at self.root
                 self.commit_pipe.flush()
                 return self._recover_window(win, arr, k, blocks, start_idx)
-            self._validate_and_advance(block, batch, arr[k],
-                                       win["touched_lists"][k],
-                                       win["slot_lists"][k],
-                                       win["t_pad"])
+            try:
+                self._validate_and_advance(block, batch, arr[k],
+                                           win["touched_lists"][k],
+                                           win["slot_lists"][k],
+                                           win["t_pad"])
+            except ReplayError:
+                # device-path VALIDATION failed (a malformed block, or
+                # a gas/receipt-model gap): before giving up, rewind
+                # and retry the block on the exact host path — the
+                # same recovery an execution failure gets.  A block
+                # that fails there too re-raises with .block attached
+                # (the streaming pipeline's quarantine seam).
+                # _validate_and_advance raises before staging, so the
+                # staged set is exactly the valid prefix [0, k).
+                self.commit_pipe.flush()
+                return self._recover_window(win, arr, k, blocks,
+                                            start_idx)
         # ONE deduped fold + root check for the whole window
         self.commit_pipe.flush()
         # NOTE: the classifier's slot overlay is NOT cleared here — with
@@ -1458,9 +1517,16 @@ class ReplayEngine:
             if receipts is None:
                 # verify_block_fee reads only gas_used per receipt
                 receipts = [Receipt(gas_used=g) for g in gas_list]
-            self.engine.verify_block_fee(
-                block.base_fee, block.header.block_gas_cost,
-                block.transactions, receipts, None)
+            from coreth_tpu.consensus.engine import ConsensusError
+            try:
+                self.engine.verify_block_fee(
+                    block.base_fee, block.header.block_gas_cost,
+                    block.transactions, receipts, None)
+            except ConsensusError as exc:
+                # ReplayError so _complete_window's host retry (and
+                # the pipeline quarantine) own it, with the block
+                # attributed
+                raise _block_error(f"block fee: {exc}", block) from exc
         t0 = time.monotonic()
         # STAGE this block's trie effects — the fold itself is
         # window-batched (replay/commit.py): _complete_window flushes
@@ -1501,13 +1567,20 @@ class ReplayEngine:
         CORETH_MACHINE=0 forces the host path (A/B benching)."""
         if not bool(int(os.environ.get("CORETH_MACHINE", "1"))):
             return False
+        if not self.supervisor.allows("device"):
+            return False
         mx = self._machine_executor()
         t0 = time.monotonic()
         plans = mx.classify(block)
         self.stats.t_classify += time.monotonic() - t0
         if plans is None:
             return False
-        return mx.execute_run([(block, plans)]) == 1
+        from coreth_tpu.replay.supervisor import BackendFault
+        try:
+            return self.supervisor.run(
+                "device", None, mx.execute_run, [(block, plans)]) == 1
+        except BackendFault:
+            return False  # caller takes the exact host path
 
     def _machine_run(self, blocks: List[Block], i: int,
                      ensure=None) -> int:
@@ -1523,7 +1596,8 @@ class ReplayEngine:
         FALLBACK block can, so execute_run stops its run at the first
         block it escalates and the remainder re-classifies here fresh.
         """
-        if not bool(int(os.environ.get("CORETH_MACHINE", "1"))):
+        if not bool(int(os.environ.get("CORETH_MACHINE", "1"))) \
+                or not self.supervisor.allows("device"):
             self._fallback(blocks[i])
             return 1
         mx = self._machine_executor()
@@ -1563,7 +1637,16 @@ class ReplayEngine:
             self._fallback(blocks[i])
             return 1
         mx._fork = fork
-        consumed = mx.execute_run(items)
+        from coreth_tpu.replay.supervisor import BackendFault
+        try:
+            consumed = self.supervisor.run("device", None,
+                                           mx.execute_run, items)
+        except BackendFault:
+            # persistent device fault with no progress: the run's
+            # first block takes the exact host path; the rest
+            # re-enter the loop (and re-route while demoted)
+            self._fallback(blocks[i])
+            return 1
         if consumed == 0:
             self._fallback(blocks[i])
             consumed = 1
@@ -1579,7 +1662,11 @@ class ReplayEngine:
             if self._try_machine(block):
                 return self.root
             return self._fallback(block)
-        win = self._issue_window([(block, batch)])
+        from coreth_tpu.replay.supervisor import BackendFault
+        try:
+            win = self._issue_window([(block, batch)])
+        except BackendFault:
+            return self._fallback(block)
         resume = self._complete_window(win, [block], 0)
         return self.root if resume is None else self.root
 
@@ -1607,6 +1694,7 @@ class ReplayEngine:
         now-stale base) is discarded and re-classified.  Tail resume is
         iterative (round-3 verdict: the recursive form was O(depth) in
         adversarial fallback-per-window chains)."""
+        from coreth_tpu.replay.supervisor import BackendFault
         window = window or self.window
         n = len(blocks)
         pipe = _SenderPipeline(self, blocks)
@@ -1627,7 +1715,16 @@ class ReplayEngine:
                     break
                 run.append((blocks[i], batch))
                 i += 1
-            win = self._issue_window(run) if run else None
+            win = None
+            failed_run = None
+            if run:
+                try:
+                    win = self._issue_window(run)
+                except BackendFault:
+                    # the supervisor struck (and possibly demoted) the
+                    # device scope; the classified run replays on the
+                    # exact host path after the pending window retires
+                    failed_run = run
             # retire the previous window while the chip runs this one
             if pending is not None:
                 p_win, p_start = pending
@@ -1636,8 +1733,12 @@ class ReplayEngine:
                 if resume is not None:
                     if win is not None:
                         self._discard_window(win)
-                    i = resume
+                    i = resume  # failed_run blocks re-enter from here
                     continue
+            if failed_run is not None:
+                for b, _batch in failed_run:
+                    self._fallback(b)
+                continue
             if win is not None:
                 pending = (win, run_start)
                 continue
@@ -1648,9 +1749,28 @@ class ReplayEngine:
                 i += self._machine_run(blocks, i, ensure=pipe.ensure)
         return self.root
 
-    def _fallback(self, block: Block) -> bytes:
+    def quarantine_block(self, block: Block) -> List[str]:
+        """Tolerant host application of a poison block — one that
+        failed validation on EVERY backend (device, native, and the
+        strict interpreter path).  The state transition still applies
+        (the computed post-state is the only consistent base later
+        blocks can build on) but the failed consensus checks are
+        RECORDED instead of raised; the caller parks the block's
+        reasons in its quarantine report.  Streaming-pipeline only —
+        batch replay stays strict."""
+        reasons: List[str] = []
+        self._fallback(block, strict=False, reasons=reasons)
+        self.supervisor.note_quarantined()
+        self.stats.blocks_quarantined += 1
+        return reasons
+
+    def _fallback(self, block: Block, strict: bool = True,
+                  reasons: Optional[List[str]] = None) -> bytes:
         """Bit-exact host path for non-transfer blocks; device state for
-        touched accounts is refreshed afterwards."""
+        touched accounts is refreshed afterwards.  ``strict=False`` is
+        the quarantine mode: consensus mismatches are appended to
+        ``reasons`` instead of raised and the computed state still
+        commits (see quarantine_block)."""
         self.commit_pipe.flush()  # staged windows precede this block
         t0 = time.monotonic()
         if self._native:
@@ -1675,12 +1795,20 @@ class ReplayEngine:
         receipts, logs, used_gas = self.processor.process(
             block, parent, statedb)
         if used_gas != block.header.gas_used:
-            raise ReplayError("gas used mismatch (fallback)")
+            if strict:
+                raise _block_error("gas used mismatch (fallback)", block)
+            reasons.append("gas used mismatch")
         if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
-            raise ReplayError("receipt root mismatch (fallback)")
+            if strict:
+                raise _block_error(
+                    "receipt root mismatch (fallback)", block)
+            reasons.append("receipt root mismatch")
         root = statedb.intermediate_root(True)
         if root != block.header.root:
-            raise ReplayError("state root mismatch (fallback)")
+            if strict:
+                raise _block_error(
+                    "state root mismatch (fallback)", block)
+            reasons.append("state root mismatch")
         statedb.commit(delete_empty_objects=True)
         # refresh engine trie + device copies of touched accounts (one
         # batched scatter via the staging buffer)

@@ -98,6 +98,9 @@ class MachineBlockExecutor:
         self.window_attempts = 0   # dispatches those windows took
         self.dirty_blocks = 0      # blocks the fused path escalated
         self.last_writes: Dict[Tuple[bytes, bytes], int] = {}
+        # blocks fully finished+staged by the current _chunk_loop call
+        # (read by execute_run's fault containment)
+        self._inflight_consumed = 0
         self._runner: Optional[MachineWindowRunner] = None
         self._runner_fork: Optional[str] = None
         self._runner_epoch = -1
@@ -286,7 +289,6 @@ class MachineBlockExecutor:
         lane escapes to the host (caller falls back).  Raises
         ReplayError on consensus validation failure, like the transfer
         path."""
-        from coreth_tpu.replay.engine import ReplayError
         e = self.e
         # a fused window may have staged earlier blocks of this run;
         # _host_resolve commits the engine tries for its scratch
@@ -404,7 +406,6 @@ class MachineBlockExecutor:
         With ``defer=True`` the caller owns the flush, so a fused
         window folds ONCE while the next window's dispatch is already
         in flight."""
-        from coreth_tpu.replay.engine import ReplayError
         e = self.e
         t1 = time.monotonic()
         accounts: Dict[bytes, List[int]] = {}  # addr -> [bal, nonce]
@@ -427,17 +428,18 @@ class MachineBlockExecutor:
                 accounts[addr] = st
             return st
 
+        from coreth_tpu.replay.engine import _block_error
         receipts: List[Receipt] = []
         cum = 0
         writes_final: Dict[Tuple[bytes, bytes], int] = {}
         for i, pl in enumerate(plans):
             s = acct(pl.sender)
             if pl.nonce != s[1]:
-                raise ReplayError(
-                    f"machine block: nonce mismatch tx {i}")
+                raise _block_error(
+                    f"machine block: nonce mismatch tx {i}", block)
             if s[0] < pl.gas_limit * pl.fee_cap + pl.value:
-                raise ReplayError(
-                    f"machine block: insufficient funds tx {i}")
+                raise _block_error(
+                    f"machine block: insufficient funds tx {i}", block)
             if pl.kind == "xfer":
                 used = pl.intrinsic
                 status = 1
@@ -467,15 +469,23 @@ class MachineBlockExecutor:
                 tx_type=block.transactions[i].tx_type, status=status,
                 cumulative_gas_used=cum, gas_used=used, logs=logs))
         if cum != block.header.gas_used:
-            raise ReplayError("machine block: gas used mismatch")
+            raise _block_error("machine block: gas used mismatch", block)
         if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
-            raise ReplayError("machine block: receipt root mismatch")
+            raise _block_error(
+                "machine block: receipt root mismatch", block)
         if create_bloom(receipts) != block.header.bloom:
-            raise ReplayError("machine block: bloom mismatch")
+            raise _block_error("machine block: bloom mismatch", block)
         if e.config.is_apricot_phase4(block.time):
-            e.engine.verify_block_fee(
-                block.base_fee, block.header.block_gas_cost,
-                block.transactions, receipts, None)
+            from coreth_tpu.consensus.engine import ConsensusError
+            try:
+                e.engine.verify_block_fee(
+                    block.base_fee, block.header.block_gas_cost,
+                    block.transactions, receipts, None)
+            except ConsensusError as exc:
+                # block-attributed so the streaming pipeline can
+                # quarantine exactly this block (never a device strike)
+                raise _block_error(
+                    f"machine block: {exc}", block) from exc
 
         # ---------------- stage storage + accounts for the window fold
         self.last_writes = writes_final
@@ -523,6 +533,9 @@ class MachineBlockExecutor:
             return False
         if os.environ.get("CORETH_HOST_EXEC", "native") != "native":
             return False
+        sup = getattr(self.e, "supervisor", None)
+        if sup is not None and not sup.allows("native"):
+            return False  # supervisor demoted the native engine
         calls = [pl for pl in plans if pl.kind == "call"]
         if len(calls) < 2:
             return False
@@ -581,9 +594,17 @@ class MachineBlockExecutor:
                     warm = [pl.sender, pl.to]
                     if warm_coinbase:
                         warm.append(block.header.coinbase)
-                    res = be.call(pl.sender, pl.to, pl.value, pl.price,
-                                  pl.data, pl.gas_limit - pl.intrinsic,
-                                  warm_addrs=warm)
+                    try:
+                        res = be.call(pl.sender, pl.to, pl.value,
+                                      pl.price, pl.data,
+                                      pl.gas_limit - pl.intrinsic,
+                                      warm_addrs=warm)
+                    except Exception as exc:  # noqa: BLE001 — native boundary fault (injected error rc / session loss): strike the native scope and escalate this block off the serial path
+                        sup = getattr(e, "supervisor", None)
+                        if sup is not None:
+                            sup.strike("native", exc)
+                        escaped = True
+                        break
                     if res.needs_host or any(
                             c != pl.to for c, _k in res.writes):
                         escaped = True
@@ -711,11 +732,37 @@ class MachineBlockExecutor:
         runner = self._window_runner()
         chunks = [items[k:k + self.WINDOW]
                   for k in range(0, len(items), self.WINDOW)]
-        consumed = 0
-        ci = 0
         t0 = time.monotonic()
+        # the FIRST dispatch propagates failures: nothing is staged
+        # yet, so the supervisor wrapping this call (engine
+        # _machine_run) can safely retry or strike toward demotion
         inflight = runner.issue(self._window_items(chunks[0]))
         e.stats.t_device += time.monotonic() - t0
+        from coreth_tpu.consensus.engine import ConsensusError
+        from coreth_tpu.replay.engine import ReplayError
+        self._inflight_consumed = 0
+        try:
+            return self._chunk_loop(runner, chunks, inflight)
+        except (ReplayError, ConsensusError):
+            raise  # block-validity failure: never contained here
+        except Exception as exc:  # noqa: BLE001 — a mid-run device fault: keep the committed prefix, hand the tail back for re-classification (a PERSISTENT fault then re-fires at the next run's clean first dispatch, where the supervisor can retry or demote)
+            runner.invalidate()
+            consumed = self._inflight_consumed
+            sup = getattr(e, "supervisor", None)
+            if sup is not None:
+                sup.strike("device", exc)
+            e.commit_pipe.flush()  # fully finished blocks stay committed
+            if not consumed:
+                raise
+            return consumed
+
+    def _chunk_loop(self, runner, chunks, inflight) -> int:
+        """The fused-window chunk loop of execute_run (split out so the
+        fault containment above can recover progress: every fully
+        finished-and-staged block bumps ``_inflight_consumed``)."""
+        e = self.e
+        consumed = 0
+        ci = 0
         while ci < len(chunks):
             chunk = chunks[ci]
             # sharded runner: the collective exchange tensor (tiny) is
@@ -792,6 +839,7 @@ class MachineBlockExecutor:
                         # ahead of the pipelined issue() above
                         runner.commit_block(self.last_writes)
                     consumed += 1
+                    self._inflight_consumed = consumed
                     continue
                 # dirty: partial commits may sit in the device table,
                 # and every later block of the window ran against a

@@ -35,16 +35,41 @@ bench's streaming section publishes.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from coreth_tpu.metrics import Gauge, Histogram, Meter, get_or_register
+from coreth_tpu import faults
+from coreth_tpu.metrics import Counter, Gauge, Histogram, Meter, \
+    get_or_register
 from coreth_tpu.serve.feed import BlockFeed, FeedExhausted
 from coreth_tpu.serve.prefetch import Prefetcher
 from coreth_tpu.types import Block
+
+# Injection points on the serve boundary (coreth_tpu/faults):
+PT_FEED_STALL = faults.declare(
+    "serve/feed_stall", "feed delivers nothing for a while (stall)")
+PT_FEED_DROP = faults.declare(
+    "serve/feed_drop", "feed silently loses a block (sequence gap)")
+PT_MALFORMED = faults.declare(
+    "serve/malformed_block",
+    "a block arrives corrupted (header fields lie about the body)")
+PT_CRASH = faults.declare(
+    "serve/crash",
+    "process dies (SIGKILL) after the Nth committed block")
+
+
+def _corrupt_block(b: Block) -> Block:
+    """The malformed-block injection: a wire-roundtripped copy whose
+    receipt_hash lies — execution still succeeds, every backend's
+    validation fails, which is exactly the poison-block shape the
+    quarantine must absorb without stalling later blocks."""
+    bad = Block.decode(b.encode())
+    bad.header.receipt_hash = b"\xde\xad\xbe\xef" * 8
+    return bad
 
 
 @dataclass
@@ -66,7 +91,17 @@ class StreamReport:
     stages_s: dict = field(default_factory=dict)
     backpressure: dict = field(default_factory=dict)
     feed_stalls: int = 0
+    feed_drops: int = 0
     shutdown: bool = False
+    # fault-tolerance surface: blocks applied-but-unverified (poison
+    # parked without wedging the queue), the supervisor's ladder
+    # counters, checkpoint cadence, armed-plan firing counts, and the
+    # reason the stream halted early (None = ran to exhaustion)
+    quarantined: List[dict] = field(default_factory=list)
+    supervisor: dict = field(default_factory=dict)
+    checkpoint: dict = field(default_factory=dict)
+    faults: dict = field(default_factory=dict)
+    halted: Optional[str] = None
 
     def row(self) -> dict:
         return dict(self.__dict__)
@@ -89,12 +124,36 @@ class StreamingPipeline:
                  depth: Optional[int] = None,
                  window_wait: float = 0.01,
                  commit_delay: float = 0.0,
-                 registry=None):
+                 registry=None,
+                 quarantine: bool = True,
+                 quarantine_limit: int = 8,
+                 checkpoint_every: Optional[int] = None):
+        faults.arm_from_env()  # CORETH_FAULT_PLAN (idempotent)
         self.engine = engine
         self.feed = feed
         self.depth = depth or 2 * engine.window
         self.window_wait = window_wait
         self.commit_delay = commit_delay
+        # serving must not wedge: a poison block (fails every backend)
+        # is applied tolerantly + parked in the report by default;
+        # quarantine=False restores batch replay's strict raise
+        self.quarantine = quarantine
+        self.quarantine_limit = quarantine_limit
+        self._quar_streak = 0
+        # crash-consistent checkpoints (replay/checkpoint.py) every N
+        # committed blocks; default from CORETH_CHECKPOINT, active
+        # only when the engine's Database is disk-backed (rawdb
+        # PersistentNodeDict exposes its kv)
+        if checkpoint_every is None:
+            checkpoint_every = int(os.environ.get("CORETH_CHECKPOINT",
+                                                  "0"))
+        self._ckpt = None
+        ckpt_kv = getattr(engine.db.node_db, "kv", None)
+        if checkpoint_every > 0 and ckpt_kv is not None:
+            from coreth_tpu.replay.checkpoint import CheckpointManager
+            self._ckpt = CheckpointManager(engine, ckpt_kv,
+                                           checkpoint_every)
+        self._expect_number: Optional[int] = None
         self._q_feed: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._q_exec: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
@@ -143,6 +202,18 @@ class StreamingPipeline:
                 if b is None:
                     self.stats.feed_stalls += 1
                     continue
+                # injected feed faults: a stall delays the block, a
+                # drop loses it (the execute stage detects the gap),
+                # a malformed block arrives corrupted (quarantine)
+                if faults.fire(PT_FEED_STALL) is not None:
+                    self.stats.feed_stalls += 1
+                if faults.check(PT_FEED_DROP) is not None:
+                    self.stats.feed_drops += 1
+                    get_or_register("serve/feed_drops", Counter,
+                                    self._registry).inc()
+                    continue
+                if faults.check(PT_MALFORMED) is not None:
+                    b = _corrupt_block(b)
                 it = _Item(block=b, t_enqueue=time.monotonic())
                 if self._t_first_enqueue is None:
                     self._t_first_enqueue = it.t_enqueue
@@ -217,10 +288,59 @@ class StreamingPipeline:
             self._latency.update(now - it.t_enqueue)
             self._tx_meter.mark(len(it.block.transactions))
             self.stats.txs += len(it.block.transactions)
+            # the SIGKILL seam: an armed plan kills the process after
+            # the Nth committed block — mid-stream, past a checkpoint
+            # boundary — to prove the resume path (crash-consistency
+            # tests; a no-op lookup otherwise)
+            faults.fire(PT_CRASH)
         self.stats.blocks += len(items)
         self._committed_blocks += len(items)
         if items:
             self._t_last_commit = now
+            # any clean commit breaks a quarantine streak — the limit
+            # counts CONSECUTIVE quarantined blocks, so _try_quarantine
+            # re-increments right after its own call here
+            self._quar_streak = 0
+            if self._ckpt is not None:
+                self._ckpt.on_committed(len(items))
+
+    # ------------------------------------------------- fault handling
+    def _halt(self, reason: str) -> None:
+        """Stop the stream cleanly with the reason in the report: the
+        committed prefix stays durable (and checkpointed), run()
+        returns its report instead of wedging or crashing."""
+        if self.stats.halted is None:
+            self.stats.halted = reason
+        self._stop.set()
+
+    def _try_quarantine(self, it: _Item, exc: BaseException) -> bool:
+        """A block failed validation on every backend: apply it
+        tolerantly (engine.quarantine_block) and park it in the
+        report.  False (and a halt) when the block cannot even be
+        applied, or when too many consecutive blocks quarantine — the
+        chain itself has diverged and blind progress would be noise."""
+        if not self.quarantine:
+            raise exc
+        if self._quar_streak + 1 > self.quarantine_limit:
+            self._halt(f"quarantine limit ({self.quarantine_limit}) "
+                       f"reached at block {it.block.number}")
+            return False
+        try:
+            reasons = self.engine.quarantine_block(it.block)
+        except Exception as sub:  # noqa: BLE001 — the block cannot even be applied (invalid txs): halt with the reason; resume needs operator intervention
+            self._halt(f"unservable block {it.block.number}: {sub!r}")
+            return False
+        streak = self._quar_streak
+        self.stats.quarantined.append({
+            "number": it.block.number,
+            "hash": it.block.hash().hex(),
+            "reasons": [str(exc)] + reasons,
+        })
+        get_or_register("serve/quarantined", Counter,
+                        self._registry).inc()
+        self._mark_committed([it])  # resets the streak; restore + bump
+        self._quar_streak = streak + 1
+        return True
 
     # ---------------------------------------------------------- execute
     def _next_item(self, idle: bool) -> Optional[_Item]:
@@ -237,6 +357,20 @@ class StreamingPipeline:
                 it = self._q_exec.get(timeout=min(0.05, remaining))
             except queue.Empty:
                 continue
+            # continuity gate: a lost block (dropped upstream, a
+            # wedged peer) would otherwise surface blocks later as a
+            # baffling state-root mismatch — halt HERE with the gap
+            # named, the committed prefix durable (checkpoint), and
+            # the report saying exactly what to refetch
+            num = it.block.number
+            if self._expect_number is not None \
+                    and num != self._expect_number:
+                self._halt(f"sequence gap: got block {num}, "
+                           f"expected {self._expect_number}")
+                get_or_register("serve/sequence_gaps", Counter,
+                                self._registry).inc()
+                return None
+            self._expect_number = num + 1
             # first sight of the block on the execute stage: senders
             # the prefetch stage already recovered count as hits
             self._prefetch_hits += sum(
@@ -250,7 +384,16 @@ class StreamingPipeline:
     def _drive(self) -> None:
         """The execute stage — see the module docstring's stage model.
         Mirrors ReplayEngine.replay()'s issue-ahead/retire-behind loop,
-        driven by arriving items instead of a fixed block list."""
+        driven by arriving items instead of a fixed block list.
+
+        Fault handling on top of the batch loop: a device BackendFault
+        leaves the classified items in the buffer (the supervisor has
+        struck/demoted; they re-route down the ladder next iteration),
+        and a ReplayError carrying its block — a poison block that
+        failed every backend — goes through the quarantine instead of
+        killing the stream."""
+        from coreth_tpu.replay.engine import ReplayError
+        from coreth_tpu.replay.supervisor import BackendFault
         e = self.engine
         buf: List[_Item] = []
         pending = None  # (win, its items) — issued, not yet validated
@@ -277,13 +420,40 @@ class StreamingPipeline:
                 run.append((buf[k].block, batch))
                 k += 1
             e.stats.t_classify += time.monotonic() - t0
-            win = e._issue_window(run) if run else None
+            win = None
+            if run:
+                try:
+                    win = e._issue_window(run)
+                except BackendFault:
+                    # struck (and maybe demoted): the items stay in
+                    # the buffer and re-route through the host ladder
+                    win = None
             # retire the previous window while the chip runs this one
             if pending is not None:
                 p_win, p_items = pending
                 pending = None
-                resume = e._complete_window(
-                    p_win, [it.block for it in p_items], 0)
+                try:
+                    resume = e._complete_window(
+                        p_win, [it.block for it in p_items], 0)
+                except ReplayError as exc:
+                    blk = getattr(exc, "block", None)
+                    if blk is None or not self.quarantine:
+                        raise
+                    # the engine rewound to the prefix before the
+                    # poison block and already retried it on the
+                    # exact host path; quarantine it and hand the
+                    # window tail (stale speculative base) back
+                    j = next((i for i, it in enumerate(p_items)
+                              if it.block is blk), None)
+                    if j is None:
+                        raise
+                    self._mark_committed(p_items[:j])
+                    if win is not None:
+                        e._discard_window(win)
+                    if not self._try_quarantine(p_items[j], exc):
+                        return
+                    buf = p_items[j + 1:] + buf
+                    continue
                 if resume is not None:
                     # prefix [0, resume) is committed (device blocks +
                     # the host-fallback block); the tail re-enters the
@@ -304,7 +474,23 @@ class StreamingPipeline:
                 # flight: machine-OCC run / exact host path, exactly
                 # like batch replay's hit_fallback branch
                 blocks = [it.block for it in buf]
-                n = e._machine_run(blocks, 0)
+                try:
+                    n = e._machine_run(blocks, 0)
+                except ReplayError as exc:
+                    blk = getattr(exc, "block", None)
+                    if blk is None or not self.quarantine:
+                        raise
+                    # blocks before the poison one were committed
+                    # (the fallback flushes staged work first)
+                    j = next((i for i, it in enumerate(buf)
+                              if it.block is blk), None)
+                    if j is None:
+                        raise
+                    self._mark_committed(buf[:j])
+                    if not self._try_quarantine(buf[j], exc):
+                        return
+                    buf = buf[j + 1:]
+                    continue
                 self._mark_committed(buf[:n])
                 buf = buf[n:]
 
@@ -333,6 +519,10 @@ class StreamingPipeline:
             restore()
         if self._errors:
             raise self._errors[0]
+        if self._ckpt is not None and self.stats.blocks:
+            # final checkpoint: the whole committed stream is durable,
+            # a restart resumes at the exact tail
+            self._ckpt.write()
         wall = time.monotonic() - t_start
         self._publish(wall)
         return self.stats
@@ -380,6 +570,15 @@ class StreamingPipeline:
             "commit_flushes": self._commit_flushes,
         }
         s.shutdown = self._shutdown_called
+        # fault-tolerance surface: ladder counters, checkpoint
+        # cadence, and what the armed plan (if any) actually fired
+        sup = getattr(self.engine, "supervisor", None)
+        if sup is not None:
+            s.supervisor = sup.snapshot()
+            sup.publish(self._registry)
+        if self._ckpt is not None:
+            s.checkpoint = self._ckpt.snapshot()
+        s.faults = faults.fired()
         # SLO surface in the metrics registry (scrapeable next to the
         # engine's replay/* gauges)
         reg = self._registry

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional
 
 from coreth_tpu.crypto import bls
+from coreth_tpu.metrics import Counter, get_or_register
 from coreth_tpu.warp.messages import (
     BitSetSignature, SignedMessage, UnsignedMessage,
 )
@@ -25,11 +26,16 @@ class AggregateError(Exception):
 class Aggregator:
     def __init__(self, validator_set: ValidatorSet,
                  fetch_signature: Callable[[bytes, UnsignedMessage],
-                                           Optional[bytes]]):
+                                           Optional[bytes]],
+                 registry=None):
         """fetch_signature(node_id, msg) -> 96-byte signature or None
-        (the peer.NetworkClient seam)."""
+        (the peer.NetworkClient seam).  ``registry`` scopes the
+        warp/peer_faults metric (default: the process registry)."""
         self.validators = validator_set
         self.fetch = fetch_signature
+        self.peer_faults = 0  # per-aggregator twin of warp/peer_faults
+        self._fault_counter = get_or_register("warp/peer_faults",
+                                              Counter, registry)
 
     def aggregate(self, msg: UnsignedMessage, quorum_num: int = 67,
                   quorum_den: int = 100) -> SignedMessage:
@@ -42,7 +48,9 @@ class Aggregator:
         for i, v in enumerate(self.validators.canonical()):
             try:
                 sig = self.fetch(v.node_id, msg)
-            except Exception:  # noqa: BLE001 — peer fault, skip
+            except Exception:  # noqa: BLE001 — peer fault: skip the validator, but COUNT it (warp/peer_faults) — dropped signatures must be observable, not silent
+                self.peer_faults += 1
+                self._fault_counter.inc()
                 continue
             if sig is None:
                 continue

@@ -1,0 +1,161 @@
+"""Subprocess driver for the SIGKILL-resume crash-consistency tests.
+
+Two modes over one disk-backed chain database (rawdb FileDB +
+PersistentNodeDict/PersistentCodeDict):
+
+- ``run``: stream a deterministically-built chain through the
+  StreamingPipeline with checkpointing armed; the parent arms a
+  ``serve/crash`` fault plan (CORETH_FAULT_PLAN) that SIGKILLs this
+  process after the Nth committed block — mid-stream, between
+  checkpoint boundaries, with windows in flight.  If the plan never
+  fires the child exits 3 (the test asserts the kill happened).
+- ``resume``: reopen the SAME database, load the checkpoint record,
+  construct a fresh ReplayEngine at the checkpointed root with the
+  checkpointed parent header, stream the REMAINING blocks, and print a
+  JSON line with the final root — which the parent asserts equals the
+  uninterrupted chain's last header root, bit-identical.
+
+The chain is rebuilt deterministically in each process (fixed keys, no
+randomness), so only the *state* needs to survive the crash.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_chain(workload: str):
+    """(genesis, blocks) for one workload; MUST be deterministic."""
+    from coreth_tpu.chain import Genesis, GenesisAccount, generate_chain
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    from coreth_tpu.params import TEST_CHAIN_CONFIG
+    from coreth_tpu.state import Database
+    from coreth_tpu.types import DynamicFeeTx, sign_tx
+
+    cfg = TEST_CHAIN_CONFIG
+    gwei = 10**9
+    keys = [0x7A00 + i for i in range(8)]
+    addrs = [priv_to_address(k) for k in keys]
+    nonces = [0] * len(keys)
+
+    if workload == "transfer":
+        n_blocks, per_block = 12, 6
+        alloc = {a: GenesisAccount(balance=10**24) for a in addrs}
+
+        def gen(i, bg):
+            for j in range(per_block):
+                k = (i * per_block + j) % len(keys)
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=cfg.chain_id, nonce=nonces[k],
+                    gas_tip_cap_=gwei, gas_fee_cap_=300 * gwei,
+                    gas=21_000, to=bytes([0x40 + k]) * 20,
+                    value=1000 + j), keys[k], cfg.chain_id))
+                nonces[k] += 1
+    elif workload == "erc20":
+        from coreth_tpu.workloads.erc20 import (
+            token_genesis_account, transfer_calldata)
+        token = bytes([0x77]) * 20
+        n_blocks, per_block = 10, 5
+        alloc = {a: GenesisAccount(balance=10**24) for a in addrs}
+        alloc[token] = token_genesis_account({a: 10**18 for a in addrs})
+
+        def gen(i, bg):
+            for j in range(per_block):
+                k = (i * per_block + j) % len(keys)
+                to = addrs[(k + 1) % len(keys)] if j % 3 == 0 \
+                    else bytes([0x50 + (j % 40)]) * 20
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=cfg.chain_id, nonce=nonces[k],
+                    gas_tip_cap_=gwei, gas_fee_cap_=300 * gwei,
+                    gas=100_000, to=token, value=0,
+                    data=transfer_calldata(to, 10 + j)),
+                    keys[k], cfg.chain_id))
+                nonces[k] += 1
+    elif workload == "swap":
+        from coreth_tpu.workloads.swap import (
+            pool_genesis_account, swap_calldata)
+        pool = bytes([0x70]) * 20
+        n_blocks, per_block = 8, 4
+        skeys = [0x6200 + i for i in range(per_block)]
+        saddrs = [priv_to_address(k) for k in skeys]
+        snonces = [0] * len(skeys)
+        alloc = {a: GenesisAccount(balance=10**24) for a in saddrs}
+        alloc[pool] = pool_genesis_account(10**15, 10**15)
+
+        def gen(i, bg):
+            for k in range(per_block):
+                bg.add_tx(sign_tx(DynamicFeeTx(
+                    chain_id_=cfg.chain_id, nonce=snonces[k],
+                    gas_tip_cap_=gwei, gas_fee_cap_=300 * gwei,
+                    gas=200_000, to=pool, value=0,
+                    data=swap_calldata(1000 + 13 * i + k)),
+                    skeys[k], cfg.chain_id))
+                snonces[k] += 1
+    else:
+        raise SystemExit(f"unknown workload {workload!r}")
+
+    genesis = Genesis(config=cfg, gas_limit=8_000_000, alloc=alloc)
+    build_db = Database()
+    gblock = genesis.to_block(build_db)
+    blocks, _ = generate_chain(cfg, gblock, build_db, n_blocks, gen,
+                               gap=2)
+    return genesis, blocks
+
+
+def open_db(dbdir: str):
+    from coreth_tpu.rawdb.kv import FileDB
+    from coreth_tpu.rawdb.state_manager import (
+        PersistentCodeDict, PersistentNodeDict)
+    from coreth_tpu.state import Database
+    kv = FileDB(os.path.join(dbdir, "chain.db"))
+    db = Database(node_db=PersistentNodeDict(kv),
+                  code_db=PersistentCodeDict(kv))
+    return kv, db
+
+
+def main() -> int:
+    workload, dbdir, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.serve import ChainFeed, StreamingPipeline
+
+    genesis, blocks = build_chain(workload)
+    kv, db = open_db(dbdir)
+
+    if mode == "run":
+        gblock = genesis.to_block(db)
+        engine = ReplayEngine(genesis.config, db, gblock.root,
+                              parent_header=gblock.header,
+                              capacity=256, batch_pad=64, window=4)
+        pipe = StreamingPipeline(engine, ChainFeed(list(blocks)))
+        pipe.run()
+        # the armed serve/crash plan should have SIGKILLed us mid-run
+        print("NOKILL", flush=True)
+        return 3
+
+    # mode == "resume"
+    from coreth_tpu.replay.checkpoint import resume_engine
+    engine, ckpt = resume_engine(
+        genesis.config, db, kv, capacity=256, batch_pad=64, window=4)
+    if engine is None:
+        print("NOCHECKPOINT", flush=True)
+        return 4
+    # blocks[i] carries number i+1: resume feeding from ckpt.number+1
+    rest = list(blocks[ckpt.number:])
+    pipe = StreamingPipeline(engine, ChainFeed(rest))
+    report = pipe.run()
+    out = {
+        "resumed_from": ckpt.number,
+        "resumed_root": ckpt.root.hex(),
+        "blocks_replayed": report.blocks,
+        "final_root": engine.root.hex(),
+        "expected_root": blocks[-1].header.root.hex(),
+    }
+    print(json.dumps(out), flush=True)
+    kv.close()
+    return 0 if out["final_root"] == out["expected_root"] else 5
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,181 @@
+"""Crash-consistent checkpoint/resume (replay/checkpoint.py).
+
+The headline test SIGKILLs a streaming replay mid-window in a REAL
+subprocess (an armed ``serve/crash`` fault plan — no atexit, no flush,
+the honest crash) and resumes a second process from the durable
+checkpoint, asserting bit-identical final roots to the uninterrupted
+chain — across transfer/erc20/swap x CORETH_TRIE=native|py.
+
+In-process tests pin the protocol pieces: record roundtrip through the
+rawdb schema, resume equivalence without a kill, and the torn
+checkpoint (a crash between the node flush and the record write must
+leave the PREVIOUS record valid — the write-order argument).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu import faults
+from coreth_tpu.faults import FaultInjected, FaultPlan, FaultSpec
+from coreth_tpu.mpt import native_trie
+from coreth_tpu.serve import ChainFeed, StreamingPipeline
+
+from tests.ckpt_child import build_chain, open_db
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BACKENDS = ["py"] + (["native"] if native_trie.available() else [])
+
+
+def _engine_over(genesis, db, gblock):
+    from coreth_tpu.replay import ReplayEngine
+    return ReplayEngine(genesis.config, db, gblock.root,
+                        parent_header=gblock.header, capacity=256,
+                        batch_pad=64, window=4)
+
+
+# ---------------------------------------------------------------- in-process
+
+def test_checkpoint_record_roundtrip(tmp_path):
+    from coreth_tpu.rawdb.kv import FileDB
+    from coreth_tpu.rawdb import schema
+    from coreth_tpu.replay.checkpoint import load_checkpoint
+    from coreth_tpu.types.block import Header
+    kv = FileDB(str(tmp_path / "c.db"))
+    assert load_checkpoint(kv) is None
+    h = Header(number=7, root=b"\x11" * 32, time=1234,
+               gas_limit=8_000_000)
+    schema.write_replay_checkpoint(kv, 7, h.hash(), h.root, h.encode())
+    kv.close()
+    kv2 = FileDB(str(tmp_path / "c.db"))
+    ck = load_checkpoint(kv2)
+    assert (ck.number, ck.block_hash, ck.root) == (7, h.hash(), h.root)
+    assert ck.header.encode() == h.encode()
+
+
+def test_inprocess_checkpoint_and_resume(tmp_path):
+    """No kill: stream a prefix with checkpointing on, abandon the
+    process state entirely, reopen the SAME disk store, resume the
+    tail, land on the exact final root."""
+    genesis, blocks = build_chain("transfer")
+    kv, db = open_db(str(tmp_path))
+    gblock = genesis.to_block(db)
+    eng = _engine_over(genesis, db, gblock)
+    pipe = StreamingPipeline(eng, ChainFeed(list(blocks[:7])),
+                             checkpoint_every=3)
+    rep = pipe.run()
+    assert rep.checkpoint["written"] >= 2  # interval + final
+    assert rep.checkpoint["last_number"] == blocks[6].number
+    kv.close()
+    del eng, db  # "crash": all in-memory state gone
+
+    kv2, db2 = open_db(str(tmp_path))
+    from coreth_tpu.replay.checkpoint import resume_engine
+    eng2, ckpt = resume_engine(genesis.config, db2, kv2, capacity=256,
+                               batch_pad=64, window=4)
+    assert ckpt.number == blocks[6].number
+    assert eng2.root == blocks[6].header.root
+    pipe2 = StreamingPipeline(eng2, ChainFeed(list(blocks[7:])))
+    pipe2.run()
+    assert eng2.root == blocks[-1].header.root
+    kv2.close()
+
+
+def test_torn_checkpoint_keeps_previous(tmp_path):
+    """The crash_gap seam: a failure between the node flush and the
+    record write must leave the previous record authoritative — the
+    orphaned nodes are harmless (content-addressed)."""
+    from coreth_tpu.replay.checkpoint import (
+        CheckpointManager, load_checkpoint)
+    genesis, blocks = build_chain("transfer")
+    kv, db = open_db(str(tmp_path))
+    gblock = genesis.to_block(db)
+    eng = _engine_over(genesis, db, gblock)
+    eng.replay(list(blocks[:4]))
+    mgr = CheckpointManager(eng, kv, every=1)
+    mgr.write()
+    first = load_checkpoint(kv)
+    assert first.number == blocks[3].number
+
+    eng.replay(list(blocks[4:8]))
+    with faults.armed(FaultPlan({"checkpoint/crash_gap":
+                                 FaultSpec()})):
+        with pytest.raises(FaultInjected):
+            mgr.write()
+    # the torn write left the PREVIOUS record intact and loadable...
+    ck = load_checkpoint(kv)
+    assert ck.number == first.number and ck.root == first.root
+    kv.close()
+    # ...and a resume from it replays the tail to the true final root
+    kv2, db2 = open_db(str(tmp_path))
+    from coreth_tpu.replay.checkpoint import resume_engine
+    eng2, ckpt = resume_engine(genesis.config, db2, kv2, capacity=256,
+                               batch_pad=64, window=4)
+    assert ckpt.number == first.number
+    eng2.replay(list(blocks[ckpt.number:]))
+    assert eng2.root == blocks[-1].header.root
+    kv2.close()
+
+
+# ---------------------------------------------------------------- subprocess
+
+def _spawn(args, env, timeout=240):
+    """Run a ckpt_child with the repo's child-process deadline pattern
+    (tests/test_two_process.py): a hard wall so a wedged child cannot
+    eat the suite's budget."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "ckpt_child.py")]
+        + args,
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + timeout
+    while proc.poll() is None:
+        if time.time() > deadline:
+            proc.kill()
+            proc.wait(timeout=30)
+            raise RuntimeError(
+                f"ckpt child wedged past {timeout}s: {args}")
+        time.sleep(0.1)
+    out, err = proc.communicate()
+    return proc.returncode, out, err
+
+
+def _child_env(backend):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               CORETH_TRIE=backend)
+    env.pop("CORETH_FAULT_PLAN", None)
+    return env
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("workload", ["transfer", "erc20", "swap"])
+def test_sigkill_resume_matrix(tmp_path, workload, backend):
+    """The acceptance matrix: SIGKILL a streaming run mid-window;
+    resume from the checkpoint; final roots bit-identical to the
+    uninterrupted chain (its own header roots ARE the uninterrupted
+    truth — batch/stream equivalence is pinned by tests/test_serve)."""
+    dbdir = str(tmp_path)
+    env = _child_env(backend)
+    env["CORETH_CHECKPOINT"] = "3"
+    env["CORETH_FAULT_PLAN"] = json.dumps(
+        {"serve/crash": {"after": 5, "action": "sigkill"}})
+    rc, out, err = _spawn([workload, dbdir, "run"], env)
+    # the plan SIGKILLed the child mid-run (never a clean exit)
+    assert rc == -9, (rc, out[-500:], err[-500:])
+
+    env_resume = _child_env(backend)
+    env_resume["CORETH_CHECKPOINT"] = "3"
+    rc, out, err = _spawn([workload, dbdir, "resume"], env_resume)
+    assert rc == 0, (rc, out[-500:], err[-2000:])
+    info = json.loads(out.strip().splitlines()[-1])
+    assert info["final_root"] == info["expected_root"]
+    # the kill landed mid-stream: the checkpoint is past genesis and
+    # before the tip, so the resume genuinely replayed a tail
+    assert 0 < info["resumed_from"]
+    assert info["blocks_replayed"] >= 1

@@ -360,6 +360,35 @@ def test_occ_recompile_free_table_growth(monkeypatch):
     assert lr <= 2          # bounded: once per pow2 bucket crossed
 
 
+def test_occ_prewarm_compile_thread_ab(monkeypatch):
+    """The pre-warm compile rides a background compile thread by
+    default (the dispatch that needs the bucket JOINS any in-flight
+    warm, so retraces stay zero); CORETH_COMPILE_THREAD=0 restores
+    the synchronous compile — bit-identical roots and the same
+    zero-retrace guarantee either way."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen(i, nonces):
+        return [_tx(k, nonces, TOKEN,
+                    transfer_calldata(
+                        bytes([0xA0 + i]) + bytes([k]) * 19, 3 + k))
+                for k in range(8)]
+
+    gblock, blocks = _build_chain(8, gen)
+    eng = _replay(gblock, blocks)            # async (default)
+    mx = eng._machine
+    assert mx._runner._compile_async
+    assert mx._runner.table_cap >= 128
+    assert mx.machine_counters()["kernel_retraces"] == 0
+
+    monkeypatch.setenv("CORETH_COMPILE_THREAD", "0")
+    sync = _replay(gblock, blocks)           # synchronous A/B
+    assert sync.root == eng.root == blocks[-1].root
+    assert not sync._machine._runner._compile_async
+    assert sync._machine.machine_counters()["kernel_retraces"] == 0
+
+
 def test_occ_ineligible_spec_raises():
     """MachineRunner.run refuses ineligible code outright: scan_code
     gives it empty jumpdests, so silent acceptance would turn a taken
