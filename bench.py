@@ -819,6 +819,131 @@ def run_streaming():
     return out
 
 
+def run_flat_state():
+    """Flat-state section (state/flat): the cold-read microbench —
+    the SAME key population resolved through the flat store vs the
+    trie-walk path it replaced — plus checkpoint durability cost ON vs
+    OFF the execute thread (background stamp vs synchronous write,
+    both recorded) and the layer's hit/miss counters.  All regression
+    signals are RATIOS (speedup, stamp-vs-export), never absolute
+    txs/s (the bench-drift rule: boxes differ, ratios travel)."""
+    from coreth_tpu.replay.checkpoint import CheckpointManager
+    from coreth_tpu.serve import ChainFeed, StreamingPipeline
+    from coreth_tpu.types import Block
+    genesis, blocks = build_or_load_chain("erc20")
+    n = min(len(blocks), int(os.environ.get("BENCH_FLAT_BLOCKS", "48")))
+    wire = [b.encode() for b in blocks[:n]]
+    out = {"blocks": n}
+
+    # ---- replay once with the layer on: counters + key population
+    fresh = [Block.decode(w) for w in wire]
+    engine = _fresh_engine(genesis, ERC20_TXS)
+    if engine.flat is None:
+        return {"skipped": "CORETH_FLAT=0"}
+    engine.replay_block(fresh[0])
+    engine.replay(fresh[1:])
+    assert engine.root == fresh[-1].header.root
+    engine.commit_pipe.flush()
+    flat = engine.flat
+    out["counters"] = flat.snapshot()
+
+    # ---- cold-read microbench: flat dict vs the trie-walk path
+    # (engine.trie / storage tries — native C++ when built, so the
+    # denominator is the FAST pre-flat path, not a strawman)
+    addrs = sorted(flat.accounts)[:512]
+    slots = sorted((a, k) for a, sub in flat.storage.items()
+                   for k in sub)[:512]
+    reads = len(addrs) + len(slots)
+    reps = max(1, 100_000 // max(1, reads))
+    t0 = time.monotonic()
+    for _ in range(reps):
+        for a in addrs:
+            flat.account(a)
+        for c, k in slots:
+            flat.storage_value(c, k)
+    t_flat = time.monotonic() - t0
+    t0 = time.monotonic()
+    for _ in range(reps):
+        for a in addrs:
+            engine.trie.get(a)
+        for c, k in slots:
+            engine._storage_trie(c).get(k)
+    t_trie = time.monotonic() - t0
+    out["cold_read"] = {
+        "reads": reads * reps,
+        "flat_us_per_read": round(1e6 * t_flat / (reads * reps), 3),
+        "trie_us_per_read": round(1e6 * t_trie / (reads * reps), 3),
+        # the acceptance ratio: >= 3x over the replaced trie-walk path
+        "speedup": round(t_trie / max(t_flat, 1e-9), 2),
+        "trie_backend": "native" if engine._native else "py",
+    }
+
+    # ---- checkpoint durability: background stamp vs sync write, on
+    # a real disk-backed store (tempdir FileDB + PersistentNodeDict)
+    import shutil
+    import tempfile
+    from coreth_tpu.rawdb.kv import FileDB
+    from coreth_tpu.rawdb.state_manager import (
+        PersistentCodeDict, PersistentNodeDict)
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.state import Database
+
+    def ckpt_run(sync: bool):
+        td = tempfile.mkdtemp(prefix="bench_flat_")
+        try:
+            kv = FileDB(os.path.join(td, "chain.db"))
+            db = Database(node_db=PersistentNodeDict(kv),
+                          code_db=PersistentCodeDict(kv))
+            gblock = genesis.to_block(db)
+            eng = ReplayEngine(genesis.config, db, gblock.root,
+                               parent_header=gblock.header,
+                               batch_pad=ERC20_TXS,
+                               window=int(os.environ.get(
+                                   "BENCH_STREAM_WINDOW", "32")))
+            if sync:
+                os.environ["CORETH_CHECKPOINT_SYNC"] = "1"
+            try:
+                pipe = StreamingPipeline(
+                    eng, ChainFeed([Block.decode(w) for w in wire]),
+                    window_wait=0.005, checkpoint_every=8)
+                t0 = time.monotonic()
+                rep = pipe.run()
+                wall = time.monotonic() - t0
+            finally:
+                os.environ.pop("CORETH_CHECKPOINT_SYNC", None)
+            assert eng.root == fresh[-1].header.root
+            kv.close()
+            return rep, wall
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+
+    rep_bg, wall_bg = ckpt_run(sync=False)
+    ck = rep_bg.checkpoint
+    out["checkpoint_background"] = {
+        "wall_s": round(wall_bg, 3),
+        "records": ck["written"],
+        # the execute thread only pays the stamps...
+        "stamp_us": ck["stamp_us"],
+        # ...while the exporter thread pays the Merkleization + fsync
+        "export_ms": ck["exporter"]["export_ms"],
+        "entries": ck["exporter"]["entries_written"],
+    }
+    if not _deadline_tight():
+        rep_sy, wall_sy = ckpt_run(sync=True)
+        cks = rep_sy.checkpoint
+        out["checkpoint_sync"] = {
+            "wall_s": round(wall_sy, 3),
+            "records": cks["written"],
+            "write_ms": cks["write_ms"],   # on the execute thread
+        }
+        # the tentpole ratio: execute-thread durability cost,
+        # background stamps vs synchronous exports
+        stamp_ms = max(ck["stamp_us"] / 1000.0, 1e-3)
+        out["execute_thread_cost_ratio"] = round(
+            cks["write_ms"] / stamp_ms, 1)
+    return out
+
+
 def run_faults():
     """Fault-tolerance section: canned fault plans over a small
     transfer chain, reporting what the supervisor DID about them —
@@ -1065,7 +1190,7 @@ def main():
         else:
             skipped.append("mixed")
 
-        _begin_section(0.90)
+        _begin_section(0.88)
         if _remaining() > 45:
             # streaming ingestion (serve/): sustained-rate p50/p99
             # block latency through the bounded-queue pipeline — the
@@ -1075,7 +1200,7 @@ def main():
         else:
             skipped.append("streaming")
 
-        _begin_section(0.95)
+        _begin_section(0.92)
         if _remaining() > 30:
             # fault tolerance: demotion counts + recovery latency
             # under canned fault plans (supervisor + quarantine)
@@ -1083,6 +1208,15 @@ def main():
             _section_done("faults")
         else:
             skipped.append("faults")
+
+        _begin_section(0.96)
+        if _remaining() > 30:
+            # flat-state layer: cold-read speedup ratio + checkpoint
+            # stamp-vs-export attribution (state/flat)
+            result["flat_state"] = run_flat_state()
+            _section_done("flat_state")
+        else:
+            skipped.append("flat_state")
 
         _begin_section(0.99)
         if _remaining() > 40:

@@ -494,6 +494,11 @@ class MachineWindowRunner:
         # legacy miss-and-rerun / rebuild-and-retrace paths)
         self._predict = bool(int(os.environ.get(
             "CORETH_PREMAP_PREDICT", "1")))
+        # second-level (nested-mapping) recipes — allowance-style
+        # keccak(pad32(b) || keccak(pad32(a) || pad32(p))) keys — are
+        # separately A/B-able under the prediction umbrella
+        self._nest = bool(int(os.environ.get(
+            "CORETH_PREMAP_NEST", "1")))
         self._prebucket = bool(int(os.environ.get(
             "CORETH_GROWTH_PREBUCKET", "1")))
         # pre-warm compiles ride the background compile thread by
@@ -518,6 +523,7 @@ class MachineWindowRunner:
         # ---- counters (surfaced via machine stats + bench)
         self.premap_predicted = 0   # predicted keys seeded into premaps
         self.premap_hits = 0        # predicted keys lanes then touched
+        self.premap_nested = 0      # keys derived via 2nd-level recipes
         self.discovery_dispatches = 0  # re-dispatches for missed keys
         self.kernel_retraces = 0    # mid-run compiles at dispatch time
 
@@ -577,6 +583,12 @@ class MachineWindowRunner:
         return len(self.vals)
 
     # -------------------------------------------------------- prediction
+    def _rc_src(self, t: TxSpec, tag: tuple) -> bytes:
+        """A recipe source tag's padded 32-byte value for THIS lane."""
+        if tag[0] == "caller":
+            return b"\x00" * 12 + t.caller
+        return _cd_word(t.calldata, tag[1])
+
     def _learn_recipes(self, t: TxSpec, missed: List[bytes]) -> None:
         """Explain a lane's missed keys as
         ``keccak(pad32(source) || pad32(slot))`` over the lane's caller
@@ -584,7 +596,17 @@ class MachineWindowRunner:
         becomes a recipe that derives FUTURE lanes' keys from their own
         inputs before dispatch.  One erc20 discovery cycle teaches
         ("caller", 0) and ("data", 0, 0) — from then on fresh
-        recipients premap without a second dispatch."""
+        recipients premap without a second dispatch.
+
+        A miss no first-level derivation explains is tried one level
+        deeper: ``keccak(pad32(src2) || inner)`` where ``inner`` is one
+        of the first-level digests — the Solidity NESTED-mapping rule
+        (``mapping(a => mapping(b => v))`` at slot p stores ``v`` at
+        ``keccak(pad32(b) || keccak(pad32(a) || pad32(p)))``, the
+        allowance shape).  A match records a second-level recipe
+        ``(sel, "nest", outer_tag, inner_tag, slot)``, so
+        allowance-style lanes stop falling back to discovery
+        (CORETH_PREMAP_NEST=0 restores the miss-and-rerun A/B)."""
         if not self._predict or not missed:
             return
         recipes = self.recipes.setdefault(t.address, {})
@@ -606,13 +628,36 @@ class MachineWindowRunner:
                 for slot in range(self.SLOT_SCAN)]
         digs = keccak256_many(msgs)
         want = dict.fromkeys(missed)
+        explained: Dict[bytes, None] = {}
         i = 0
         for tag, _src in srcs:
             for slot in range(self.SLOT_SCAN):
                 if _norm_slot_key(digs[i]) in want \
                         and len(recipes) < self.RECIPE_CAP:
                     recipes[(sel,) + tag + (slot,)] = None
+                    explained[_norm_slot_key(digs[i])] = None
                 i += 1
+        if not self._nest or len(recipes) >= self.RECIPE_CAP:
+            return
+        leftover = dict.fromkeys(k for k in want if k not in explained)
+        if not leftover:
+            return
+        # second level: outer keccaks over every first-level digest as
+        # the candidate inner hash — |srcs| * |srcs| * SLOT_SCAN
+        # keccaks, one batched call, only for unexplained misses
+        msgs2 = [src2 + digs[i]
+                 for _tag2, src2 in srcs
+                 for i in range(len(digs))]
+        digs2 = keccak256_many(msgs2)
+        j = 0
+        for tag2, _src2 in srcs:
+            for i in range(len(digs)):
+                if _norm_slot_key(digs2[j]) in leftover \
+                        and len(recipes) < self.RECIPE_CAP:
+                    tag1 = srcs[i // self.SLOT_SCAN][0]
+                    slot = i % self.SLOT_SCAN
+                    recipes[(sel, "nest", tag2, tag1, slot)] = None
+                j += 1
 
     # ------------------------------------------------------------- shape
     def _occ_params(self, items, premaps):
@@ -727,8 +772,10 @@ class MachineWindowRunner:
         static PUSH-constant footprint + learned keccak recipes applied
         to the lane's own caller/calldata), then the seeded storage
         view, the common-key residue, and keys discovered by earlier
-        attempts.  Every recipe keccak of the whole window goes through
-        ONE batched call (crypto.keccak256_many ->
+        attempts.  Recipe keccaks batch across the whole window: one
+        call for every first-level digest (which doubles as the INNER
+        hash of the nested recipes), then one call for the nested
+        recipes' outer keccaks (crypto.keccak256_many ->
         coreth_keccak256_batch).  Returns (premaps, predicted) where
         ``predicted[bi][li]`` is the prediction-only key set (hit-rate
         accounting in _update_common)."""
@@ -739,20 +786,44 @@ class MachineWindowRunner:
                 block_meta = []
                 for t in specs:
                     sel = bytes(t.calldata[:4])
-                    lane = [rc for rc
-                            in self.recipes.get(t.address, ())
-                            if rc[0] == sel]
-                    for rc in lane:
-                        if rc[1] == "caller":
-                            src, slot = b"\x00" * 12 + t.caller, rc[2]
+                    lane = []
+                    for rc in self.recipes.get(t.address, ()):
+                        if rc[0] != sel:
+                            continue
+                        if rc[1] == "nest":
+                            if not self._nest:
+                                continue
+                            _sel, _n, tag2, tag1, slot = rc
+                            msgs.append(self._rc_src(t, tag1)
+                                        + slot.to_bytes(32, "big"))
+                            lane.append(("nest",
+                                         self._rc_src(t, tag2)))
+                        elif rc[1] == "caller":
+                            msgs.append(b"\x00" * 12 + t.caller
+                                        + rc[2].to_bytes(32, "big"))
+                            lane.append(("flat",))
                         else:
-                            src, slot = _cd_word(t.calldata,
-                                                 rc[2]), rc[3]
-                        msgs.append(src + slot.to_bytes(32, "big"))
+                            msgs.append(_cd_word(t.calldata, rc[2])
+                                        + rc[3].to_bytes(32, "big"))
+                            lane.append(("flat",))
                     block_meta.append(lane)
                 meta.append(block_meta)
         digs = keccak256_many(msgs)
+        # second batch: the nested recipes' outer keccaks consume the
+        # raw inner digests (the kernel computes keccak of the raw
+        # 32-byte hash; only the FINAL key normalizes via the bit-0
+        # storage-partition mask)
+        msgs2: List[bytes] = []
         di = 0
+        for block_meta in meta:
+            for lane in block_meta:
+                for entry in lane:
+                    if entry[0] == "nest":
+                        msgs2.append(entry[1] + digs[di])
+                    di += 1
+        digs2 = keccak256_many(msgs2)
+        di = 0
+        dj = 0
         premaps = []
         predicted = []
         for bi, ((_env, specs), disc) in enumerate(
@@ -766,8 +837,13 @@ class MachineWindowRunner:
                     for k in _static_premap(t.code):
                         keys[k] = None
                         pred[k] = None
-                    for _rc in meta[bi][li]:
-                        k = _norm_slot_key(digs[di])
+                    for entry in meta[bi][li]:
+                        if entry[0] == "nest":
+                            k = _norm_slot_key(digs2[dj])
+                            dj += 1
+                            self.premap_nested += 1
+                        else:
+                            k = _norm_slot_key(digs[di])
                         di += 1
                         keys[k] = None
                         pred[k] = None

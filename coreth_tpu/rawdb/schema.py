@@ -32,6 +32,23 @@ CODE_PREFIX = b"c"
 LAST_ACCEPTED_KEY = b"LastAcceptedKey"
 LAST_ROOT_KEY = b"LastRoot"
 REPLAY_CHECKPOINT_KEY = b"ReplayCheckpoint"
+# flat-state layer (state/flat): hash-keyed base entries + meta stamp.
+# 'fa' ++ keccak(addr)         -> rlp([num8, addr, account-fields])
+# 'fs' ++ keccak(addr) ++ slot -> rlp([num8, addr, value])
+# Every value carries the writing generation's block number and the
+# raw-address preimage (the in-memory store is raw-keyed; keccak is
+# not invertible), so a reload can both rebuild the raw-keyed dicts
+# and skip entries newer than the checkpoint record it resumes from.
+FLAT_ACCOUNT_PREFIX = b"fa"
+FLAT_STORAGE_PREFIX = b"fs"
+# 'fb' ++ keccak(addr) -> num8: a STORAGE BARRIER — the account was
+# destructed in that generation, so persisted 'fs' entries stamped
+# BELOW the barrier are dead (same-generation re-create writes, stamped
+# equal, survive).  Without it a destruct+re-create would resurrect
+# stale slot values on reload (old entries are never individually
+# deletable — keccak keys are not enumerable per account).
+FLAT_BARRIER_PREFIX = b"fb"
+FLAT_META_KEY = b"FlatMeta"
 
 
 def _num8(n: int) -> bytes:
@@ -159,3 +176,78 @@ def read_replay_checkpoint(kv: KVStore):
         return None
     number, block_hash, root, header_rlp = rlp.decode(raw)
     return rlp.decode_uint(number), block_hash, root, header_rlp
+
+
+# ----------------------------------------------------------- flat state
+
+def write_flat_account(kv: KVStore, addr_hash: bytes, number: int,
+                       addr: bytes, account) -> None:
+    """One flat-base account entry.  ``account`` is the store's
+    (balance, nonce, root, code_hash, multicoin) tuple, or None for a
+    known-deleted account (the tombstone form)."""
+    if account is None:
+        fields = []
+    else:
+        balance, nonce, root, code_hash, multicoin = account
+        fields = [rlp.encode_uint(balance), rlp.encode_uint(nonce),
+                  root, code_hash, rlp.encode_uint(1 if multicoin
+                                                   else 0)]
+    kv.put(FLAT_ACCOUNT_PREFIX + addr_hash,
+           rlp.encode([_num8(number), addr, fields]))
+
+
+def parse_flat_account(key: bytes, value: bytes):
+    """(number, addr, account_tuple | None) when ``key`` is a flat
+    account entry, else None (not this table)."""
+    if key[:2] != FLAT_ACCOUNT_PREFIX or len(key) != 2 + 32:
+        return None
+    number, addr, fields = rlp.decode(value)
+    if not fields:
+        return int.from_bytes(number, "big"), addr, None
+    balance, nonce, root, code_hash, mc = fields
+    return (int.from_bytes(number, "big"), addr,
+            (rlp.decode_uint(balance), rlp.decode_uint(nonce), root,
+             code_hash, bool(rlp.decode_uint(mc))))
+
+
+def write_flat_storage(kv: KVStore, addr_hash: bytes, slot_key: bytes,
+                       number: int, addr: bytes, value: int) -> None:
+    kv.put(FLAT_STORAGE_PREFIX + addr_hash + slot_key,
+           rlp.encode([_num8(number), addr, rlp.encode_uint(value)]))
+
+
+def parse_flat_storage(key: bytes, value: bytes):
+    """(number, addr, slot_key, value) for a flat storage entry, else
+    None."""
+    if key[:2] != FLAT_STORAGE_PREFIX or len(key) != 2 + 32 + 32:
+        return None
+    number, addr, val = rlp.decode(value)
+    return (int.from_bytes(number, "big"), addr, key[2 + 32:],
+            rlp.decode_uint(val))
+
+
+def write_flat_barrier(kv: KVStore, addr_hash: bytes,
+                       number: int) -> None:
+    kv.put(FLAT_BARRIER_PREFIX + addr_hash, _num8(number))
+
+
+def parse_flat_barrier(key: bytes, value: bytes):
+    """(addr_hash, number) for a storage-barrier entry, else None."""
+    if key[:2] != FLAT_BARRIER_PREFIX or len(key) != 2 + 32:
+        return None
+    return key[2:], int.from_bytes(value, "big")
+
+
+def write_flat_meta(kv: KVStore, number: int, root: bytes) -> None:
+    """The exporter's base stamp: the newest generation whose entries
+    are durably written (informational — reloads trust the checkpoint
+    record, with per-entry number stamps as the filter)."""
+    kv.put(FLAT_META_KEY, rlp.encode([_num8(number), root]))
+
+
+def read_flat_meta(kv: KVStore):
+    raw = kv.get(FLAT_META_KEY)
+    if raw is None:
+        return None, None
+    number, root = rlp.decode(raw)
+    return int.from_bytes(number, "big"), root

@@ -29,6 +29,8 @@ SIGKILLs a streaming run mid-window in a subprocess to prove it).
 
 from __future__ import annotations
 
+import os
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -66,7 +68,13 @@ def resume_engine(config, db, kv, engine_cls=None, **engine_kw):
     """(engine, checkpoint) resumed from ``kv``'s record, or
     (None, None) when no checkpoint exists (caller starts from
     genesis).  ``db`` must be backed by the same store the crashed run
-    wrote through (rawdb PersistentNodeDict / PersistentCodeDict)."""
+    wrote through (rawdb PersistentNodeDict / PersistentCodeDict).
+
+    The persisted flat base reloads too: entries stamped at or below
+    the record's block are exactly the committed prefix (the exporter
+    may have written newer entries before the crash — their number
+    stamps exclude them), so the resumed engine starts with a warm
+    flat layer instead of re-walking the trie cold."""
     ckpt = load_checkpoint(kv)
     if ckpt is None:
         return None, None
@@ -75,6 +83,9 @@ def resume_engine(config, db, kv, engine_cls=None, **engine_kw):
         engine_cls = ReplayEngine
     eng = engine_cls(config, db, ckpt.root,
                      parent_header=ckpt.header, **engine_kw)
+    flat = getattr(eng, "flat", None)
+    if flat is not None:
+        flat.load(kv, ckpt.number)
     return eng, ckpt
 
 
@@ -83,14 +94,29 @@ class CheckpointManager:
 
     ``every`` is in committed blocks (the ``CORETH_CHECKPOINT`` knob);
     callers feed :meth:`on_committed` from their commit path — the
-    streaming pipeline's ``_mark_committed`` — and the manager writes
-    at block-``every`` boundaries.  Writing is synchronous on the
-    execute thread (the engine's tries are single-owner) but cheap:
-    ``engine.commit()`` exports only nodes newer than the last export,
-    and the record itself is ~600 bytes.
+    streaming pipeline's ``_mark_committed`` — and the manager
+    checkpoints at block-``every`` boundaries.
+
+    Two durability modes:
+
+    - **background** (default whenever the engine carries a flat
+      layer; ``CORETH_CHECKPOINT_SYNC=1`` opts out): the execute
+      thread only STAMPS a checkpoint marker into the flat store's
+      generation log — O(1), measured in ``stamp_ns`` — and the
+      :class:`~coreth_tpu.state.flat.FlatExporter` worker re-derives
+      the trie from the frozen diff generations, fsyncs the nodes, and
+      writes the record off the critical path.
+    - **synchronous** (legacy, PR 10): :meth:`write` flushes, exports
+      the engine's own tries, and writes the record on the caller's
+      thread.
+
+    Both keep the PR-10 crash-consistency write order (nodes durable
+    before the record), so a found record always implies its root's
+    full node closure.
     """
 
-    def __init__(self, engine, kv, every: int):
+    def __init__(self, engine, kv, every: int,
+                 background: Optional[bool] = None):
         if every <= 0:
             raise ValueError("checkpoint interval must be positive")
         self.engine = engine
@@ -99,20 +125,87 @@ class CheckpointManager:
         self.written = 0
         self.last_number: Optional[int] = None
         self._since = 0
+        self.stamp_ns = 0     # execute-thread cost of background stamps
+        self.write_ns = 0     # execute-thread cost of sync write()s
+        flat = getattr(engine, "flat", None)
+        if background is None:
+            background = flat is not None and not bool(int(
+                os.environ.get("CORETH_CHECKPOINT_SYNC", "0")))
+        self.exporter = None
+        if background and flat is not None:
+            from coreth_tpu.state.flat import FlatExporter
+            # seed the shadow tries with a ONE-TIME synchronous commit
+            # of the engine's current state (for a fresh engine this is
+            # the already-persisted genesis/resume root): generations
+            # sealed before this point are covered by the seed, so the
+            # worker starts cleanly no matter when the manager attaches
+            engine.commit_pipe.flush()
+            seed_root = engine.commit()
+            flat.mark_preexisting_exported()
+            self.exporter = FlatExporter(flat, engine.db, kv,
+                                         seed_root)
+            self.exporter.on_record = self._on_record
+            self.exporter.start()
+
+    def _on_record(self, gen) -> None:
+        """Exporter-thread callback: one durable record landed."""
+        self.written += 1
+        self.last_number = gen.number
 
     def on_committed(self, n_blocks: int) -> bool:
-        """Account ``n_blocks`` newly committed blocks; write a
-        checkpoint when the interval fills.  Returns True iff one was
+        """Account ``n_blocks`` newly committed blocks; checkpoint
+        when the interval fills.  Returns True iff one was stamped or
         written."""
         self._since += n_blocks
         if self._since < self.every:
             return False
         self._since = 0
+        if self.exporter is not None:
+            return self.stamp()
         self.write()
         return True
 
+    def stamp(self) -> bool:
+        """Background mode: mark the flat store's tip as a checkpoint
+        boundary (an empty marker generation the exporter turns into
+        nodes + record).  This is the ONLY checkpoint work the execute
+        thread pays."""
+        t0 = time.monotonic_ns()
+        gen = self.engine.flat.mark_checkpoint()
+        self.stamp_ns += time.monotonic_ns() - t0
+        return gen is not None
+
+    def drain(self, timeout_s: int = 120) -> None:
+        """Block until the exporter has made every stamped checkpoint
+        durable (stream shutdown / the final checkpoint)."""
+        if self.exporter is not None:
+            self.exporter.drain(timeout_s)
+
+    def close(self) -> None:
+        if self.exporter is not None:
+            self.exporter.stop()
+
     def write(self) -> Checkpoint:
-        """Persist the current committed state as the restart point."""
+        """Persist the current committed state as the restart point.
+        In background mode this stamps the tip and DRAINS the exporter
+        (the synchronous tail a stream shutdown needs); otherwise it is
+        the legacy on-thread export."""
+        if self.exporter is not None:
+            self.engine.commit_pipe.flush()
+            self.stamp()
+            self.drain()
+            # None when nothing could land (e.g. the whole stream
+            # quarantined: the held generation blocks the exporter, so
+            # no durable record exists — correctly, since a
+            # quarantined tip is not finalized)
+            return load_checkpoint(self.kv)
+        t0 = time.monotonic_ns()
+        try:
+            return self._write_sync()
+        finally:
+            self.write_ns += time.monotonic_ns() - t0
+
+    def _write_sync(self) -> Checkpoint:
         eng = self.engine
         eng.commit_pipe.flush()
         header = eng.parent_header
@@ -135,5 +228,11 @@ class CheckpointManager:
                           root=root, header=header)
 
     def snapshot(self) -> dict:
-        return {"every": self.every, "written": self.written,
-                "last_number": self.last_number}
+        out = {"every": self.every, "written": self.written,
+               "last_number": self.last_number,
+               "background": self.exporter is not None,
+               "stamp_us": self.stamp_ns // 1_000,
+               "write_ms": self.write_ns // 1_000_000}
+        if self.exporter is not None:
+            out["exporter"] = self.exporter.snapshot()
+        return out

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 from coreth_tpu import faults, rlp
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.mpt.rehash import device_rehash
+from coreth_tpu.state.flat import DELETED as FLAT_DELETED
 from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
 
 # Injection point: the window fold fails (a device rehash hiccup, an
@@ -61,6 +62,7 @@ class CommitPipeline:
         self.accounts: Dict[bytes, Tuple[int, int]] = {}
         self.expected_root: Optional[bytes] = None
         self.expected_number: Optional[int] = None
+        self.expected_header = None
         self.staged_blocks = 0
         # commit-phase attribution (bench.py fold_ms_per_block)
         self.fold_s = 0.0
@@ -79,6 +81,7 @@ class CommitPipeline:
         self.accounts.update(accounts)
         self.expected_root = header.root
         self.expected_number = header.number
+        self.expected_header = header
         self.staged_blocks += 1
 
     def pending(self) -> bool:
@@ -185,6 +188,7 @@ class CommitPipeline:
             sup.retry_point("commit", PT_FLUSH)
         else:
             faults.fire(PT_FLUSH)
+        prev_root = e.root
         t0 = time.monotonic()
         self._fold_storage()
         root = self._fold_accounts()
@@ -195,16 +199,45 @@ class CommitPipeline:
         self.fold_blocks += self.staged_blocks
         expected = self.expected_root
         number = self.expected_number
+        header = self.expected_header
         n_blocks = self.staged_blocks
+        writes = self.writes
+        accounts = self.accounts
         self.writes = {}
         self.accounts = {}
         self.staged_blocks = 0
         self.expected_root = None
         self.expected_number = None
+        self.expected_header = None
         if root != expected:
             raise ReplayError(
                 f"state root mismatch at block {number} "
                 f"(commit window of {n_blocks}): {root.hex()} != "
                 f"{expected.hex()}")
         e.root = root
+        flat = getattr(e, "flat", None)
+        if flat is not None:
+            # seal the window as ONE flat generation — the post-fold
+            # storage roots are fresh in e.state.roots, so the account
+            # tuples are complete (the background exporter re-derives
+            # and root-checks the trie from exactly this diff)
+            state = e.state
+            gen_accounts: Dict[bytes, object] = {}
+            for addr, (balance, nonce) in accounts.items():
+                idx = state.index[addr]
+                code_hash = state.code_hashes[idx]
+                storage_root = state.roots[idx]
+                multicoin = bool(state.multicoin[idx])
+                if (balance == 0 and nonce == 0
+                        and code_hash == EMPTY_CODE_HASH
+                        and storage_root == EMPTY_ROOT_HASH
+                        and not multicoin):
+                    gen_accounts[addr] = FLAT_DELETED  # EIP-158 deletion
+                else:
+                    gen_accounts[addr] = (balance, nonce, storage_root,
+                                          code_hash, multicoin)
+            flat.apply_generation(
+                number=number, block_hash=header.hash(), root=root,
+                header=header, prev_root=prev_root,
+                accounts=gen_accounts, storage=writes, kind="window")
         return root

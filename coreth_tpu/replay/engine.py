@@ -45,6 +45,7 @@ from coreth_tpu.params import ChainConfig
 from coreth_tpu.params import protocol as P
 from coreth_tpu.processor.state_processor import Processor
 from coreth_tpu.state import Database, StateDB
+from coreth_tpu.state.flat import DELETED as FLAT_DELETED
 from coreth_tpu.workloads.erc20 import (
     TOKEN_CODE_HASH, TRANSFER_TOPIC, balance_slot,
     measure_transfer_exec_gas, parse_transfer_calldata,
@@ -119,6 +120,9 @@ class ReplayStats:
     # blocks applied tolerantly after failing validation on every
     # backend (supervisor quarantine — streaming callers only)
     blocks_quarantined: int = 0
+    # quarantined blocks later popped again via rollback_block (the
+    # reorg primitive over the flat layer's generational diffs)
+    blocks_rolled_back: int = 0
     # where batched sender recovery ran: the device ECDSA ladder
     # (single-chip or mesh-sharded — overlapping window execution in
     # the replay loop) vs the native host batch
@@ -743,6 +747,19 @@ class ReplayEngine:
         # window runner drops its device-resident slot table when it
         # observes a bump (its mirror can no longer be trusted)
         self.storage_epoch = 0
+        # asynchronous flat-state layer (state/flat): O(1) cold reads
+        # for the engine, the device table fills, and host StateDBs;
+        # generational diffs feed background checkpoints and the
+        # quarantine-rollback primitive.  CORETH_FLAT=0 restores the
+        # trie-walk-only read path (A/B + safety valve);
+        # CORETH_FLAT_CHECK=1 arms the differential oracle — every
+        # flat hit is re-derived from the trie and must match.
+        self.flat = None
+        self._flat_check = bool(os.environ.get("CORETH_FLAT_CHECK"))
+        if bool(int(os.environ.get("CORETH_FLAT", "1"))):
+            from coreth_tpu.state.flat import FlatStore
+            self.flat = FlatStore()
+        self._flat_view_memo = None
         # window-batched trie commit (replay/commit.py): finished
         # blocks stage deduped writes; flush() folds once per window
         from coreth_tpu.replay.commit import CommitPipeline
@@ -760,12 +777,53 @@ class ReplayEngine:
         _hx_bridge.set_fault_observer(self.supervisor)
 
     # ---------------------------------------------------------------- index
+    def _flat_view(self):
+        """StateDB-facing flat adapter (host fallback / scratch
+        StateDBs read flat-first too); None when the layer is off."""
+        if self.flat is None:
+            return None
+        if self._flat_view_memo is None:
+            from coreth_tpu.state.flat import FlatStateView
+            self._flat_view_memo = FlatStateView(self.flat,
+                                                 self._flat_check)
+        return self._flat_view_memo
+
+    def _flat_oracle_fail(self, what: str, addr: bytes, got,
+                          want) -> None:
+        raise ReplayError(
+            f"flat oracle divergence ({what}) at {addr.hex()}: "
+            f"flat={got!r} trie={want!r}")
+
     def _account(self, addr: bytes) -> int:
         idx = self.state.index.get(addr)
         if idx is not None:
             return idx
+        flat = self.flat
+        if flat is not None:
+            v = flat.account(addr)
+            if v is not None:
+                account = None
+                if v is not FLAT_DELETED:
+                    account = StateAccount(
+                        nonce=v[1], balance=v[0], root=v[2],
+                        code_hash=v[3], is_multi_coin=v[4])
+                if self._flat_check:
+                    raw = self.trie.get(addr)
+                    want = StateAccount.from_rlp(raw) \
+                        if raw is not None else None
+                    if (want is None) != (account is None) or (
+                            want is not None
+                            and want.rlp() != account.rlp()):
+                        self._flat_oracle_fail("account", addr,
+                                               account, want)
+                return self.state.ensure(addr, account)
         raw = self.trie.get(addr)
         account = StateAccount.from_rlp(raw) if raw is not None else None
+        if flat is not None:
+            flat.fill_account(
+                addr, FLAT_DELETED if account is None else (
+                    account.balance, account.nonce, account.root,
+                    account.code_hash, account.is_multi_coin))
         return self.state.ensure(addr, account)
 
     def _storage_trie(self, contract: bytes):
@@ -797,10 +855,24 @@ class ReplayEngine:
         if s_idx is not None:
             return s_idx
         value = self.commit_pipe.base_value(contract, key)
+        if value is None and self.flat is not None:
+            # flat layer before the trie walk (staged window writes
+            # above stay authoritative — they have not folded yet)
+            value = self.flat.storage_value(contract, key)
+            if value is not None and self._flat_check:
+                from coreth_tpu import rlp
+                raw = self._storage_trie(contract).get(key)
+                want = int.from_bytes(rlp.decode(raw), "big") \
+                    if raw else 0
+                if want != value:
+                    self._flat_oracle_fail("slot", contract, value,
+                                           want)
         if value is None:
             from coreth_tpu import rlp
             raw = self._storage_trie(contract).get(key)
             value = int.from_bytes(rlp.decode(raw), "big") if raw else 0
+            if self.flat is not None:
+                self.flat.fill_storage(contract, key, value)
         return self.state.ensure_slot(contract, key, value)
 
     # -------------------------------------------------------------- senders
@@ -1764,6 +1836,89 @@ class ReplayEngine:
         self.stats.blocks_quarantined += 1
         return reasons
 
+    def rollback_block(self, block: Block) -> bytes:
+        """Reorg primitive: pop a quarantined block's generation and
+        re-converge the engine to the pre-block (strict-mode) state.
+
+        The flat layer's undo log restores the flat view; the engine
+        tries reopen at the generation's recorded ``prev_root`` (whose
+        node closure the quarantine path committed before executing
+        the block); device-state metadata and slot mirrors repair from
+        the reopened tries for exactly the keys the block touched.
+        Only the NEWEST generation — a quarantined block — is
+        revertible: strict blocks validated against their headers and
+        never need to come back out."""
+        if self.flat is None:
+            raise ReplayError(
+                "rollback requires the flat layer (CORETH_FLAT=1)")
+        if self.commit_pipe.pending():
+            raise ReplayError(
+                "rollback with staged commits pending (flush first)")
+        # checkpoint markers stamped on the doomed tip carry no diff;
+        # discard them so the quarantine generation is the target
+        gen = self.flat.last_generation()
+        while gen is not None and gen.kind == "checkpoint" \
+                and not gen.exported:
+            self.flat.rollback_last()
+            gen = self.flat.last_generation()
+        if gen is None or gen.kind != "quarantine" \
+                or gen.number != block.number \
+                or gen.block_hash != block.hash():
+            raise ReplayError(
+                "rollback target is not the newest quarantined "
+                "generation")
+        gen = self.flat.rollback_last()
+        prev_root = gen.prev_root
+        base = self.db.open_trie(prev_root)
+        if self._native:
+            from coreth_tpu.mpt.native_trie import (
+                CheckedSecureTrie, NativeSecureTrie)
+            if self._trie_check:
+                self.trie = CheckedSecureTrie(base)
+            else:
+                self.trie = NativeSecureTrie.from_python_trie(base)
+        else:
+            self.trie = base
+        self.storage_tries.clear()
+        self._slot_overlay.clear()
+        # the window runner's mirror/table saw the quarantined writes
+        self.storage_epoch += 1
+        st = self.state
+        st.flush_staged()
+        touched = sorted(set(gen.accounts) | set(gen.destructs))
+        for addr in touched:
+            idx = st.index.get(addr)
+            if idx is None:
+                continue
+            raw = self.trie.get(addr)
+            account = StateAccount.from_rlp(raw) if raw \
+                else StateAccount()
+            st._staged.append((idx, account.balance, account.nonce))
+            st.has_code[idx] = account.code_hash != EMPTY_CODE_HASH
+            st.multicoin[idx] = account.is_multi_coin
+            st.code_hashes[idx] = account.code_hash
+            st.roots[idx] = account.root
+        from coreth_tpu import rlp as _rlp
+        for (contract, key) in sorted(gen.storage):
+            s_idx = st.slot_index.get((contract, key))
+            if s_idx is None or contract not in st.index:
+                continue
+            raw_v = self._storage_trie(contract).get(key)
+            v = int.from_bytes(_rlp.decode(raw_v), "big") \
+                if raw_v else 0
+            if v != st.slot_host[s_idx]:
+                st.slot_host[s_idx] = v
+                st._staged_slots.append((s_idx, v))
+        st.flush_staged()
+        if self.trie.hash() != prev_root:
+            raise ReplayError(
+                "rollback: trie did not re-converge to the pre-block "
+                "root")
+        self.root = prev_root
+        self.parent_header = gen.prev_header
+        self.stats.blocks_rolled_back += 1
+        return prev_root
+
     def _fallback(self, block: Block, strict: bool = True,
                   reasons: Optional[List[str]] = None) -> bytes:
         """Bit-exact host path for non-transfer blocks; device state for
@@ -1772,6 +1927,8 @@ class ReplayEngine:
         ``reasons`` instead of raised and the computed state still
         commits (see quarantine_block)."""
         self.commit_pipe.flush()  # staged windows precede this block
+        prev_root = self.root
+        prev_header = self.parent_header
         t0 = time.monotonic()
         if self._native:
             self.trie.commit_into(self.db.node_db)
@@ -1783,7 +1940,7 @@ class ReplayEngine:
             # storage tries the device path touched must be readable too
             for st in self.storage_tries.values():
                 self.db.cache_trie(st.commit(), st)
-        statedb = StateDB(self.root, self.db)
+        statedb = StateDB(self.root, self.db, flat=self._flat_view())
         if (self.parent_header is None
                 and self.config.is_apricot_phase4(block.time)):
             # the shim cannot supply parent block_gas_cost/time, which
@@ -1857,6 +2014,23 @@ class ReplayEngine:
                         self.state.slot_host[s_idx] = v
                         self.state._staged_slots.append((s_idx, v))
         self.state.flush_staged()
+        if self.flat is not None:
+            # one generation per host-path block: the flat view learns
+            # the block's diff (keeping cold reads current) and the
+            # undo log makes a QUARANTINED block revertible
+            # (rollback_block) — quarantine generations are applied
+            # with hold=True so the background exporter cannot make
+            # them durable before the chain accepts past them
+            from coreth_tpu.state.flat import flat_diff_from_statedb
+            accounts, storage, destructs = \
+                flat_diff_from_statedb(statedb)
+            self.flat.apply_generation(
+                number=block.number, block_hash=block.hash(),
+                root=root, header=block.header, prev_root=prev_root,
+                prev_header=prev_header, accounts=accounts,
+                storage=storage, destructs=destructs,
+                kind="fallback" if strict else "quarantine",
+                hold=not strict)
         self.root = root
         self.parent_header = block.header
         self.stats.blocks_fallback += 1
@@ -1872,6 +2046,10 @@ class ReplayEngine:
         for name, value in self.stats.row().items():
             get_or_register(f"{prefix}/{name}", Gauge,
                             registry).update(value)
+        if self.flat is not None:
+            for name, value in self.flat.snapshot().items():
+                get_or_register(f"flat/{name}", Gauge,
+                                registry).update(value)
 
     def commit(self) -> bytes:
         """Persist the engine tries so host StateDBs can open the state."""

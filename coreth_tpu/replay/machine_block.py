@@ -107,7 +107,7 @@ class MachineBlockExecutor:
         # premap-prediction / recompile-free-growth counters accumulate
         # across runner rebuilds (an epoch bump discards the runner)
         self._runner_totals = dict(
-            premap_predicted=0, premap_hits=0,
+            premap_predicted=0, premap_hits=0, premap_nested=0,
             discovery_dispatches=0, kernel_retraces=0)
 
     def machine_counters(self) -> dict:
@@ -207,7 +207,7 @@ class MachineBlockExecutor:
         hx0 = hx_counters().get("native_calls", 0)
         rules = e.config.rules(block.number, block.time)
         e.commit()  # persist engine tries so the scratch db can read
-        scratch = StateDB(e.root, e.db)
+        scratch = StateDB(e.root, e.db, flat=e._flat_view())
         block_ctx = BlockContext(
             coinbase=block.header.coinbase, number=block.number,
             time=block.time, gas_limit=block.header.gas_limit,
@@ -275,12 +275,29 @@ class MachineBlockExecutor:
         # staged-but-unfolded window writes are authoritative over the
         # trie (the commit pipeline defers folds past the next
         # window's dispatch)
-        v = self.e.commit_pipe.base_value(contract, key)
+        e = self.e
+        v = e.commit_pipe.base_value(contract, key)
         if v is not None:
             return v
-        st = self.e._storage_trie(contract)
+        if e.flat is not None:
+            # flat layer next: device table fills (the window runner's
+            # storage_resolver routes here) hit a dict, not the trie
+            v = e.flat.storage_value(contract, key)
+            if v is not None:
+                if e._flat_check:
+                    raw = e._storage_trie(contract).get(key)
+                    want = int.from_bytes(rlp.decode(raw), "big") \
+                        if raw else 0
+                    if want != v:
+                        e._flat_oracle_fail("machine-slot", contract,
+                                            v, want)
+                return v
+        st = e._storage_trie(contract)
         raw = st.get(key)
-        return int.from_bytes(rlp.decode(raw), "big") if raw else 0
+        v = int.from_bytes(rlp.decode(raw), "big") if raw else 0
+        if e.flat is not None:
+            e.flat.fill_storage(contract, key, v)
+        return v
 
     # ------------------------------------------------------------- execute
     def execute(self, block: Block,

@@ -102,6 +102,9 @@ class StreamReport:
     checkpoint: dict = field(default_factory=dict)
     faults: dict = field(default_factory=dict)
     halted: Optional[str] = None
+    # flat-state layer surface (state/flat): read hit/miss counters,
+    # generation/rollback counts (empty when CORETH_FLAT=0)
+    flat: dict = field(default_factory=dict)
 
     def row(self) -> dict:
         return dict(self.__dict__)
@@ -176,6 +179,9 @@ class StreamingPipeline:
         self._commit_flushes = 0
         self._prefetch_hits = 0
         self._errors: List[BaseException] = []
+        # quarantined Block objects, parallel to stats.quarantined
+        # (rollback_quarantined needs the block itself back)
+        self._quarantined_blocks: List[Block] = []
 
     # ------------------------------------------------------- queue helpers
     def _put(self, q: "queue.Queue", item) -> float:
@@ -336,6 +342,7 @@ class StreamingPipeline:
             "hash": it.block.hash().hex(),
             "reasons": [str(exc)] + reasons,
         })
+        self._quarantined_blocks.append(it.block)
         get_or_register("serve/quarantined", Counter,
                         self._registry).inc()
         self._mark_committed([it])  # resets the streak; restore + bump
@@ -509,23 +516,53 @@ class StreamingPipeline:
         feed_t.start()
         pre_t.start()
         try:
-            self._drive()
+            try:
+                self._drive()
+            finally:
+                self._stop.set()
+                feed_t.join(timeout=10)
+                pre_t.join(timeout=10)
+                # anything still staged belongs to completed blocks
+                self.engine.commit_pipe.flush()
+                restore()
+            if self._errors:
+                raise self._errors[0]
+            if self._ckpt is not None and self.stats.blocks:
+                # final checkpoint: the whole committed stream is
+                # durable, a restart resumes at the exact tail.  In
+                # background mode write() stamps the tip and DRAINS
+                # the flat exporter — the one synchronous wait, at
+                # shutdown, not per interval.
+                self._ckpt.write()
         finally:
-            self._stop.set()
-            feed_t.join(timeout=10)
-            pre_t.join(timeout=10)
-            # anything still staged belongs to completed blocks
-            self.engine.commit_pipe.flush()
-            restore()
-        if self._errors:
-            raise self._errors[0]
-        if self._ckpt is not None and self.stats.blocks:
-            # final checkpoint: the whole committed stream is durable,
-            # a restart resumes at the exact tail
-            self._ckpt.write()
+            if self._ckpt is not None:
+                # ALWAYS stop the exporter thread — an error path that
+                # skipped it would leak one polling thread per failed
+                # run
+                self._ckpt.close()
         wall = time.monotonic() - t_start
         self._publish(wall)
         return self.stats
+
+    def rollback_quarantined(self) -> dict:
+        """Reorg primitive: pop the NEWEST quarantined block (its
+        tolerantly-applied state transition reverts through the flat
+        layer's generational undo log, engine.rollback_block) so a
+        corrected block can be streamed in its place.  Call after
+        run() returned (the engine is single-owner again).  Returns
+        the popped quarantine report entry."""
+        if not self._quarantined_blocks:
+            raise ValueError("no quarantined block to roll back")
+        blk = self._quarantined_blocks[-1]
+        self.engine.rollback_block(blk)
+        self._quarantined_blocks.pop()
+        entry = self.stats.quarantined.pop()
+        self.stats.blocks -= 1
+        self.stats.txs -= len(blk.transactions)
+        self._committed_blocks -= 1
+        # the replacement block re-enters at the popped number
+        self._expect_number = blk.number
+        return entry
 
     def shutdown(self) -> None:
         """Mid-stream stop: the feed stops pulling, in-flight queues
@@ -578,6 +615,9 @@ class StreamingPipeline:
             sup.publish(self._registry)
         if self._ckpt is not None:
             s.checkpoint = self._ckpt.snapshot()
+        flat = getattr(self.engine, "flat", None)
+        if flat is not None:
+            s.flat = flat.snapshot()
         s.faults = faults.fired()
         # SLO surface in the metrics registry (scrapeable next to the
         # engine's replay/* gauges)

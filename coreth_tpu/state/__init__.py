@@ -1,9 +1,12 @@
 """World state: journaled StateDB over trie-backed storage.
 
 Semantic twin of reference ``core/state/`` (statedb.go, state_object.go,
-journal.go).  The flat-read acceleration role of core/state/snapshot/ is
-played by the Database's account/storage caches; the TPU replay engine
-(coreth_tpu.replay) additionally mirrors hot state into device arrays.
+journal.go).  The flat-read acceleration role of core/state/snapshot/
+is played by ``state/flat`` (the asynchronous flat-state layer: O(1)
+raw-keyed reads, generational diffs, background checkpoint export) and
+by the blockHash-keyed snapshot tree in ``state/snapshot.py`` on the
+chain path; the TPU replay engine (coreth_tpu.replay) additionally
+mirrors hot state into device arrays.
 """
 
 from coreth_tpu.state.database import Database  # noqa: F401

@@ -1,0 +1,24 @@
+"""Asynchronous flat-state layer (Reddio-style execution/storage split).
+
+A flat ``address -> account`` / ``(address, slot) -> value`` store kept
+incrementally current from the commit pipeline's already-deduped window
+effects, with three jobs (see store.py / exporter.py):
+
+1. **O(1) cold reads** — engine cold reads, device table fills, and
+   StateDB resolution hit a dict instead of walking the Merkle trie
+   (the reference's ``core/state/snapshot/`` fast path, raw-keyed in
+   memory, hash-keyed on disk);
+2. **background checkpoints** — the execute thread only stamps a
+   generation boundary; a worker thread re-derives the trie from frozen
+   diff generations and writes the durable checkpoint record
+   (Merkleization fully off the critical path);
+3. **reorg-capable rollback** — per-commit-unit generations carry undo
+   logs, so a quarantined block can be popped and the engine
+   re-converged to the strict-mode root.
+"""
+
+from coreth_tpu.state.flat.store import (  # noqa: F401
+    DELETED, FlatGeneration, FlatStateView, FlatStore,
+    flat_diff_from_statedb,
+)
+from coreth_tpu.state.flat.exporter import FlatExporter  # noqa: F401

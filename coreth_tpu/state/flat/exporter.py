@@ -1,0 +1,258 @@
+"""Background checkpoint exporter: Merkleization off the execute thread.
+
+PR 10's CheckpointManager ran the whole durability event on the
+execute thread: flush the commit pipeline, export the engine's trie
+nodes, fsync, write the record.  This exporter moves everything but
+the O(1) generation stamp to a worker thread, the Reddio decoupling
+carried to durability:
+
+- it owns SHADOW tries (plain Python mpt over the engine Database's
+  node store) seeded at the engine's start root, and re-derives each
+  sealed flat generation's state by folding the generation's deduped
+  diffs — account trie + per-contract storage tries — verifying the
+  resulting root against the generation's recorded (header) root, so
+  a divergence between the flat layer and the chain can never become
+  a durable checkpoint;
+- at a checkpoint marker it commits the shadow nodes into the
+  node store, flushes them to the KV log, and only THEN writes the
+  flat meta stamp and the checkpoint record — the PR-10 write-order
+  argument (record implies full node closure) is preserved verbatim,
+  just on this thread;
+- it writes each generation's flat entries (hash-keyed, number-
+  stamped) as it goes, so the persisted flat base trails the live
+  view by at most the queue depth.
+
+Crash consistency: a SIGKILL anywhere leaves the previous record
+authoritative (nodes flushed before the record; flat entries newer
+than the record are skipped on reload via their number stamps).  The
+``checkpoint/crash_gap`` seam fires at the same node-flush/record
+boundary as the synchronous path; ``flat/torn_write`` fires between a
+generation's flat-entry writes and the meta/record write.
+"""
+
+from __future__ import annotations
+
+import threading
+import time  # noqa: DET003 — host-side export-thread waits/instrumentation, never consensus data
+from typing import Dict, Optional
+
+from coreth_tpu import faults
+from coreth_tpu.mpt import EMPTY_ROOT
+from coreth_tpu.rawdb import schema
+from coreth_tpu.state.flat.store import (
+    DELETED, FlatGeneration, FlatStore,
+)
+from coreth_tpu.types import StateAccount
+
+# the torn-flat-write seam: a crash (or injected error) between a
+# generation's flat-entry writes and the meta/record write must leave
+# the previous record authoritative; a transient error retries the
+# durable step (entry puts are idempotent)
+PT_TORN = faults.declare(
+    "flat/torn_write",
+    "crash window between flat-entry writes and the meta/record write")
+
+# the export queue hands back an already-exported (stale) generation —
+# the queue-races-rollback shape; the exporter must detect and skip it
+# instead of double-applying diffs to the shadow tries
+PT_STALE = faults.declare(
+    "flat/stale_generation",
+    "export queue hands back an already-exported generation")
+
+# the node-flush/record boundary — the SAME point name replay/
+# checkpoint.py declares for the synchronous path (declare() is
+# idempotent; naming it here keeps this package below replay in the
+# layer map), so one fault plan covers both paths
+PT_CRASH_GAP = faults.declare(
+    "checkpoint/crash_gap",
+    "crash window between trie-node flush and checkpoint-record write")
+
+
+class ExporterError(Exception):
+    pass
+
+
+# host-side poll cadences for the worker loop / drain spin (wall-clock
+# by nature; no consensus data flows through them)
+_POLL_S = 0.05        # noqa: DET001 — export-thread poll cadence
+_DRAIN_POLL_S = 0.005  # noqa: DET001 — drain spin cadence
+
+
+class FlatExporter:
+    """Drains a FlatStore's sealed generations on a worker thread and
+    turns checkpoint markers into durable records."""
+
+    DURABLE_RETRIES = 3
+
+    def __init__(self, flat: FlatStore, db, kv, start_root: bytes):
+        self.flat = flat
+        self.db = db
+        self.kv = kv
+        # shadow account trie + lazily-opened per-contract storage
+        # tries; all plain-Python mpt over the SAME node store the
+        # engine commits into, so the start root's closure is readable
+        self.trie = db.open_trie(start_root)
+        self.storage_tries: Dict[bytes, object] = {}
+        self.on_record = None     # callback(gen) after a record lands
+        self.error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # ---- counters (bench flat_state: export cost vs stamp cost)
+        self.exports = 0
+        self.records = 0
+        self.stale_skips = 0
+        self.entries_written = 0
+        self.export_ns = 0        # worker wall time applying+writing
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self.flat.attach_exporter()
+        self._thread = threading.Thread(
+            target=self._loop, name="flat-exporter", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+
+    def drain(self, timeout_s: int = 60) -> None:
+        """Block until every sealed generation is exported (the
+        synchronous tail of a stream: the final checkpoint).  Raises
+        the exporter's error, if any."""
+        deadline = time.monotonic_ns() \
+            + timeout_s * 1_000_000_000  # noqa: DET003 — drain wall-clock deadline, host-side only
+        while not self.flat.drained():
+            if self.error is not None:
+                raise ExporterError(
+                    "flat exporter failed") from self.error
+            if time.monotonic_ns() > deadline:  # noqa: DET003 — drain wall-clock deadline, host-side only
+                raise ExporterError("flat exporter drain timed out")
+            time.sleep(_DRAIN_POLL_S)  # noqa: DET003 — drain spin wait, host-side only
+        if self.error is not None:
+            raise ExporterError("flat exporter failed") from self.error
+
+    # --------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.error is not None:
+                time.sleep(_POLL_S)  # noqa: DET003 — failed-exporter idle wait, host-side only
+                continue
+            gen = self.flat.next_for_export(_POLL_S)
+            if gen is None:
+                continue
+            if gen.exported or gen.rolled_back:
+                # a stale handout (the flat/stale_generation shape):
+                # double-applying its diffs would corrupt the shadow
+                # tries — detect by flag and skip
+                self.stale_skips += 1
+                continue
+            t0 = time.monotonic_ns()  # noqa: DET003 — export-cost instrumentation, host-side only
+            try:
+                self._export(gen)
+            except BaseException as exc:  # noqa: BLE001 — a wedged exporter must not kill the stream; drain()/stamp surfaces the error
+                self.error = exc
+            finally:
+                self.export_ns += time.monotonic_ns() - t0  # noqa: DET003 — export-cost instrumentation, host-side only
+
+    # ------------------------------------------------------------- export
+    def _storage_trie(self, addr: bytes):
+        st = self.storage_tries.get(addr)
+        if st is None:
+            raw = self.trie.get(addr)
+            root = StateAccount.from_rlp(raw).root if raw is not None \
+                else EMPTY_ROOT
+            st = self.db.open_trie(root)
+            self.storage_tries[addr] = st
+        return st
+
+    def _apply(self, gen: FlatGeneration) -> None:
+        """Fold one generation's diffs into the shadow tries and verify
+        the root — the background Merkleization."""
+        from coreth_tpu import rlp
+        for addr in gen.destructs:
+            # the pre-destruct storage is dead wholesale (even on
+            # destruct+re-create); later slot writes repopulate
+            self.storage_tries[addr] = self.db.open_trie(EMPTY_ROOT)
+        by_contract: Dict[bytes, list] = {}
+        for (addr, key) in sorted(gen.storage):
+            by_contract.setdefault(addr, []).append(key)
+        for addr, keys in by_contract.items():
+            st = self._storage_trie(addr)
+            for key in keys:
+                v = gen.storage[(addr, key)]
+                if v == 0:
+                    st.delete(key)
+                else:
+                    st.update(key, rlp.encode(
+                        v.to_bytes(32, "big").lstrip(b"\x00")))
+        for addr in sorted(gen.accounts):
+            v = gen.accounts[addr]
+            if v is DELETED:
+                self.trie.delete(addr)
+                self.storage_tries.pop(addr, None)
+                continue
+            balance, nonce, root, code_hash, multicoin = v
+            st = self.storage_tries.get(addr)
+            if st is not None and st.hash() != root:
+                raise ExporterError(
+                    f"shadow storage root diverged for "
+                    f"{addr.hex()} at block {gen.number}")
+            self.trie.update(addr, StateAccount(
+                nonce=nonce, balance=balance, root=root,
+                code_hash=code_hash, is_multi_coin=multicoin).rlp())
+        got = self.trie.hash()
+        if got != gen.root:
+            raise ExporterError(
+                f"shadow state root diverged at block {gen.number}: "
+                f"{got.hex()} != {gen.root.hex()}")
+
+    def _durable(self, gen: FlatGeneration) -> None:
+        """The write-ordered durability step (retryable: every write
+        is an idempotent put)."""
+        self.entries_written += self.flat.write_gen_entries(
+            self.kv, gen)
+        faults.fire(PT_TORN)
+        if gen.checkpoint:
+            # nodes first — the record-implies-closure invariant
+            self.trie.commit()
+            for st in self.storage_tries.values():
+                st.commit()
+            node_db = self.db.node_db
+            if hasattr(node_db, "flush"):
+                node_db.flush()
+            self.kv.flush()
+            faults.fire(PT_CRASH_GAP)
+            schema.write_flat_meta(self.kv, gen.number, gen.root)
+            schema.write_replay_checkpoint(
+                self.kv, gen.number, gen.block_hash, gen.root,
+                gen.header.encode())
+            self.kv.flush()
+            self.records += 1
+            if self.on_record is not None:
+                self.on_record(gen)
+
+    def _export(self, gen: FlatGeneration) -> None:
+        self._apply(gen)
+        for attempt in range(self.DURABLE_RETRIES):
+            try:
+                self._durable(gen)
+                break
+            except faults.FaultInjected:
+                if attempt == self.DURABLE_RETRIES - 1:
+                    raise
+                continue
+        self.flat.mark_exported(gen)
+        self.exports += 1
+
+    # ------------------------------------------------------------ report
+    def snapshot(self) -> dict:
+        return {
+            "exports": self.exports,
+            "records": self.records,
+            "stale_skips": self.stale_skips,
+            "entries_written": self.entries_written,
+            "export_ms": self.export_ns // 1_000_000,
+            "failed": self.error is not None,
+        }

@@ -86,14 +86,20 @@ class StateObject:
 
 class StateDB:
     def __init__(self, root: bytes, db: Optional[Database] = None,
-                 snap=None):
+                 snap=None, flat=None):
         """snap: optional snapshot layer (state.snapshot DiskLayer/
         DiffLayer) — O(1) account/storage reads that bypass the trie
         (the snapshot read-path acceleration, statedb.go:147 New with
-        snaps).  The trie stays authoritative for hashing."""
+        snaps).  flat: optional flat-state view (state.flat
+        FlatStateView, duck-typed) — same role, raw-keyed, consulted
+        BEFORE snap/trie and back-filled on trie fallthrough; its
+        ``check`` flag arms the differential oracle (every flat hit
+        re-derived from the trie).  The trie stays authoritative for
+        hashing."""
         self.db = db if db is not None else Database()
         self.original_root = root
         self.snap = snap
+        self.flat = flat
         # optional TriePrefetcher warming paths during execution
         # (StartPrefetcher, blockchain.go:1319)
         self.prefetcher = None
@@ -160,13 +166,35 @@ class StateDB:
 
     # ------------------------------------------------------------- objects
     def _load_account(self, addr: bytes) -> Optional[StateAccount]:
+        fl = self.flat
+        if fl is not None:
+            v = fl.account_state(addr)
+            if v is not None:
+                account = None if v is fl.DELETED else v
+                if fl.check:
+                    data = self._trie.get(addr)
+                    want = StateAccount.from_rlp(data) \
+                        if data is not None else None
+                    if (want is None) != (account is None) or (
+                            want is not None
+                            and want.rlp() != account.rlp()):
+                        raise ValueError(
+                            f"flat oracle divergence (statedb "
+                            f"account) at {addr.hex()}: "
+                            f"flat={account!r} trie={want!r}")
+                return account
         if self.snap is not None:
             data = self.snap.account(keccak256(addr))
         else:
             data = self._trie.get(addr)
         if data is None:
+            if fl is not None:
+                fl.fill_account(addr, None)
             return None
-        return StateAccount.from_rlp(data)
+        account = StateAccount.from_rlp(data)
+        if fl is not None:
+            fl.fill_account(addr, account)
+        return account
 
     def _get_object(self, addr: bytes) -> Optional[StateObject]:
         obj = self._objects.get(addr)
@@ -345,8 +373,22 @@ class StateDB:
     def _origin_value(self, obj: StateObject, key: bytes) -> bytes:
         if key in obj.origin_storage:
             return obj.origin_storage[key]
+        fl = self.flat
         if obj.fresh:
             value = HASH_ZERO
+        elif fl is not None \
+                and (v := fl.storage_value(obj.address, key)) is not None:
+            value = v.to_bytes(32, "big")
+            if fl.check:
+                trie = self._open_storage_trie(obj)
+                raw = trie.get(key)
+                want = rlp.decode(raw).rjust(32, b"\x00") \
+                    if raw is not None else HASH_ZERO
+                if want != value:
+                    raise ValueError(
+                        f"flat oracle divergence (statedb slot) at "
+                        f"{obj.address.hex()}/{key.hex()}: "
+                        f"flat={value.hex()} trie={want.hex()}")
         elif self.snap is not None:
             raw = self.snap.storage_slot(keccak256(obj.address),
                                          keccak256(key))
@@ -359,6 +401,9 @@ class StateDB:
                 value = HASH_ZERO
             else:
                 value = rlp.decode(raw).rjust(32, b"\x00")
+            if fl is not None:
+                fl.fill_storage(obj.address, key,
+                                int.from_bytes(value, "big"))
         obj.origin_storage[key] = value
         return value
 
@@ -656,7 +701,8 @@ class StateDB:
         same one-way contract: "Snapshots of the copied state cannot be
         applied to the copy."
         """
-        new = StateDB(self.original_root, self.db, snap=self.snap)
+        new = StateDB(self.original_root, self.db, snap=self.snap,
+                      flat=self.flat)
         new._trie = self._trie.copy()
         new._dirty_counts = dict(self._dirty_counts)
         for addr, obj in self._objects.items():

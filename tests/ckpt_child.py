@@ -124,11 +124,36 @@ def main() -> int:
     kv, db = open_db(dbdir)
 
     if mode == "run":
+        # compile warm-up on a throwaway db: XLA traces are
+        # process-cached, so the paced feed below actually runs at its
+        # cadence instead of draining a compile-time backlog in one
+        # burst (the async checkpoint exporter needs the cadence to be
+        # real for a record to exist by the injected kill)
+        from coreth_tpu.state import Database
+        from coreth_tpu.types import Block
+        warm_db = Database()
+        wg = genesis.to_block(warm_db)
+        warm = ReplayEngine(genesis.config, warm_db, wg.root,
+                            parent_header=wg.header, capacity=256,
+                            batch_pad=64, window=4)
+        warm.replay([Block.decode(b.encode()) for b in blocks[:5]])
+
         gblock = genesis.to_block(db)
         engine = ReplayEngine(genesis.config, db, gblock.root,
                               parent_header=gblock.header,
                               capacity=256, batch_pad=64, window=4)
-        pipe = StreamingPipeline(engine, ChainFeed(list(blocks)))
+        # paced feed: the checkpoint exporter runs on a background
+        # thread (state/flat), so the record TRAILS the commit by the
+        # export latency.  A backlog feed would commit the whole chain
+        # in single-digit milliseconds and the SIGKILL could land
+        # before any record exists (crash-consistency still holds —
+        # resume from genesis — but the matrix wants to prove a
+        # genuinely mid-stream resume).  ~30 blocks/s leaves the
+        # worker orders of magnitude more time than a generation
+        # export costs while keeping windows honestly in flight.
+        rate = float(os.environ.get("CKPT_FEED_RATE", "30"))
+        pipe = StreamingPipeline(engine, ChainFeed(list(blocks),
+                                                   rate=rate))
         pipe.run()
         # the armed serve/crash plan should have SIGKILLed us mid-run
         print("NOKILL", flush=True)

@@ -87,18 +87,23 @@ def test_inprocess_checkpoint_and_resume(tmp_path):
     kv2.close()
 
 
-def test_torn_checkpoint_keeps_previous(tmp_path):
+@pytest.mark.parametrize("background", [False, True])
+def test_torn_checkpoint_keeps_previous(tmp_path, background):
     """The crash_gap seam: a failure between the node flush and the
     record write must leave the previous record authoritative — the
-    orphaned nodes are harmless (content-addressed)."""
+    orphaned nodes are harmless (content-addressed).  Covered in both
+    durability modes: the legacy on-thread export raises FaultInjected
+    directly; the background flat exporter retries, exhausts, and
+    surfaces the failure as ExporterError at the drain."""
     from coreth_tpu.replay.checkpoint import (
         CheckpointManager, load_checkpoint)
+    from coreth_tpu.state.flat.exporter import ExporterError
     genesis, blocks = build_chain("transfer")
     kv, db = open_db(str(tmp_path))
     gblock = genesis.to_block(db)
     eng = _engine_over(genesis, db, gblock)
     eng.replay(list(blocks[:4]))
-    mgr = CheckpointManager(eng, kv, every=1)
+    mgr = CheckpointManager(eng, kv, every=1, background=background)
     mgr.write()
     first = load_checkpoint(kv)
     assert first.number == blocks[3].number
@@ -106,8 +111,10 @@ def test_torn_checkpoint_keeps_previous(tmp_path):
     eng.replay(list(blocks[4:8]))
     with faults.armed(FaultPlan({"checkpoint/crash_gap":
                                  FaultSpec()})):
-        with pytest.raises(FaultInjected):
+        with pytest.raises(
+                ExporterError if background else FaultInjected):
             mgr.write()
+    mgr.close()
     # the torn write left the PREVIOUS record intact and loadable...
     ck = load_checkpoint(kv)
     assert ck.number == first.number and ck.root == first.root

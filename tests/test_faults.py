@@ -117,6 +117,7 @@ def test_declared_points_all_covered():
     import coreth_tpu.replay.commit  # noqa: F401
     import coreth_tpu.replay.engine  # noqa: F401
     import coreth_tpu.serve.pipeline  # noqa: F401
+    import coreth_tpu.state.flat.exporter  # noqa: F401
     COVERAGE = {
         "device/dispatch":
             "test_faults::test_persistent_device_fault_demotes",
@@ -137,6 +138,12 @@ def test_declared_points_all_covered():
             "test_checkpoint_resume::test_sigkill_resume_matrix",
         "checkpoint/crash_gap":
             "test_checkpoint_resume::test_torn_checkpoint_keeps_previous",
+        "flat/torn_write":
+            "test_flat_state::test_torn_flat_write_retries (+ the "
+            "persistent shape in "
+            "test_torn_flat_write_persistent_keeps_previous)",
+        "flat/stale_generation":
+            "test_flat_state::test_stale_generation_handout_skipped",
     }
     declared = set(faults.declared())
     covered = set(COVERAGE)

@@ -1,0 +1,510 @@
+"""Asynchronous flat-state layer (coreth_tpu/state/flat).
+
+Four surfaces under test:
+
+1. the STORE: O(1) reads, read-through fills, generational diffs with
+   undo, rollback, destruct masking, and the number-stamped rawdb
+   persistence (entries newer than the trusted checkpoint are skipped
+   on reload);
+2. the READ PATH: the flat-vs-trie differential oracle
+   (``CORETH_FLAT_CHECK=1``) armed over transfer/erc20/swap on both
+   trie backends — every flat hit re-derived against the trie — plus
+   an injected-divergence test proving the oracle actually fires;
+3. ROLLBACK: quarantine-then-rollback reaches the strict-mode root
+   bit-identically (engine-level and through the streaming pipeline's
+   ``rollback_quarantined``);
+4. the BACKGROUND EXPORTER: checkpoints land off the execute thread
+   (stamp vs export cost both recorded), resume reloads the persisted
+   flat base, and the ``flat/torn_write`` / ``flat/stale_generation``
+   injection points are survived (completeness-gated in
+   tests/test_faults.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu import faults
+from coreth_tpu.faults import FaultPlan, FaultSpec
+from coreth_tpu.mpt import EMPTY_ROOT, native_trie
+from coreth_tpu.rawdb.kv import MemDB
+from coreth_tpu.serve import ChainFeed, StreamingPipeline
+from coreth_tpu.state.flat import (
+    DELETED, FlatStore, flat_diff_from_statedb,
+)
+from coreth_tpu.types import Block
+from coreth_tpu.types.account import EMPTY_CODE_HASH, EMPTY_ROOT_HASH
+
+from tests.ckpt_child import open_db
+from tests.test_serve import (  # noqa: E501 — deterministic chain builders shared with the serve suite
+    build_swap_chain, build_token_chain, build_transfer_chain,
+    _fresh_engine,
+)
+
+BACKENDS = ["py"] + (["native"] if native_trie.available() else [])
+
+A1 = b"\x11" * 20
+A2 = b"\x22" * 20
+H7 = b"\x77" * 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    yield
+    faults.disarm()
+
+
+def _acct(bal, nonce=0):
+    return (bal, nonce, EMPTY_ROOT_HASH, EMPTY_CODE_HASH, False)
+
+
+class _Hdr:
+    """Minimal header stand-in for store-level tests."""
+
+    def __init__(self, number):
+        self.number = number
+
+    def encode(self):
+        return b"hdr%d" % self.number
+
+
+# ------------------------------------------------------------------ store
+
+def test_store_reads_fills_and_generations():
+    fs = FlatStore()
+    assert fs.account(A1) is None
+    fs.fill_account(A1, _acct(100))
+    assert fs.account(A1) == _acct(100)
+    fs.fill_account(A1, _acct(999))  # fills never clobber
+    assert fs.account(A1) == _acct(100)
+    fs.fill_storage(A1, H7, 42)
+    assert fs.storage_value(A1, H7) == 42
+    assert fs.storage_value(A1, b"\x01" * 32) is None
+
+    gen = fs.apply_generation(
+        number=1, block_hash=b"\x01" * 32, root=b"\x0a" * 32,
+        header=_Hdr(1), prev_root=b"\x0b" * 32,
+        accounts={A1: _acct(50, 1), A2: DELETED},
+        storage={(A1, H7): 7, (A2, b"\x02" * 32): 9})
+    assert fs.account(A1) == _acct(50, 1)
+    assert fs.account(A2) is DELETED
+    assert fs.storage_value(A1, H7) == 7
+    # A2's slot write landed AFTER its DELETED pop (apply order):
+    # deletes mask the tracked storage, later writes repopulate
+    assert fs.storage_value(A2, b"\x02" * 32) == 9
+    assert gen.kind == "window"
+    assert fs.snapshot()["generations"] == 1
+
+    fs.rollback_last()
+    assert fs.account(A1) == _acct(100)   # the fill came back
+    assert fs.account(A2) is None
+    assert fs.storage_value(A1, H7) == 42
+    assert fs.storage_value(A2, b"\x02" * 32) is None
+    assert fs.snapshot()["rollbacks"] == 1
+
+
+def test_store_destruct_masks_and_rollback_restores():
+    fs = FlatStore()
+    fs.fill_storage(A1, H7, 5)
+    fs.fill_storage(A1, b"\x03" * 32, 6)
+    fs.apply_generation(
+        number=1, block_hash=b"\x01" * 32, root=b"\x0a" * 32,
+        header=_Hdr(1), prev_root=b"\x0b" * 32,
+        accounts={A1: _acct(1, 1)}, storage={(A1, H7): 8},
+        destructs=[A1], kind="quarantine", hold=True)
+    # the destruct killed BOTH tracked slots; the later write
+    # repopulated exactly one
+    assert fs.storage_value(A1, H7) == 8
+    assert fs.storage_value(A1, b"\x03" * 32) is None
+    fs.rollback_last()
+    assert fs.storage_value(A1, H7) == 5
+    assert fs.storage_value(A1, b"\x03" * 32) == 6
+
+
+def test_store_persistence_trust_filter():
+    """Entries persist number-stamped; a reload trusts only entries at
+    or below the checkpoint record's block — the crash shape where the
+    exporter ran ahead of the record."""
+    fs = FlatStore()
+    kv = MemDB()
+    g1 = fs.apply_generation(
+        number=3, block_hash=b"\x01" * 32, root=b"\x0a" * 32,
+        header=_Hdr(3), accounts={A1: _acct(10, 1)},
+        storage={(A1, H7): 70})
+    g2 = fs.apply_generation(
+        number=6, block_hash=b"\x02" * 32, root=b"\x0c" * 32,
+        header=_Hdr(6), accounts={A2: _acct(20, 2), A1: DELETED},
+        storage={(A2, H7): 99})
+    fs.write_gen_entries(kv, g1)
+    fs.write_gen_entries(kv, g2)
+
+    warm = FlatStore()
+    n = warm.load(kv, trusted_number=3)
+    # A1's account entry was OVERWRITTEN by gen 6 (per-key last-write-
+    # wins), so its newest stamp is untrusted and it drops to unknown
+    # (trie fallthrough); its gen-3 STORAGE entry is poisoned too —
+    # the gen-6 deletion landed a barrier past the trusted number, and
+    # whether that deletion belongs to the resumed timeline is
+    # unknowable (see test_store_persistence_destruct_barrier)
+    assert n == 0
+    assert warm.account(A1) is None
+    assert warm.account(A2) is None    # gen-6 entry skipped
+    assert warm.storage_value(A1, H7) is None
+    assert warm.storage_value(A2, H7) is None
+
+    full = FlatStore()
+    full.load(kv, trusted_number=6)
+    assert full.account(A1) is DELETED
+    assert full.account(A2) == _acct(20, 2)
+    # a trusted DELETED account must not keep stale storage
+    assert full.storage_value(A1, H7) is None
+    assert full.storage_value(A2, H7) == 99
+
+
+def test_store_persistence_destruct_barrier():
+    """A destruct (or delete)+re-create must not resurrect STALE
+    persisted slot entries on reload: old 'fs' keys are not
+    enumerable per account (keccak-keyed), so the exporter lands a
+    storage BARRIER — entries stamped below it are dead, the
+    re-create generation's own writes (stamped equal) survive, and a
+    barrier PAST the trusted number poisons the account's persisted
+    storage wholesale (trie fallthrough beats a maybe-stale hit)."""
+    fs = FlatStore()
+    kv = MemDB()
+    g1 = fs.apply_generation(
+        number=3, block_hash=b"\x01" * 32, root=b"\x0a" * 32,
+        header=_Hdr(3), accounts={A1: _acct(10, 1)},
+        storage={(A1, H7): 70, (A1, b"\x03" * 32): 30})
+    # block 6 destructs + re-creates A1, rewriting only H7
+    g2 = fs.apply_generation(
+        number=6, block_hash=b"\x02" * 32, root=b"\x0c" * 32,
+        header=_Hdr(6), accounts={A1: _acct(1, 1)},
+        storage={(A1, H7): 700}, destructs=[A1])
+    fs.write_gen_entries(kv, g1)
+    fs.write_gen_entries(kv, g2)
+
+    warm = FlatStore()
+    warm.load(kv, trusted_number=6)
+    assert warm.account(A1) == _acct(1, 1)
+    assert warm.storage_value(A1, H7) == 700       # same-gen rewrite
+    # the UNREWRITTEN pre-destruct slot must NOT come back
+    assert warm.storage_value(A1, b"\x03" * 32) is None
+
+    # a barrier past the trusted number poisons the whole account's
+    # persisted storage (the destruct may or may not be in the
+    # resumed timeline — fall through to the trie)
+    early = FlatStore()
+    early.load(kv, trusted_number=3)
+    assert early.storage_value(A1, H7) is None
+    assert early.storage_value(A1, b"\x03" * 32) is None
+
+
+def test_store_checkpoint_marker_and_hold_release():
+    fs = FlatStore()
+    fs.apply_generation(
+        number=1, block_hash=b"\x01" * 32, root=b"\x0a" * 32,
+        header=_Hdr(1), accounts={A1: _acct(1)}, storage={})
+    mk = fs.mark_checkpoint()
+    assert mk.kind == "checkpoint" and mk.checkpoint
+    assert mk.number == 1 and mk.root == b"\x0a" * 32
+    # a held (quarantine) generation blocks the export queue...
+    q = fs.apply_generation(
+        number=2, block_hash=b"\x02" * 32, root=b"\x0b" * 32,
+        header=_Hdr(2), accounts={A1: _acct(2)}, storage={},
+        kind="quarantine", hold=True)
+    fs.attach_exporter()
+    got = fs.next_for_export(0.01)
+    assert got is not None and got.number == 1
+    fs.mark_exported(got)
+    fs.mark_exported(mk)
+    assert fs.next_for_export(0.01) is None   # blocked at the hold
+    assert fs.drained()                       # ...but drains cleanly
+    # a later REAL generation releases the hold (chain accepted past)
+    fs.apply_generation(
+        number=3, block_hash=b"\x03" * 32, root=b"\x0c" * 32,
+        header=_Hdr(3), accounts={A1: _acct(3)}, storage={})
+    assert not q.hold
+    assert fs.next_for_export(0.01) is q
+
+
+# ---------------------------------------------------------- read path
+
+def _builders():
+    return [("transfer", build_transfer_chain),
+            ("erc20", build_token_chain),
+            ("swap", build_swap_chain)]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("workload", ["transfer", "erc20", "swap"])
+def test_flat_oracle_armed_replay(monkeypatch, workload, backend):
+    """The acceptance matrix: CORETH_FLAT_CHECK=1 re-derives EVERY
+    flat hit against the trie during a full replay — transfer/erc20/
+    swap x CORETH_TRIE=native|py — and the roots stay bit-identical
+    to the headers."""
+    monkeypatch.setenv("CORETH_TRIE", backend)
+    monkeypatch.setenv("CORETH_FLAT_CHECK", "1")
+    builder = dict(_builders())[workload]
+    genesis, blocks = builder()
+    eng, _ = _fresh_engine(genesis)
+    assert eng._flat_check and eng.flat is not None
+    root = eng.replay(list(blocks))
+    assert root == blocks[-1].header.root
+    snap = eng.flat.snapshot()
+    assert snap["generations"] > 0
+    assert snap["fills"] > 0
+
+
+def test_flat_oracle_catches_divergence(monkeypatch):
+    """A poisoned flat entry must be CAUGHT, not served: the armed
+    oracle re-derives the hit from the trie and raises."""
+    from coreth_tpu.replay.engine import ReplayError
+    from coreth_tpu.state import StateDB
+    monkeypatch.setenv("CORETH_FLAT_CHECK", "1")
+    genesis, blocks = build_transfer_chain()
+    eng, _ = _fresh_engine(genesis)
+    eng.replay(list(blocks))
+    victim = b"\x9a" * 20              # never touched by the chain
+    eng.flat.fill_account(victim, _acct(123456))
+    with pytest.raises(ReplayError, match="flat oracle"):
+        eng._account(victim)
+    # the StateDB resolution path has its own oracle
+    eng.flat.accounts.pop(victim)
+    eng.flat.fill_account(victim, _acct(777))
+    sdb = StateDB(eng.commit(), eng.db, flat=eng._flat_view())
+    with pytest.raises(ValueError, match="flat oracle"):
+        sdb.get_balance(victim)
+
+
+@pytest.mark.parametrize("flat", ["0", "1"])
+def test_flat_ab_equivalence(monkeypatch, flat):
+    """CORETH_FLAT=0 restores the trie-walk-only read path with
+    bit-identical roots (the A/B the bench's cold-read microbench
+    compares)."""
+    monkeypatch.setenv("CORETH_FLAT", flat)
+    genesis, blocks = build_token_chain()
+    eng, _ = _fresh_engine(genesis)
+    root = eng.replay(list(blocks))
+    assert root == blocks[-1].header.root
+    assert (eng.flat is None) == (flat == "0")
+
+
+# ------------------------------------------------------------- rollback
+
+def _corrupt_drop_tx(block: Block) -> Block:
+    """A poison block whose COMPUTED state genuinely diverges: the
+    body lost its last tx while the header still claims it — gas,
+    receipts, and state root all mismatch, and the tolerantly-applied
+    transition differs from the true block's."""
+    bad = Block.decode(block.encode())
+    bad.transactions.pop()
+    return bad
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_quarantine_then_rollback_engine(monkeypatch, backend):
+    """The acceptance test: quarantine a diverging block, roll it
+    back through the flat layer's generational undo, and re-converge
+    to the strict-mode root bit-identically."""
+    monkeypatch.setenv("CORETH_TRIE", backend)
+    genesis, blocks = build_transfer_chain(n_blocks=8)
+    eng, _ = _fresh_engine(genesis)
+    eng.replay(list(blocks[:4]))
+    assert eng.root == blocks[3].header.root
+    pre_root = eng.root
+
+    bad = _corrupt_drop_tx(blocks[4])
+    reasons = eng.quarantine_block(bad)
+    assert reasons                      # mismatches recorded, not raised
+    assert eng.root != blocks[4].header.root  # diverged state applied
+
+    eng.rollback_block(bad)
+    assert eng.root == pre_root
+    assert eng.stats.blocks_rolled_back == 1
+
+    # strict re-convergence over the TRUE tail: bit-identical root
+    eng.replay(list(blocks[4:]))
+    assert eng.root == blocks[-1].header.root
+
+
+def test_quarantine_then_rollback_pipeline():
+    """StreamingPipeline.rollback_quarantined: the corrected block
+    streams in place of the popped poison block and the stream ends on
+    the strict root."""
+    genesis, blocks = build_transfer_chain(n_blocks=8)
+    eng, _ = _fresh_engine(genesis)
+    feed = list(blocks[:4]) + [_corrupt_drop_tx(blocks[4])]
+    pipe = StreamingPipeline(eng, ChainFeed(feed))
+    rep = pipe.run()
+    assert len(rep.quarantined) == 1
+    assert rep.quarantined[0]["number"] == blocks[4].number
+    assert rep.flat.get("generations", 0) > 0
+
+    pipe.rollback_quarantined()
+    assert eng.root == blocks[3].header.root
+    assert not pipe.stats.quarantined
+
+    pipe2 = StreamingPipeline(eng, ChainFeed(list(blocks[4:])))
+    pipe2.run()
+    assert eng.root == blocks[-1].header.root
+
+
+def test_rollback_refuses_non_quarantine_tip():
+    from coreth_tpu.replay.engine import ReplayError
+    genesis, blocks = build_transfer_chain()
+    eng, _ = _fresh_engine(genesis)
+    eng.replay(list(blocks))
+    with pytest.raises(ReplayError, match="rollback target"):
+        eng.rollback_block(blocks[-1])
+
+
+# -------------------------------------------------- background exporter
+
+def _disk_engine(tmp_path, genesis):
+    from coreth_tpu.replay import ReplayEngine
+    kv, db = open_db(str(tmp_path))
+    gblock = genesis.to_block(db)
+    eng = ReplayEngine(genesis.config, db, gblock.root,
+                       parent_header=gblock.header, capacity=256,
+                       batch_pad=64, window=4)
+    return kv, db, eng
+
+
+def test_background_checkpoint_off_execute_thread(tmp_path):
+    """The tentpole durability claim: with the flat layer armed the
+    execute thread only STAMPS generation boundaries (stamp_ms
+    recorded) while the exporter thread re-derives the trie and writes
+    the records; a resume reloads the persisted flat base and finishes
+    on the exact root."""
+    genesis, blocks = build_transfer_chain(n_blocks=8)
+    kv, db, eng = _disk_engine(tmp_path, genesis)
+    pipe = StreamingPipeline(eng, ChainFeed(list(blocks[:6])),
+                             checkpoint_every=2)
+    rep = pipe.run()
+    ck = rep.checkpoint
+    assert ck["background"] is True
+    assert ck["written"] >= 2
+    exp = ck["exporter"]
+    assert exp["exports"] > 0 and exp["records"] == ck["written"]
+    assert not exp["failed"]
+    assert exp["entries_written"] > 0
+    assert ck["last_number"] == blocks[5].number
+    kv.close()
+    del eng, db
+
+    kv2, db2 = open_db(str(tmp_path))
+    from coreth_tpu.replay.checkpoint import resume_engine
+    eng2, ckpt = resume_engine(genesis.config, db2, kv2, capacity=256,
+                               batch_pad=64, window=4)
+    assert ckpt.number == blocks[5].number
+    # the persisted flat base came back warm
+    assert eng2.flat.loaded_entries > 0
+    StreamingPipeline(eng2, ChainFeed(list(blocks[6:]))).run()
+    assert eng2.root == blocks[-1].header.root
+    kv2.close()
+
+
+def test_checkpoint_sync_mode_ab(tmp_path, monkeypatch):
+    """CORETH_CHECKPOINT_SYNC=1 restores the PR-10 on-thread export —
+    same records, no exporter thread."""
+    monkeypatch.setenv("CORETH_CHECKPOINT_SYNC", "1")
+    genesis, blocks = build_transfer_chain(n_blocks=6)
+    kv, db, eng = _disk_engine(tmp_path, genesis)
+    pipe = StreamingPipeline(eng, ChainFeed(list(blocks)),
+                             checkpoint_every=3)
+    rep = pipe.run()
+    assert rep.checkpoint["background"] is False
+    assert rep.checkpoint["written"] >= 2
+    assert rep.checkpoint["last_number"] == blocks[-1].number
+    kv.close()
+
+
+def test_torn_flat_write_retries(tmp_path):
+    """flat/torn_write (transient shape): injected failures between the
+    entry writes and the record write are absorbed by the exporter's
+    bounded retry (the writes are idempotent puts) — records still
+    land, roots unaffected."""
+    genesis, blocks = build_transfer_chain(n_blocks=6)
+    kv, db, eng = _disk_engine(tmp_path, genesis)
+    with faults.armed(FaultPlan({"flat/torn_write":
+                                 FaultSpec(times=2)})):
+        pipe = StreamingPipeline(eng, ChainFeed(list(blocks)),
+                                 checkpoint_every=2)
+        rep = pipe.run()
+    assert rep.faults.get("flat/torn_write") == 2
+    assert rep.checkpoint["written"] >= 2
+    assert not rep.checkpoint["exporter"]["failed"]
+    assert eng.root == blocks[-1].header.root
+    kv.close()
+
+
+def test_torn_flat_write_persistent_keeps_previous(tmp_path):
+    """flat/torn_write (persistent shape): the exporter exhausts its
+    retries and surfaces the failure at the drain; whatever record
+    exists stays authoritative and a resume from it replays to the
+    true root — the PR-10 guarantee under the new seam."""
+    from coreth_tpu.state.flat.exporter import ExporterError
+    genesis, blocks = build_transfer_chain(n_blocks=8)
+    kv, db, eng = _disk_engine(tmp_path, genesis)
+    # let the first interval land, then fail every torn-write attempt
+    with faults.armed(FaultPlan({"flat/torn_write":
+                                 FaultSpec(after=2)})):
+        pipe = StreamingPipeline(eng, ChainFeed(list(blocks)),
+                                 checkpoint_every=2)
+        with pytest.raises(ExporterError):
+            pipe.run()
+    from coreth_tpu.replay.checkpoint import load_checkpoint
+    ck = load_checkpoint(kv)
+    assert ck is not None            # the pre-fault record survived
+    assert ck.number < blocks[-1].number
+    kv.close()
+    kv2, db2 = open_db(str(tmp_path))
+    from coreth_tpu.replay.checkpoint import resume_engine
+    eng2, ckpt = resume_engine(genesis.config, db2, kv2, capacity=256,
+                               batch_pad=64, window=4)
+    eng2.replay(list(blocks[ckpt.number:]))
+    assert eng2.root == blocks[-1].header.root
+    kv2.close()
+
+
+def test_stale_generation_handout_skipped(tmp_path):
+    """flat/stale_generation: the export queue hands back an already-
+    exported generation (the queue-races-rollback shape); the exporter
+    detects it by flag, skips without double-applying, and later
+    records stay correct."""
+    genesis, blocks = build_transfer_chain(n_blocks=8)
+    kv, db, eng = _disk_engine(tmp_path, genesis)
+    with faults.armed(FaultPlan({"flat/stale_generation":
+                                 FaultSpec(times=3)})):
+        pipe = StreamingPipeline(eng, ChainFeed(list(blocks)),
+                                 checkpoint_every=2)
+        rep = pipe.run()
+    exp = rep.checkpoint["exporter"]
+    assert exp["stale_skips"] >= 1
+    assert not exp["failed"]
+    assert rep.checkpoint["written"] >= 2
+    assert eng.root == blocks[-1].header.root
+    assert rep.checkpoint["last_number"] == blocks[-1].number
+    kv.close()
+
+
+def test_diff_from_statedb_shapes():
+    """flat_diff_from_statedb mirrors the snapshot diff feed in raw
+    key space: mutated accounts, written slots, destruct set."""
+    from coreth_tpu.state import Database, StateDB
+    db = Database()
+    sdb = StateDB(EMPTY_ROOT, db)
+    sdb.add_balance(A1, 1000)
+    sdb.set_state(A1, H7, (5).to_bytes(32, "big"))
+    sdb.add_balance(A2, 1)
+    sdb.suicide(A2)
+    sdb.intermediate_root(True)
+    accounts, storage, destructs = flat_diff_from_statedb(sdb)
+    assert accounts[A1][0] == 1000
+    assert accounts[A2] is DELETED
+    key = bytes([H7[0] & 0xFE]) + H7[1:]   # normalized partition
+    assert storage[(A1, key)] == 5
+    assert destructs == [A2]

@@ -162,6 +162,43 @@ def test_layer_unmapped_package_flagged():
     assert codes(check_layers([s2], CONFIG)) == ["LAY002"]
 
 
+def test_layer_nested_package_own_level():
+    """state/flat has its OWN level below state: a state/flat source
+    importing upward into state is LAY001, while state (and replay)
+    importing down into state/flat is fine — nested names resolve
+    most-specific-first against the configured levels."""
+    assert CONFIG.levels["state/flat"] < CONFIG.levels["state"]
+    up = src("from coreth_tpu.state import StateDB\n",
+             path="coreth_tpu/state/flat/store.py")
+    assert codes(check_layers([up], CONFIG)) == ["LAY001"]
+    down = src("from coreth_tpu.state.flat import FlatStore\n",
+               path="coreth_tpu/state/statedb.py")
+    assert check_layers([down], CONFIG) == []
+    down2 = src("from coreth_tpu.state.flat.store import FlatStore\n",
+                path="coreth_tpu/replay/engine.py")
+    assert check_layers([down2], CONFIG) == []
+
+
+def test_layer_nested_package_internal_and_fallback():
+    """Imports WITHIN a configured nested package are same-package;
+    an unconfigured nested directory still resolves to its top-level
+    package (evm/device inherits evm's level)."""
+    inner = src("from .store import FlatStore\n"
+                "from coreth_tpu.state.flat import DELETED\n",
+                path="coreth_tpu/state/flat/exporter.py")
+    assert check_layers([inner], CONFIG) == []
+    # evm/device is NOT in layers.toml: resolves to evm, so importing
+    # state (one level down from evm) stays legal
+    dev = src("from coreth_tpu.state import StateDB\n",
+              path="coreth_tpu/evm/device/adapter2.py")
+    assert check_layers([dev], CONFIG) == []
+    # ...and state/flat importing mpt/rawdb (below it) is legal
+    ok = src("from coreth_tpu.mpt import EMPTY_ROOT\n"
+             "from coreth_tpu.rawdb import schema\n",
+             path="coreth_tpu/state/flat/exporter.py")
+    assert check_layers([ok], CONFIG) == []
+
+
 def test_package_of():
     assert package_of("coreth_tpu/mpt/trie.py") == "mpt"
     assert package_of("coreth_tpu/rlp.py") == "rlp"

@@ -51,6 +51,45 @@ TOKEN = b"\x75" * 20
 ESCAPER = b"\x76" * 20
 ESCAPER_CODE = bytes.fromhex("600061138852" + "00")
 
+# ------------------------------------------------- nested-mapping fixture
+# allowance-style contract: spend(address spender, uint256 amt) does
+#   allowance[caller][spender] += amt
+# with allowance = mapping(address => mapping(address => uint)) at slot
+# 2, i.e. the value slot is keccak(pad32(spender) || keccak(pad32(
+# caller) || pad32(2))) — the SECOND-level Solidity mapping rule that
+# first-level recipes cannot derive (the inner hash is not a small
+# constant).  Hand-assembled with the erc20 workload's assembler.
+from coreth_tpu.workloads.erc20 import _assemble, _b1  # noqa: E402
+
+SPEND_SELECTOR = bytes.fromhex("a1b2c3d4")
+ALLOW = b"\x78" * 20
+ALLOW_RUNTIME = _assemble([
+    _b1(0x00), "CALLDATALOAD", _b1(0xE0), "SHR",
+    "DUP1", ("PUSH", SPEND_SELECTOR), "EQ", ("PUSHL", "spend"),
+    "JUMPI",
+    _b1(0x00), _b1(0x00), "REVERT",
+
+    ("LABEL", "spend"),
+    # inner = keccak(pad32(caller) ++ pad32(2))
+    "CALLER", _b1(0x00), "MSTORE",
+    _b1(0x02), _b1(0x20), "MSTORE",
+    _b1(0x40), _b1(0x00), "SHA3",                    # [inner]
+    # key = keccak(pad32(spender) ++ inner)
+    _b1(0x04), "CALLDATALOAD", _b1(0x00), "MSTORE",  # [inner]
+    _b1(0x20), "MSTORE",                             # [] mem32 = inner
+    _b1(0x40), _b1(0x00), "SHA3",                    # [key]
+    "DUP1", "SLOAD",                                 # [key, old]
+    _b1(0x24), "CALLDATALOAD", "ADD",                # [key, old+amt]
+    "SWAP1", "SSTORE",                               # []
+    _b1(0x01), _b1(0x00), "MSTORE",
+    _b1(0x20), _b1(0x00), "RETURN",
+])
+
+
+def spend_calldata(spender: bytes, amount: int) -> bytes:
+    return (SPEND_SELECTOR + b"\x00" * 12 + spender
+            + amount.to_bytes(32, "big"))
+
 
 def _alloc(extra=None):
     alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
@@ -322,6 +361,54 @@ def test_occ_predicted_premap_erc20(monkeypatch):
     assert legacy.root == eng.root == blocks[-1].root
     lc = legacy._machine.machine_counters()
     assert lc["premap_predicted"] == 0
+    assert lc["discovery_dispatches"] > mc["discovery_dispatches"]
+
+
+def test_occ_nested_premap_allowance(monkeypatch):
+    """PR-9 carry-over CI gate: allowance-style NESTED-mapping keys
+    ``keccak(pad32(spender) || keccak(pad32(caller) || pad32(slot)))``
+    learn as second-level recipes — the inner hash of a miss matches a
+    known first-level derivation, so one discovery cycle teaches
+    (sel, "nest", (data, 0), (caller,), 2) and every later window
+    derives fresh spenders' slots BEFORE dispatch.  Pins
+    dispatches_per_block <= 1.1, premap_nested > 0, and bit-identical
+    roots vs the nesting-disabled miss-and-rerun A/B
+    (CORETH_PREMAP_NEST=0)."""
+    from coreth_tpu.chain import GenesisAccount
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+    extra = {ALLOW: GenesisAccount(balance=0, code=ALLOW_RUNTIME,
+                                   nonce=1)}
+
+    def gen(i, nonces):
+        # fresh spender every block: the nested value slot is a fresh
+        # keccak chain neither static footprints, first-level recipes,
+        # nor the common-key residue could premap
+        return [_tx(k, nonces, ALLOW,
+                    spend_calldata(
+                        bytes([0xB0 + i]) + bytes([k]) * 19, 5 + k))
+                for k in range(6)]
+
+    gblock, blocks = _build_chain(8, gen, extra)
+    d0 = ADP.DISPATCH_COUNT
+    eng = _replay(gblock, blocks, extra)
+    disp = ADP.DISPATCH_COUNT - d0
+    mx = eng._machine
+    assert mx.blocks == 8
+    mc = mx.machine_counters()
+    assert mc["premap_nested"] > 0
+    assert mc["premap_hits"] > 0
+    # only the first window's discovery cycle re-dispatches (inner and
+    # outer keccaks resolve against block-start state in one round)
+    assert mc["discovery_dispatches"] <= 2
+    assert disp / mx.blocks <= 1.1
+
+    # A/B: without nested recipes the same chain lands the same root,
+    # paying a discovery re-dispatch for (almost) every window
+    monkeypatch.setenv("CORETH_PREMAP_NEST", "0")
+    legacy = _replay(gblock, blocks, extra)
+    assert legacy.root == eng.root == blocks[-1].root
+    lc = legacy._machine.machine_counters()
+    assert lc["premap_nested"] == 0
     assert lc["discovery_dispatches"] > mc["discovery_dispatches"]
 
 

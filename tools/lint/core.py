@@ -109,6 +109,23 @@ def package_of(path: str) -> Optional[str]:
     return rest[0]
 
 
+def nested_package_of(path: str) -> Optional[str]:
+    """The '/'-joined SUBPACKAGE name of a file nested more than one
+    directory under coreth_tpu — ``coreth_tpu/state/flat/store.py`` ->
+    ``state/flat`` — or None for top-level packages/modules.  Lets
+    layers.toml assign nested packages (e.g. ``state/flat``) their own
+    layer: resolution picks the most specific configured name and
+    falls back to the top-level package (see layers.check_layers)."""
+    parts = path.replace(os.sep, "/").split("/")
+    if ROOT_PACKAGE not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index(ROOT_PACKAGE)
+    rest = parts[idx + 1:]
+    if len(rest) <= 2:
+        return None
+    return "/".join(rest[:-1])
+
+
 def collect_sources(paths: Sequence[str]) -> List[Source]:
     files = []
     for p in paths:

@@ -186,8 +186,10 @@ def _check_division(src: Source, findings: List[Finding]) -> None:
 def check_determinism(sources: List[Source], config) -> List[Finding]:
     packages = set(config.determinism_packages)
     findings = []
+    from tools.lint.core import nested_package_of
     for src in sources:
-        if src.package not in packages:
+        nested = nested_package_of(src.path)
+        if src.package not in packages and nested not in packages:
             continue
         _check_division(src, findings)
         # module names (incl. aliases) bound to entropy modules

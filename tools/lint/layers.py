@@ -18,7 +18,9 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from tools.lint.core import Finding, ROOT_PACKAGE, Source
+from tools.lint.core import (
+    Finding, ROOT_PACKAGE, Source, nested_package_of,
+)
 
 DEFAULT_TOML = os.path.join(os.path.dirname(__file__), "layers.toml")
 
@@ -102,14 +104,46 @@ def load_config(toml_path: str = DEFAULT_TOML) -> Config:
     return cfg
 
 
-def _import_targets(src: Source):
+def _resolve_nested(mod_tail: List[str], levels: Dict[str, int]) -> str:
+    """Most specific configured package name for an import path tail
+    (the parts after ``coreth_tpu``): ``["state", "flat", "store"]``
+    resolves to ``state/flat`` when layers.toml assigns that nested
+    package its own layer, else to the top-level ``state``."""
+    for k in range(len(mod_tail), 1, -1):
+        cand = "/".join(mod_tail[:k])
+        if cand in levels:
+            return cand
+    return mod_tail[0]
+
+
+def _source_package(src: Source, levels: Dict[str, int]) -> Optional[str]:
+    """The source file's package at configured granularity: the nested
+    name when layers.toml maps it, else the top-level package."""
+    nested = nested_package_of(src.path)
+    if nested is not None:
+        for cand in _prefixes_desc(nested):
+            if cand in levels:
+                return cand
+    return src.package
+
+
+def _prefixes_desc(nested: str) -> List[str]:
+    parts = nested.split("/")
+    return ["/".join(parts[:k]) for k in range(len(parts), 1, -1)]
+
+
+def _import_targets(src: Source, levels: Optional[Dict[str, int]] = None):
     """Yield (node, target_package, name_form) for every coreth_tpu
     import, module-level or nested.  Relative imports are resolved
     against the source file's own package — ``from ..state import X``
     inside ``coreth_tpu/mpt/`` targets ``state`` exactly like the
     absolute form, so the standard relative idiom cannot dodge the
     gate.  ``name_form`` marks ``from coreth_tpu import X`` aliases,
-    where X may be a plain re-exported symbol rather than a package."""
+    where X may be a plain re-exported symbol rather than a package.
+    With ``levels``, dotted targets resolve to the most specific
+    configured nested package (``coreth_tpu.state.flat.store`` ->
+    ``state/flat``)."""
+    levels = levels or {}
     parts = src.path.split("/")
     pkg_parts = None  # the file's containing package, e.g. [root, "mpt"]
     if ROOT_PACKAGE in parts:
@@ -122,7 +156,9 @@ def _import_targets(src: Source):
                 if mod[0] == ROOT_PACKAGE:
                     # len==1: bare root import — target is the root
                     # itself (check_layers turns it into LAY003)
-                    yield node, mod[1] if len(mod) > 1 else ROOT_PACKAGE, False
+                    yield node, (_resolve_nested(mod[1:], levels)
+                                 if len(mod) > 1
+                                 else ROOT_PACKAGE), False
         elif isinstance(node, ast.ImportFrom):
             if node.level:
                 if pkg_parts is None or node.level > len(pkg_parts):
@@ -134,7 +170,7 @@ def _import_targets(src: Source):
             if mod[0] != ROOT_PACKAGE:
                 continue
             if len(mod) > 1:
-                yield node, mod[1], False
+                yield node, _resolve_nested(mod[1:], levels), False
             else:  # from coreth_tpu import rlp, wire  /  from .. import rlp
                 for alias in node.names:
                     yield node, alias.name, True
@@ -142,9 +178,10 @@ def _import_targets(src: Source):
 
 def check_layers(sources: List[Source], config: Config) -> List[Finding]:
     findings = []
-    present = {s.package for s in sources}  # packages actually scanned
+    # packages actually scanned (configured granularity)
+    present = {_source_package(s, config.levels) for s in sources}
     for src in sources:
-        pkg = src.package
+        pkg = _source_package(src, config.levels)
         if pkg is None or pkg == ROOT_PACKAGE:
             continue  # outside the tree / root __init__ re-exports
         if pkg not in config.levels:
@@ -157,7 +194,8 @@ def check_layers(sources: List[Source], config: Config) -> List[Finding]:
         # LAY004 — the native-runtime boundary: a raw ctypes import
         # outside the designated binder packages bypasses the loader,
         # the ABI declarations, and the per-symbol degradation policy
-        if config.ctypes_packages and pkg not in config.ctypes_packages:
+        if config.ctypes_packages \
+                and pkg.split("/")[0] not in config.ctypes_packages:
             for node in ast.walk(src.tree):
                 mods = []
                 if isinstance(node, ast.Import):
@@ -172,7 +210,8 @@ def check_layers(sources: List[Source], config: Config) -> List[Finding]:
                         f"native runtime; go through their wrappers",
                         "ctypes-outside-boundary"))
         seen = set()
-        for node, target, name_form in _import_targets(src):
+        for node, target, name_form in _import_targets(src,
+                                                       config.levels):
             if target == pkg:
                 continue
             if target == ROOT_PACKAGE:
