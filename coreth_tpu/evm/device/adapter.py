@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from coreth_tpu import faults
 from coreth_tpu.crypto.keccak import keccak256_many
+from coreth_tpu import obs
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as T
 from coreth_tpu.ops import u256
@@ -64,6 +65,7 @@ DISPATCH_COUNT = 0
 def _count_dispatch() -> None:
     global DISPATCH_COUNT
     DISPATCH_COUNT += 1
+    obs.instant("device/dispatch")
 
 
 def addr_word(addr: bytes) -> int:
@@ -944,7 +946,8 @@ class MachineWindowRunner:
         )
         fn = self._get_kernel(p, occ)
         _count_dispatch()
-        out = fn(table, key_tab, inputs)
+        with obs.jax_span("coreth/occ_window"):
+            out = fn(table, key_tab, inputs)
         # the input table was donated into the dispatch; the output
         # handle (post-window committed state) replaces it
         self.table = out["table"]
@@ -986,6 +989,8 @@ class MachineWindowRunner:
             self._buckets_used.add(key)
             if not self._cold:
                 self.kernel_retraces += 1
+                obs.instant("device/kernel_retrace",
+                            table_cap=occ.table_cap)
         fut = self._warm_pending.pop(key, None)
         if fut is not None:
             # a background pre-warm of THIS bucket is in flight: join
@@ -1085,8 +1090,10 @@ class MachineWindowRunner:
                       occ: M.OccParams) -> None:
         """Body of one background pre-warm: build + trace + dispatch
         the all-inactive warm batch for a bucket (compile-thread)."""
-        fn = self._kernel(p, occ)
-        fn(*self._warm_args(p, occ))
+        with obs.span("device/prewarm_compile",
+                      table_cap=occ.table_cap):
+            fn = self._kernel(p, occ)
+            fn(*self._warm_args(p, occ))
 
     # ---------------------------------------------------------- complete
     def _block_stride(self, handle: dict) -> int:
@@ -1100,6 +1107,7 @@ class MachineWindowRunner:
 
     def _on_result_fetch(self, handle: dict) -> None:
         """Hook for the sharded runner's dispatch-ordering trace."""
+        obs.instant("device/result_fetch")
 
     def complete(self, handle: dict) -> WindowResult:
         """Fetch a window's results; resolve any storage keys that

@@ -38,7 +38,6 @@ re-execute every lane.  This module shards the machine path instead:
 
 from __future__ import annotations
 
-from collections import deque
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -46,7 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as PS
 
-from coreth_tpu import faults
+from coreth_tpu import faults, obs
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as T
@@ -67,11 +66,16 @@ PT_EXCHANGE = faults.declare(
 
 # Dispatch/fetch ordering trace for the overlap test: entries are
 # "dispatch:<seq>", "exchange_fetch:<seq>", "result_fetch:<seq>".
-# Bounded (a long-running mesh service appends a few entries per
-# window forever), and seq is MODULE-global so two runners in one
+# An obs.EventRing — a small ALWAYS-ON bounded ring with the exact
+# deque semantics the dispatch-ordering test in
+# tests/test_shard_replay.py pins (a long-running mesh service appends
+# a few entries per window forever), which additionally mirrors each
+# entry into the active span tracer as an instant event when
+# CORETH_TRACE=1, so the Perfetto timeline shows the same
+# dispatch/fetch ordering.  seq is MODULE-global so two runners in one
 # process (e.g. a mempool-fed builder + replica pair) never emit
 # colliding entries.
-EVENT_LOG: "deque[str]" = deque(maxlen=512)
+EVENT_LOG = obs.EventRing("shard", maxlen=512)
 _SEQ = [0]
 
 
@@ -326,6 +330,7 @@ class ShardedWindowRunner(MachineWindowRunner):
             self.table = _grow(self.table)
             self.key_tab = _grow(self.key_tab)
             self.table_cap = G
+            obs.instant("device/table_grow", per_shard_rows=G)
         if self.table is None or self.table_cap != G or self._stale:
             tv = np.zeros((n * G, u256.LIMBS), dtype=np.int32)
             tk = np.zeros((n * G, u256.LIMBS), dtype=np.int32)
@@ -505,7 +510,8 @@ class ShardedWindowRunner(MachineWindowRunner):
         _count_dispatch()
         seq = _next_seq()
         EVENT_LOG.append(f"dispatch:{seq}")
-        out = fn(table, key_tab, inputs)
+        with obs.jax_span("coreth/shard_occ_window"):
+            out = fn(table, key_tab, inputs)
         self.table = out["table"]
         self._dispatched += 1
         # the exchange rides the same device queue, right behind the

@@ -27,6 +27,9 @@ import os
 from typing import Dict, Optional
 
 from coreth_tpu import faults
+# the local name `obs` is taken by the fault OBSERVER below; bind the
+# tracing API under an explicit alias
+from coreth_tpu.obs import span as _trace_span
 from coreth_tpu.evm import vmerrs
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device.tables import fork_key
@@ -181,10 +184,11 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
                ctx.base_fee or 0, ctx.difficulty)
     be.set_code(addr, code)
     try:
-        res = be.call(
-            caller, addr, value, evm.tx_ctx.gas_price, input_, gas,
-            warm_addrs=sorted(statedb.access_list_addresses),
-            warm_slots=sorted(statedb.access_list_slots))
+        with _trace_span("hostexec/native_call", gas=gas):
+            res = be.call(
+                caller, addr, value, evm.tx_ctx.gas_price, input_, gas,
+                warm_addrs=sorted(statedb.access_list_addresses),
+                warm_slots=sorted(statedb.access_list_slots))
     except faults.FaultInjected as exc:
         # the native/error_rc seam (backend.py): an error rc from the
         # session is a per-tx interpreter fallback + a native strike —

@@ -27,6 +27,12 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
         snap = metric.snapshot()
         base = _sanitize(name)
         kind = snap.pop("type")
+        desc = reg.description(name)
+        if desc:
+            # HELP precedes TYPE for the metric family's primary name
+            # (meters expose under <base>_total)
+            helped = f"{base}_total" if kind == "meter" else base
+            lines.append(f"# HELP {helped} {desc}")
         if kind == "counter":
             lines.append(f"# TYPE {base} counter")
             lines.append(f"{base} {snap['count']}")

@@ -63,7 +63,13 @@ class Meter:
 
     def rate_mean(self, clock=_time.monotonic) -> float:
         dt = clock() - self.start
-        return self.count / dt if dt > 0 else 0.0
+        # first-scrape guard: dt can be ~0 (a scrape right after
+        # registration, or a coarse clock returning the same tick) and
+        # count/dt would explode into a bogus rate — report 0 until a
+        # meaningful interval has elapsed
+        if dt < 1e-6:
+            return 0.0
+        return self.count / dt
 
     def snapshot(self) -> dict:
         return {"type": "meter", "count": self.count,
@@ -150,29 +156,42 @@ class Timer(Histogram):
 class Registry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
+        # optional one-line descriptions registered alongside a metric;
+        # the Prometheus exposition renders them as # HELP lines
+        self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
 
-    def register(self, name: str, metric) -> object:
+    def register(self, name: str, metric,
+                 description: Optional[str] = None) -> object:
         with self._lock:
             if name in self._metrics:
                 raise ValueError(f"metric {name!r} already registered")
             self._metrics[name] = metric
+            if description:
+                self._help[name] = description
         return metric
 
     def get(self, name: str):
         return self._metrics.get(name)
 
-    def get_or_register(self, name: str, factory: Callable):
+    def description(self, name: str) -> Optional[str]:
+        return self._help.get(name)
+
+    def get_or_register(self, name: str, factory: Callable,
+                        description: Optional[str] = None):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = factory()
                 self._metrics[name] = m
+            if description and name not in self._help:
+                self._help[name] = description
             return m
 
     def unregister(self, name: str) -> None:
         with self._lock:
             self._metrics.pop(name, None)
+            self._help.pop(name, None)
 
     def each(self):
         with self._lock:
@@ -186,5 +205,7 @@ default_registry = Registry()
 
 
 def get_or_register(name: str, factory: Callable,
-                    registry: Optional[Registry] = None):
-    return (registry or default_registry).get_or_register(name, factory)
+                    registry: Optional[Registry] = None,
+                    description: Optional[str] = None):
+    return (registry or default_registry).get_or_register(
+        name, factory, description)

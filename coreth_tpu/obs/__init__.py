@@ -1,0 +1,28 @@
+"""Observability: end-to-end span tracing, Perfetto export, telemetry.
+
+Level-0 leaf beside ``metrics``/``faults`` in layers.toml: every layer
+from the serve pipeline down to the device dispatch seams threads its
+timing evidence through it, so it imports nothing of the tree above
+(metrics and faults are same-level peers).
+
+- ``obs.trace`` — the span tracer: ``span()``/``instant()`` with ONE
+  module-global None check when disabled (CORETH_TRACE=0, the default),
+  per-block :class:`BlockTrace` contexts whose stage intervals become
+  ``StreamReport.stage_breakdown``, a bounded ring, and Chrome
+  trace-event / Perfetto JSON export (CORETH_TRACE_OUT).
+- ``obs.server`` — the zero-dependency live telemetry endpoint
+  (CORETH_TELEMETRY_PORT): /metrics, /trace, /report.
+"""
+
+from coreth_tpu.obs.trace import (
+    PT_EXPORT_FAIL, BlockTrace, EventRing, SpanTracer,
+    StageAccumulator, arm_from_env, block_begin, enabled, install,
+    instant, jax_span, span, tracer, uninstall, write_out,
+)
+
+__all__ = [
+    "PT_EXPORT_FAIL", "BlockTrace", "EventRing", "SpanTracer",
+    "StageAccumulator", "arm_from_env", "block_begin", "enabled",
+    "install", "instant", "jax_span", "span", "tracer", "uninstall",
+    "write_out",
+]

@@ -1,0 +1,130 @@
+"""Zero-dependency live telemetry endpoint (stdlib ``http.server``).
+
+A long streaming run used to be a black box until ``run()`` returned;
+this server makes it inspectable WHILE it runs:
+
+- ``/metrics`` — the metrics registry's Prometheus text exposition
+  (the existing ``render_prometheus``), scrapeable by anything that
+  speaks the format;
+- ``/trace``   — the active span tracer's ring as Chrome trace-event /
+  Perfetto JSON (load it straight into ui.perfetto.dev);
+- ``/report``  — the live report dict the owner registered (the
+  streaming pipeline's in-flight ``StreamReport``).
+
+Opt-in: ``CORETH_TELEMETRY_PORT=<port>`` (``0`` picks an ephemeral
+port); the streaming pipeline starts one around ``run()`` and stops it
+in the same ``finally`` that closes the checkpoint exporter, so an
+error path cannot leak the listener thread.  Binds 127.0.0.1 only —
+this is an operator diagnostic, not a public surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from coreth_tpu.metrics import render_prometheus
+from coreth_tpu.obs import trace as _trace
+
+
+class TelemetryServer:
+    """One HTTP listener serving /metrics, /trace, and /report."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry=None,
+                 report: Optional[Callable[[], dict]] = None):
+        self.registry = registry
+        self.report = report
+        self._host = host
+        self._want_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    # ------------------------------------------------------------ routes
+    def _route(self, path: str):
+        path = path.split("?", 1)[0]
+        if path == "/metrics":
+            return (render_prometheus(self.registry),
+                    "text/plain; version=0.0.4")
+        if path == "/trace":
+            t = _trace.TRACER
+            doc = t.export() if t is not None else {"traceEvents": []}
+            # default=str for the same reason as write_out: span args
+            # are an open **kwargs surface
+            return json.dumps(doc, default=str), "application/json"
+        if path == "/report":
+            rep = self.report() if self.report is not None else {}
+            # default=str: report dicts may carry bytes-ish oddities
+            # from future fields; the endpoint must render regardless
+            return json.dumps(rep, default=str), "application/json"
+        raise KeyError(path)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: no per-scrape spam
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = outer._route(self.path)
+                except KeyError:
+                    self.send_error(404)
+                    return
+                except Exception as exc:  # noqa: BLE001 — a render bug must 500 the scrape, never kill the listener thread
+                    self.send_error(500, str(exc))
+                    return
+                data = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._want_port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-telemetry",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+def maybe_start_from_env(registry=None,
+                         report: Optional[Callable[[], dict]] = None
+                         ) -> Optional[TelemetryServer]:
+    """Start a TelemetryServer iff CORETH_TELEMETRY_PORT is set (0 =
+    ephemeral); returns it started, or None when the knob is absent."""
+    raw = os.environ.get("CORETH_TELEMETRY_PORT")
+    if raw is None or raw == "":
+        return None
+    srv = TelemetryServer(port=int(raw), registry=registry,
+                          report=report)
+    try:
+        srv.start()
+    except OSError as exc:
+        # a bind failure (EADDRINUSE: two pipelines sharing one fixed
+        # port — use 0/ephemeral for that) must degrade to "no
+        # endpoint", never kill the stream before its first block
+        import sys
+        print(f"coreth obs: telemetry endpoint disabled "
+              f"(bind {raw}: {exc})", file=sys.stderr)
+        return None
+    return srv

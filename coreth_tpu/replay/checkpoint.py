@@ -34,7 +34,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from coreth_tpu import faults
+from coreth_tpu import faults, obs
 from coreth_tpu.rawdb import schema
 from coreth_tpu.types.block import Header
 
@@ -173,6 +173,7 @@ class CheckpointManager:
         t0 = time.monotonic_ns()
         gen = self.engine.flat.mark_checkpoint()
         self.stamp_ns += time.monotonic_ns() - t0
+        obs.instant("checkpoint/stamp", stamped=gen is not None)
         return gen is not None
 
     def drain(self, timeout_s: int = 120) -> None:
@@ -201,7 +202,8 @@ class CheckpointManager:
             return load_checkpoint(self.kv)
         t0 = time.monotonic_ns()
         try:
-            return self._write_sync()
+            with obs.span("checkpoint/write_sync"):
+                return self._write_sync()
         finally:
             self.write_ns += time.monotonic_ns() - t0
 

@@ -36,7 +36,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
-from coreth_tpu import faults, rlp
+from coreth_tpu import faults, obs, rlp
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.mpt.rehash import device_rehash
 from coreth_tpu.state.flat import DELETED as FLAT_DELETED
@@ -177,9 +177,13 @@ class CommitPipeline:
         """Fold the staged window (storage first — the account fold
         consumes the fresh storage roots — then accounts), verify the
         root against the last staged header, advance engine.root."""
-        e = self.e
         if not self.staged_blocks:
-            return e.root
+            return self.e.root
+        with obs.span("commit/flush", blocks=self.staged_blocks):
+            return self._flush()
+
+    def _flush(self) -> bytes:
+        e = self.e
         from coreth_tpu.replay.engine import ReplayError
         sup = getattr(e, "supervisor", None)
         if sup is not None:

@@ -38,7 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from coreth_tpu import faults
+from coreth_tpu import faults, obs
 from coreth_tpu.consensus.engine import DummyEngine
 from coreth_tpu.ops import u256
 from coreth_tpu.params import ChainConfig
@@ -592,6 +592,7 @@ class _SenderPipeline:
 
     def _issue(self, s: int) -> None:
         eng = self.engine
+        obs.instant("replay/sender_issue", seg=s)
         t0 = time.monotonic()
         h = {"todo": [], "kind": "empty"}
         try:
@@ -767,7 +768,9 @@ class ReplayEngine:
         # fault supervision: retry/demote/probe over the execution
         # ladder (replay/supervisor.py); CORETH_FAULT_PLAN arms the
         # injection registry for this process if nothing armed it yet
+        # (CORETH_TRACE=1 likewise installs the span tracer)
         faults.arm_from_env()
+        obs.arm_from_env()
         from coreth_tpu.replay.supervisor import BackendSupervisor
         self.supervisor = BackendSupervisor(self)
         # the hostexec bridge consults the newest engine's supervisor
@@ -924,6 +927,10 @@ class ReplayEngine:
         overlap segmented recovery with window execution."""
         if isinstance(blocks, Block):
             blocks = [blocks]
+        with obs.span("replay/sender_recover", blocks=len(blocks)):
+            self._warm_senders_run(blocks)
+
+    def _warm_senders_run(self, blocks) -> None:
         t0 = time.monotonic()
         todo, hashes, rs, ss, recids = self._pack_sigs(blocks)
         if not todo:
@@ -1340,10 +1347,11 @@ class ReplayEngine:
         prev = (self.state.balances, self.state.nonces,
                 self.state.slot_vals)
         perm = interleave_txs(txds.shape[1], self._n_shards)
-        new_bal, new_non, new_sv, fetches = self._mesh_window(
-            prev[0], prev[1], prev[2], jnp.asarray(acct_rows),
-            jnp.asarray(slot_rows), jnp.asarray(txds[:, perm]),
-            jnp.asarray(t_idxs), jnp.asarray(s_idxs))
+        with obs.jax_span("coreth/transfer_window"):
+            new_bal, new_non, new_sv, fetches = self._mesh_window(
+                prev[0], prev[1], prev[2], jnp.asarray(acct_rows),
+                jnp.asarray(slot_rows), jnp.asarray(txds[:, perm]),
+                jnp.asarray(t_idxs), jnp.asarray(s_idxs))
         self.state.balances = new_bal
         self.state.nonces = new_non
         self.state.slot_vals = new_sv
@@ -1364,8 +1372,9 @@ class ReplayEngine:
         backoff, persistent ones strike toward device demotion and
         surface as BackendFault (replay()/_drive route the run through
         the exact host path).  The injected seam is PT_DISPATCH."""
-        return self.supervisor.run("device", PT_DISPATCH,
-                                   self._issue_window_run, items)
+        with obs.span("replay/issue_window", blocks=len(items)):
+            return self.supervisor.run("device", PT_DISPATCH,
+                                       self._issue_window_run, items)
 
     def _issue_window_run(self, items: List[Tuple[Block, dict]]) -> dict:
         """One device call for a whole run of transfer blocks: upload the
@@ -1388,8 +1397,12 @@ class ReplayEngine:
             # the inputs here lets the scan start while the host
             # validates the previous window
             jax.block_until_ready(ups)
-        new_bal, new_non, new_sv, fetches = _transfer_window(
-            prev[0], prev[1], prev[2], *ups)
+        # annotation on the dispatch itself (not the supervised wrapper
+        # above): retries/backoff and host packing must not read as
+        # device time in a captured jax profile
+        with obs.jax_span("coreth/transfer_window"):
+            new_bal, new_non, new_sv, fetches = _transfer_window(
+                prev[0], prev[1], prev[2], *ups)
         self.state.balances = new_bal
         self.state.nonces = new_non
         self.state.slot_vals = new_sv
@@ -1434,6 +1447,12 @@ class ReplayEngine:
         """Validate a window from its fetched tensors.  Returns None on
         full success, else the index (into ``blocks``) to resume from
         after the rewind+fallback recovery."""
+        with obs.span("replay/complete_window",
+                      blocks=len(win["items"])):
+            return self._complete_window_run(win, blocks, start_idx)
+
+    def _complete_window_run(self, win: dict, blocks: List[Block],
+                             start_idx: int) -> Optional[int]:
         t0 = time.monotonic()
         arr = np.asarray(win["fetches"])  # ONE device read per window
         self.stats.t_device += time.monotonic() - t0
@@ -1926,6 +1945,12 @@ class ReplayEngine:
         the quarantine mode: consensus mismatches are appended to
         ``reasons`` instead of raised and the computed state still
         commits (see quarantine_block)."""
+        with obs.span("replay/host_fallback", number=block.number,
+                      strict=strict):
+            return self._fallback_run(block, strict, reasons)
+
+    def _fallback_run(self, block: Block, strict: bool,
+                      reasons: Optional[List[str]]) -> bytes:
         self.commit_pipe.flush()  # staged windows precede this block
         prev_root = self.root
         prev_header = self.parent_header

@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from coreth_tpu import obs
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as DT
 from coreth_tpu.evm.device.adapter import (
@@ -728,6 +729,10 @@ class MachineBlockExecutor:
         legacy per-block path; the run then stops so the engine can
         re-classify against the repaired state.
         """
+        with obs.span("machine/execute_run", blocks=len(items)):
+            return self._execute_run(items)
+
+    def _execute_run(self, items) -> int:
         e = self.e
         # serial-block short-circuit: provably-serial blocks skip the
         # device entirely (before ANY round is dispatched) and run on
@@ -736,7 +741,8 @@ class MachineBlockExecutor:
             k = 1
             while k < len(items) and self._serial_eligible(items[k][1]):
                 k += 1
-            return self._execute_serial_run(items[:k])
+            with obs.span("machine/serial_run", blocks=k):
+                return self._execute_serial_run(items[:k])
         # ... and a serial block mid-run ends this window batch so the
         # NEXT execute_run call gives it the short-circuit
         for n in range(1, len(items)):
@@ -752,8 +758,13 @@ class MachineBlockExecutor:
         t0 = time.monotonic()
         # the FIRST dispatch propagates failures: nothing is staged
         # yet, so the supervisor wrapping this call (engine
-        # _machine_run) can safely retry or strike toward demotion
-        inflight = runner.issue(self._window_items(chunks[0]))
+        # _machine_run) can safely retry or strike toward demotion.
+        # (No jax_span here: the tighter annotation around the kernel
+        # call itself lives in adapter/shard issue(), with the right
+        # per-runner label — an outer one would double-label it and
+        # sweep host-side packing under "device" time.)
+        with obs.span("machine/window_issue", blocks=len(chunks[0])):
+            inflight = runner.issue(self._window_items(chunks[0]))
         e.stats.t_device += time.monotonic() - t0
         from coreth_tpu.consensus.engine import ConsensusError
         from coreth_tpu.replay.engine import ReplayError
@@ -801,7 +812,9 @@ class MachineBlockExecutor:
                     early = runner.issue(next_items)
                 e.stats.t_device += time.monotonic() - t0
             t0 = time.monotonic()
-            wres = runner.complete(inflight)
+            with obs.span("machine/window_complete",
+                          blocks=len(chunk)):
+                wres = runner.complete(inflight)
             e.stats.t_device += time.monotonic() - t0
             inflight = None
             self.windows += 1
@@ -864,6 +877,7 @@ class MachineBlockExecutor:
                 # path and hand the rest back for re-classification
                 # (execute() flushes the staged clean prefix first)
                 self.dirty_blocks += 1
+                obs.instant("machine/dirty_block", number=block.number)
                 runner.invalidate()
                 root = self.execute(block, plans)
                 if root is None:

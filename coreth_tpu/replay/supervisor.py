@@ -34,6 +34,8 @@ import os
 import time
 from typing import Dict, Optional
 
+from coreth_tpu import obs
+
 
 class BackendFault(Exception):
     """A supervised call failed past its retry budget; the caller must
@@ -99,6 +101,16 @@ class BackendSupervisor:
         self._first_strike_t: Dict[str, Optional[float]] = {
             s: None for s in self.SCOPES}
         self.demote_latency_s: Dict[str, float] = {}
+        # the newest ladder transition (demote / probe_failed /
+        # promote), timestamped on the injected clock — surfaced in
+        # StreamReport.supervisor and mirrored into the obs event
+        # stream so the Perfetto timeline shows WHEN routing flipped
+        self.last_transition: Optional[dict] = None
+
+    def _transition(self, kind: str, scope: str) -> None:
+        self.last_transition = {"kind": kind, "scope": scope,
+                                "at_s": round(self._clock(), 3)}
+        obs.instant(f"supervisor/{kind}", scope=scope)
 
     # ------------------------------------------------------------ routing
     def allows(self, scope: str) -> bool:
@@ -125,6 +137,7 @@ class BackendSupervisor:
             st["demoted"] = False
             st["cooldown"] = None
             self.promotions += 1
+            self._transition("promote", scope)
 
     def strike(self, scope: str, exc: BaseException,
                hard: bool = False) -> None:
@@ -144,12 +157,14 @@ class BackendSupervisor:
                     self.cooldown * self.COOLDOWN_CAP)
                 st["until"] = now + st["cooldown"]
                 self.demotions += 1
+                self._transition("probe_failed", scope)
             return
         st["strikes"] += 1
         if hard or st["strikes"] >= self.strikes_to_demote:
             st["demoted"] = True
             st["until"] = now + (st["cooldown"] or self.cooldown)
             self.demotions += 1
+            self._transition("demote", scope)
             first = self._first_strike_t[scope]
             if first is not None:
                 self.demote_latency_s[scope] = round(now - first, 4)
@@ -250,6 +265,7 @@ class BackendSupervisor:
             "demoted_scopes": sorted(
                 s for s in self.SCOPES if self._state[s]["demoted"]),
             "demote_latency_s": dict(self.demote_latency_s),
+            "last_transition": self.last_transition,
         }
 
     def publish(self, registry=None) -> None:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional
 
+from coreth_tpu import obs
 from coreth_tpu.types import Block
 
 
@@ -76,6 +77,7 @@ class ChainFeed(BlockFeed):
                 if wait > 0:
                     self._sleep(wait)
                 if self._clock() < ready_at:
+                    obs.instant("feed/paced_stall", block=self._i)
                     return None  # still pacing: report a stall
         b = self.blocks[self._i]
         self._i += 1
@@ -117,7 +119,8 @@ class MempoolFeed(BlockFeed):
             # feed thread doesn't busy-spin against an empty pool
             time.sleep(timeout)
             return None
-        block = self.miner.generate_block()
+        with obs.span("feed/build_block"):
+            block = self.miner.generate_block()
         if not block.transactions:
             # nothing executable made it in (all pending underpriced
             # against the new base fee, say) — a stall, not the end

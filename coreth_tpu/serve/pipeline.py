@@ -42,7 +42,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from coreth_tpu import faults
+from coreth_tpu import faults, obs
 from coreth_tpu.metrics import Counter, Gauge, Histogram, Meter, \
     get_or_register
 from coreth_tpu.serve.feed import BlockFeed, FeedExhausted
@@ -76,6 +76,10 @@ def _corrupt_block(b: Block) -> Block:
 class _Item:
     block: Block
     t_enqueue: float
+    # per-block trace context (obs.BlockTrace; None when tracing off):
+    # rides the block through every stage, so the committed report can
+    # attribute its enqueue->committed latency stage by stage
+    bt: object = None
 
 
 @dataclass
@@ -105,6 +109,10 @@ class StreamReport:
     # flat-state layer surface (state/flat): read hit/miss counters,
     # generation/rollback counts (empty when CORETH_FLAT=0)
     flat: dict = field(default_factory=dict)
+    # per-stage SHARE of total enqueue->committed time across every
+    # committed block (obs tracer; {} when CORETH_TRACE=0): queue_feed
+    # / prefetch / queue_exec / execute / commit sum to ~1.0
+    stage_breakdown: dict = field(default_factory=dict)
 
     def row(self) -> dict:
         return dict(self.__dict__)
@@ -132,6 +140,7 @@ class StreamingPipeline:
                  quarantine_limit: int = 8,
                  checkpoint_every: Optional[int] = None):
         faults.arm_from_env()  # CORETH_FAULT_PLAN (idempotent)
+        obs.arm_from_env()     # CORETH_TRACE=1 (idempotent)
         self.engine = engine
         self.feed = feed
         self.depth = depth or 2 * engine.window
@@ -176,7 +185,18 @@ class StreamingPipeline:
         self._feed_blocked_s = 0.0
         self._prefetch_blocked_s = 0.0
         self._t_commit = 0.0
+        # commit time already attributed to committed blocks' traces
+        # (the delta since the last _mark_committed amortizes over
+        # that batch of blocks)
+        self._t_commit_attr = 0.0
         self._commit_flushes = 0
+        # live telemetry endpoint (obs/server.py): started by run()
+        # when CORETH_TELEMETRY_PORT is set, stopped in its finally
+        self._telemetry = None
+        # THIS run's stage-attribution sink (lazily created when
+        # tracing is on): per-pipeline, so concurrent or back-to-back
+        # runs sharing the process-global tracer never blend
+        self._stages = None
         self._prefetch_hits = 0
         self._errors: List[BaseException] = []
         # quarantined Block objects, parallel to stats.quarantined
@@ -221,6 +241,14 @@ class StreamingPipeline:
                 if faults.check(PT_MALFORMED) is not None:
                     b = _corrupt_block(b)
                 it = _Item(block=b, t_enqueue=time.monotonic())
+                # trace context rides the block from here to commit,
+                # folding into THIS run's stage sink (one-None-check
+                # no-op when tracing is off)
+                if obs.enabled():
+                    if self._stages is None:
+                        self._stages = obs.StageAccumulator()
+                    it.bt = obs.block_begin(b.number, it.t_enqueue,
+                                            sink=self._stages)
                 if self._t_first_enqueue is None:
                     self._t_first_enqueue = it.t_enqueue
                 # the bounded put IS the backpressure: when the
@@ -258,7 +286,15 @@ class StreamingPipeline:
                         chunk.append(self._q_feed.get_nowait())
                     except queue.Empty:
                         break
+                t_pf = time.monotonic()
                 self.prefetcher.warm([c.block for c in chunk])
+                if obs.enabled():
+                    # chunk warm cost amortizes per block; t_pf marks
+                    # the end of each block's feed-queue wait
+                    share = (time.monotonic() - t_pf) / len(chunk)
+                    for c in chunk:
+                        if c.bt is not None:
+                            c.bt.prefetched(t_pf, share)
                 for c in chunk:
                     blocked = self._put(self._q_exec, c)
                     if blocked < 0:
@@ -290,6 +326,16 @@ class StreamingPipeline:
 
     def _mark_committed(self, items: List[_Item]) -> None:
         now = time.monotonic()
+        if items and obs.enabled():
+            # the commit-flush time since the last committed batch
+            # belongs to exactly these blocks' windows; amortize it
+            # per block so each trace's stage sum stays exact
+            delta = self._t_commit - self._t_commit_attr
+            self._t_commit_attr = self._t_commit
+            share = delta / len(items)
+            for it in items:
+                if it.bt is not None:
+                    it.bt.finish(now, commit_s=share)
         for it in items:
             self._latency.update(now - it.t_enqueue)
             self._tx_meter.mark(len(it.block.transactions))
@@ -383,6 +429,8 @@ class StreamingPipeline:
             self._prefetch_hits += sum(
                 1 for tx in it.block.transactions
                 if tx.cached_sender() is not None)
+            if it.bt is not None:
+                it.bt.exec_start()
             return it
 
     def _eos(self) -> bool:
@@ -508,41 +556,76 @@ class StreamingPipeline:
         the SLO report.  The engine ends on the same root batch replay
         would produce for the blocks that were committed."""
         t_start = time.monotonic()
-        restore = self._wrap_commit()
-        feed_t = threading.Thread(target=self._feed_loop,
-                                  name="serve-feed", daemon=True)
-        pre_t = threading.Thread(target=self._prefetch_loop,
-                                 name="serve-prefetch", daemon=True)
-        feed_t.start()
-        pre_t.start()
+        # live inspection while the stream runs: /metrics (Prometheus),
+        # /trace (Perfetto JSON), /report (this run's live report) —
+        # opt-in via CORETH_TELEMETRY_PORT (obs/server.py).  The stop
+        # lives in the OUTERMOST finally, immediately below the start:
+        # no failure after this point may leak the listener thread.
+        from coreth_tpu.obs.server import maybe_start_from_env
+        self._telemetry = maybe_start_from_env(
+            registry=self._registry, report=self._live_report)
         try:
+            restore = self._wrap_commit()
+            feed_t = threading.Thread(target=self._feed_loop,
+                                      name="serve-feed", daemon=True)
+            pre_t = threading.Thread(target=self._prefetch_loop,
+                                     name="serve-prefetch", daemon=True)
+            feed_t.start()
+            pre_t.start()
             try:
-                self._drive()
+                try:
+                    self._drive()
+                finally:
+                    self._stop.set()
+                    feed_t.join(timeout=10)
+                    pre_t.join(timeout=10)
+                    # anything still staged belongs to completed blocks
+                    self.engine.commit_pipe.flush()
+                    restore()
+                if self._errors:
+                    raise self._errors[0]
+                if self._ckpt is not None and self.stats.blocks:
+                    # final checkpoint: the whole committed stream is
+                    # durable, a restart resumes at the exact tail.  In
+                    # background mode write() stamps the tip and DRAINS
+                    # the flat exporter — the one synchronous wait, at
+                    # shutdown, not per interval.
+                    self._ckpt.write()
             finally:
-                self._stop.set()
-                feed_t.join(timeout=10)
-                pre_t.join(timeout=10)
-                # anything still staged belongs to completed blocks
-                self.engine.commit_pipe.flush()
-                restore()
-            if self._errors:
-                raise self._errors[0]
-            if self._ckpt is not None and self.stats.blocks:
-                # final checkpoint: the whole committed stream is
-                # durable, a restart resumes at the exact tail.  In
-                # background mode write() stamps the tip and DRAINS
-                # the flat exporter — the one synchronous wait, at
-                # shutdown, not per interval.
-                self._ckpt.write()
+                if self._ckpt is not None:
+                    # ALWAYS stop the exporter thread — an error path
+                    # that skipped it would leak one polling thread per
+                    # failed run
+                    self._ckpt.close()
         finally:
-            if self._ckpt is not None:
-                # ALWAYS stop the exporter thread — an error path that
-                # skipped it would leak one polling thread per failed
-                # run
-                self._ckpt.close()
+            if self._telemetry is not None:
+                # same argument for the telemetry listener thread
+                self._telemetry.stop()
+                self._telemetry = None
+            # CORETH_TRACE_OUT: flush the ring to a Perfetto-loadable
+            # file (failures counted, never raised — obs/export_fail)
+            obs.write_out()
         wall = time.monotonic() - t_start
         self._publish(wall)
         return self.stats
+
+    def _live_report(self) -> dict:
+        """The /report payload while the stream runs: the report row
+        with the CURRENT latency histogram and stage attribution
+        spliced in (the final _publish numbers are richer; this is the
+        mid-run view)."""
+        row = self.stats.row()
+        snap = self._latency.snapshot()
+        row["latency_ms"] = {
+            "p50": round(1000 * snap["p50"], 3),
+            "p99": round(1000 * snap["p99"], 3),
+            "max": round(1000 * snap["max"], 3),
+        }
+        if self._stages is not None:
+            row["stage_breakdown"] = self._stages.breakdown()
+        row["committed_blocks"] = self._committed_blocks
+        row["enqueued_blocks"] = self._enqueued
+        return row
 
     def rollback_quarantined(self) -> dict:
         """Reorg primitive: pop the NEWEST quarantined block (its
@@ -618,6 +701,11 @@ class StreamingPipeline:
         flat = getattr(self.engine, "flat", None)
         if flat is not None:
             s.flat = flat.snapshot()
+        if self._stages is not None:
+            # per-stage share of enqueue->committed time (sums to ~1.0
+            # across queue_feed/prefetch/queue_exec/execute/commit) —
+            # THIS run's sink, not the process-global tracer's
+            s.stage_breakdown = self._stages.breakdown()
         s.faults = faults.fired()
         # SLO surface in the metrics registry (scrapeable next to the
         # engine's replay/* gauges)

@@ -34,6 +34,7 @@ import os
 import time
 from typing import List
 
+from coreth_tpu import obs
 from coreth_tpu.types import Block
 
 
@@ -49,13 +50,14 @@ class Prefetcher:
 
     def warm(self, blocks: List[Block]) -> None:
         t0 = time.monotonic()
-        todo = sum(1 for b in blocks for tx in b.transactions
-                   if tx.cached_sender() is None)
-        if todo:
-            if not self._shard_recover(blocks):
-                self.e.warm_senders(blocks)
-            self.sigs += todo
-        self._touch_code(blocks)
+        with obs.span("serve/prefetch_warm", blocks=len(blocks)):
+            todo = sum(1 for b in blocks for tx in b.transactions
+                       if tx.cached_sender() is None)
+            if todo:
+                if not self._shard_recover(blocks):
+                    self.e.warm_senders(blocks)
+                self.sigs += todo
+            self._touch_code(blocks)
         self.busy_s += time.monotonic() - t0
 
     def _shard_recover(self, blocks: List[Block]) -> bool:

@@ -36,7 +36,7 @@ import threading
 import time  # noqa: DET003 — host-side export-thread waits/instrumentation, never consensus data
 from typing import Dict, Optional
 
-from coreth_tpu import faults
+from coreth_tpu import faults, obs
 from coreth_tpu.mpt import EMPTY_ROOT
 from coreth_tpu.rawdb import schema
 from coreth_tpu.state.flat.store import (
@@ -234,17 +234,21 @@ class FlatExporter:
                 self.on_record(gen)
 
     def _export(self, gen: FlatGeneration) -> None:
-        self._apply(gen)
-        for attempt in range(self.DURABLE_RETRIES):
-            try:
-                self._durable(gen)
-                break
-            except faults.FaultInjected:
-                if attempt == self.DURABLE_RETRIES - 1:
-                    raise
-                continue
-        self.flat.mark_exported(gen)
-        self.exports += 1
+        # flow id = block number: the block's trace arrow continues
+        # from the execute thread onto this worker's timeline row
+        with obs.span("flat/export", flow=gen.number,
+                      checkpoint=bool(gen.checkpoint)):
+            self._apply(gen)
+            for attempt in range(self.DURABLE_RETRIES):
+                try:
+                    self._durable(gen)
+                    break
+                except faults.FaultInjected:
+                    if attempt == self.DURABLE_RETRIES - 1:
+                        raise
+                    continue
+            self.flat.mark_exported(gen)
+            self.exports += 1
 
     # ------------------------------------------------------------ report
     def snapshot(self) -> dict:

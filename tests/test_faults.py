@@ -113,6 +113,7 @@ def test_declared_points_all_covered():
     import coreth_tpu.evm.device.shard  # noqa: F401
     import coreth_tpu.evm.hostexec.backend  # noqa: F401
     import coreth_tpu.evm.hostexec.bridge  # noqa: F401
+    import coreth_tpu.obs.trace  # noqa: F401
     import coreth_tpu.replay.checkpoint  # noqa: F401
     import coreth_tpu.replay.commit  # noqa: F401
     import coreth_tpu.replay.engine  # noqa: F401
@@ -144,6 +145,8 @@ def test_declared_points_all_covered():
             "test_torn_flat_write_persistent_keeps_previous)",
         "flat/stale_generation":
             "test_flat_state::test_stale_generation_handout_skipped",
+        "obs/export_fail":
+            "test_obs::test_export_fail_fault_counted_pipeline_unharmed",
     }
     declared = set(faults.declared())
     covered = set(COVERAGE)
