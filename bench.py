@@ -619,7 +619,14 @@ def run_tpu(genesis, wire_blocks, txs_per_block, machine_stats=None):
                 premap_hit_rate=round(
                     mc["premap_hits"]
                     / max(1, mc["premap_predicted"]), 3),
+                premap_array=mc["premap_array"],
                 kernel_retraces=mc["kernel_retraces"],
+                # per-contract traced specialization (ISSUE 13): how
+                # many lanes ran straight-line sub-programs vs the
+                # generic interpreter escape hatch
+                lanes_specialized=mc["lanes_specialized"],
+                specialize_escapes=mc["specialize_escapes"],
+                programs_traced=mc["programs_traced"],
                 # which executor served host-side txs: native_txs ran
                 # on the compiled backend (evm/hostexec — serial
                 # short-circuit blocks + natively-served conflict
@@ -727,6 +734,90 @@ def run_workload(workload, baseline_blocks, tpu_blocks=None,
         print(f"[{workload}] tpu", [round(x) for x in tpu_runs], "txs/s",
               tpu_stats, file=sys.stderr)
     return base_runs, tpu_runs, native_runs
+
+
+def run_specialize():
+    """Specialization section (ISSUE 13 / ROADMAP direction 1): the
+    erc20-machine path replayed with CORETH_SPECIALIZE=1 and =0, each
+    under an installed tracer, so the before/after is ATTRIBUTED — the
+    dispatch (machine/window_issue), fetch (machine/window_complete)
+    and fold (commit/flush) span shares of replay wall time — instead
+    of argued from aggregate txs/s.  The regression signal is the
+    spec/generic RATIO (the bench-drift rule: ratios, never absolute
+    txs/s); the tentpole acceptance gate (erc20-machine >= 1x the
+    native sequential engine) is recorded next to it in main()."""
+    from coreth_tpu import obs
+    from coreth_tpu.evm.census import jump_profile
+    from coreth_tpu.types import Block
+    from coreth_tpu.workloads.erc20 import TOKEN_RUNTIME
+    genesis, blocks = build_or_load_chain("erc20")
+    n = min(len(blocks), MACHINE_BLOCKS)
+    wire = [b.encode() for b in blocks[:n]]
+    # static eligibility profile of the hot contract: how much of its
+    # jump structure is the direct-push idiom the tracer resolves
+    jumps, push_jumps = jump_profile(TOKEN_RUNTIME)
+    out = {"blocks": n,
+           "eligibility": {"jumps": jumps, "push_jumps": push_jumps}}
+    os.environ["CORETH_NO_TOKEN_FASTPATH"] = "1"
+    prev_env = os.environ.pop("CORETH_TRACE", None)
+    try:
+        for label, spec in (("specialized", "1"), ("generic", "0")):
+            os.environ["CORETH_SPECIALIZE"] = spec
+            # warm rep: each side owns distinct kernel buckets (the
+            # program set is part of the kernel key), so compiles must
+            # not skew the A/B
+            warm = [Block.decode(w) for w in wire]
+            engine = _fresh_engine(genesis, ERC20_TXS)
+            engine.replay_block(warm[0])
+            engine.replay(warm[1:])
+            assert engine.root == warm[-1].header.root
+            tracer = obs.install()
+            try:
+                fresh = [Block.decode(w) for w in wire]
+                engine = _fresh_engine(genesis, ERC20_TXS)
+                engine.replay_block(fresh[0])
+                t0 = time.monotonic()
+                engine.replay(fresh[1:])
+                dt = time.monotonic() - t0
+            finally:
+                obs.uninstall()
+            assert engine.root == fresh[-1].header.root
+            txs = sum(len(b.transactions) for b in fresh[1:])
+            mc = engine._machine.machine_counters()
+            sums = {}
+            for ev in tracer.export()["traceEvents"]:
+                if ev.get("ph") == "X":
+                    sums[ev["name"]] = sums.get(ev["name"], 0.0) \
+                        + float(ev.get("dur", 0.0))
+            total = max(dt * 1e6, 1e-9)
+            out[label] = {
+                "txs_s": round(txs / dt, 1),
+                "lanes_specialized": mc["lanes_specialized"],
+                "specialize_escapes": mc["specialize_escapes"],
+                "programs_traced": mc["programs_traced"],
+                "kernel_retraces": mc["kernel_retraces"],
+                "shares": {
+                    "dispatch": round(
+                        sums.get("machine/window_issue", 0) / total, 3),
+                    "fetch": round(
+                        sums.get("machine/window_complete", 0) / total,
+                        3),
+                    "fold": round(
+                        sums.get("commit/flush", 0) / total, 3),
+                },
+            }
+            if _deadline_tight():
+                break
+    finally:
+        os.environ.pop("CORETH_SPECIALIZE", None)
+        del os.environ["CORETH_NO_TOKEN_FASTPATH"]
+        if prev_env is not None:
+            os.environ["CORETH_TRACE"] = prev_env
+    if "specialized" in out and "generic" in out:
+        out["spec_vs_generic"] = round(
+            out["specialized"]["txs_s"]
+            / max(out["generic"]["txs_s"], 1e-9), 3)
+    return out
 
 
 def run_mixed():
@@ -1208,7 +1299,7 @@ def main():
         else:
             skipped.append("erc20")
 
-        _begin_section(0.60)
+        _begin_section(0.63)
         if _remaining() > 45:
             # the SAME erc20 chain forced through the general step
             # machine (no fast-path classification): config[1] through
@@ -1220,18 +1311,30 @@ def main():
                 tpu_blocks=MACHINE_BLOCKS,
                 machine_stats=mstats, skip_baselines=True)
             del os.environ["CORETH_NO_TOKEN_FASTPATH"]
+            emv = (round(_median(erc20m_tpu) / erc20_native_tps, 3)
+                   if erc20_native_tps else None)
             result.update({
                 "erc20_machine_txs_s": round(_median(erc20m_tpu), 1),
-                "erc20_machine_vs_native": (
-                    round(_median(erc20m_tpu) / erc20_native_tps, 3)
-                    if erc20_native_tps else None),
+                "erc20_machine_vs_native": emv,
+                # THE tentpole acceptance gate (ISSUE 13 / ROADMAP
+                # direction 1): the fused OCC path with per-contract
+                # specialization must be at least the native
+                # sequential engine on the same chain (a RATIO per
+                # the bench-drift rule)
+                "erc20_machine_vs_native_ok": (
+                    emv is not None and emv >= 1.0),
                 "erc20_machine_stats": mstats,
             })
             _section_done("erc20_machine")
+            if not _deadline_tight(margin=60.0):
+                # specialization A/B with traced dispatch/fetch/fold
+                # attribution (the CORETH_SPECIALIZE=0|1 before/after)
+                result["specialize"] = run_specialize()
+                _section_done("specialize")
         else:
             skipped.append("erc20_machine")
 
-        _begin_section(0.72)
+        _begin_section(0.74)
         if _remaining() > 45:
             # contention workload (config[3]): fully serial conflict
             # chains — the OCC rounds now run INSIDE one dispatch per

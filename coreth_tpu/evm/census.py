@@ -42,6 +42,39 @@ def opcode_census(code: bytes) -> Dict[int, int]:
     return counts
 
 
+def trace_precheck(code: bytes, allowed) -> Tuple[bool, str]:
+    """Cheap static pre-filter for the per-contract specializer
+    (evm/device/specialize.py): is every EXECUTED-position opcode of
+    `code` inside the specializer's traced subset?  A rejection here
+    skips the (more expensive) symbolic walk entirely; a pass only
+    means the walk is worth attempting — the walk itself still rejects
+    unresolvable jump structure, symbolic memory offsets, and budget
+    blow-ups.  Uses the shared census so the specializer's eligibility
+    question sees the exact opcode multiset every other backend sees.
+    """
+    for op in sorted(opcode_census(code)):
+        if op not in allowed:
+            return False, f"untraced opcode 0x{op:02x}"
+    return True, ""
+
+
+def jump_profile(code: bytes) -> Tuple[int, int]:
+    """(total JUMP/JUMPI count, count immediately preceded by a PUSH)
+    over executed positions — the direct-push jump idiom the trace
+    specializer resolves statically.  Diagnostic (bench/eligibility
+    reporting); the symbolic walk is the authority, since const jump
+    targets can also arrive through folded arithmetic."""
+    total = pushed = 0
+    prev_was_push = False
+    for op in iter_ops(code):
+        if op in (0x56, 0x57):
+            total += 1
+            if prev_was_push:
+                pushed += 1
+        prev_was_push = 0x5F <= op <= 0x7F
+    return total, pushed
+
+
 _STATIC_KEYS_CACHE: Dict[bytes, Optional[Tuple[Tuple[bytes, ...],
                                                Tuple[bytes, ...]]]] = {}
 

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from coreth_tpu import faults
@@ -25,6 +26,7 @@ from coreth_tpu.crypto.keccak import keccak256_many
 from coreth_tpu import obs
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as T
+from coreth_tpu.evm.device.specialize import KDIG_CAP
 from coreth_tpu.ops import u256
 
 # Same seam the transfer path's supervised _issue_window fires
@@ -55,6 +57,12 @@ def _compile_pool():
 
 WORD_ZERO = b"\x00" * 32
 
+# Per-runner cap on specialized programs compiled into one OCC kernel:
+# every program is a straight-line sub-program in the same XLA build,
+# so an unbounded set would bloat compile time; past the cap new
+# contracts stay on the generic kernel (counted as escapes).
+SPEC_SET_CAP = 8
+
 # Device dispatches issued through this module (single-shot machine
 # runs AND fused OCC windows).  The bench prints dispatches-per-block
 # from it and the OCC-equivalence tests assert the O(txs) -> O(1)
@@ -68,6 +76,14 @@ def _count_dispatch() -> None:
     obs.instant("device/dispatch")
 
 
+@jax.jit
+def _scatter_rows(tab, idx, rows):
+    """Jitted row scatter for the appended-gid table sync: the eager
+    ``.at[].set`` pays ms-scale host-side lowering per call; jit
+    amortizes it to a cache hit per append-batch shape."""
+    return tab.at[idx].set(rows, mode="drop")
+
+
 def addr_word(addr: bytes) -> int:
     return int.from_bytes(addr, "big")
 
@@ -76,6 +92,25 @@ def word16(v: int) -> np.ndarray:
     """u256 int -> 16 little-endian int32 limbs (the machine layout)."""
     return np.frombuffer(
         v.to_bytes(32, "little"), dtype=np.uint16).astype(np.int32)
+
+
+_WORD16_CACHE: Dict[int, np.ndarray] = {}
+
+
+def word16c(v: int) -> np.ndarray:
+    """Cached, read-only word16: the window packer converts the same
+    caller/contract/gas-price words every window (senders recur all
+    chain), so the per-lane to_bytes/frombuffer pair amortizes to a
+    dict hit.  Returned arrays are frozen — callers ASSIGN them into
+    batch tensors (a copy), never mutate."""
+    w = _WORD16_CACHE.get(v)
+    if w is None:
+        if len(_WORD16_CACHE) > (1 << 16):
+            _WORD16_CACHE.clear()  # unbounded value streams: reset
+        w = word16(v)
+        w.setflags(write=False)
+        _WORD16_CACHE[v] = w
+    return w
 
 
 def _norm_slot_key(key: bytes) -> bytes:
@@ -91,6 +126,28 @@ def _cd_word(data: bytes, w: int) -> bytes:
     zero-padded exactly like CALLDATALOAD past the end."""
     word = data[4 + 32 * w:4 + 32 * w + 32]
     return word + b"\x00" * (32 - len(word))
+
+
+_ARR_BASE: Dict[int, int] = {}
+
+
+def _arr_base(slot: int) -> int:
+    """keccak(pad32(slot)) as an int — the Solidity dynamic-array data
+    base; element i lives at base + i.  Depends only on the (small,
+    recipe-recorded) slot index, so it caches process-wide and the
+    per-lane array-key derivation is pure host arithmetic (no keccak
+    batch at premap time at all)."""
+    v = _ARR_BASE.get(slot)
+    if v is None:
+        from coreth_tpu.crypto import keccak256
+        v = int.from_bytes(keccak256(slot.to_bytes(32, "big")), "big")
+        _ARR_BASE[slot] = v
+    return v
+
+
+# Process-wide learned-recipe store (see MachineWindowRunner.__init__:
+# recipes are pure code-derived facts, shared across runners/engines)
+RECIPES: Dict[bytes, Dict[tuple, None]] = {}
 
 
 _STATIC_PREMAP: Dict[bytes, Tuple[bytes, ...]] = {}
@@ -328,16 +385,29 @@ class MachineRunner:
         return missing
 
     def _unpack(self, out: "PackedOut", txs) -> List[TxResult]:
-        return [result_from_row(out, i) for i in range(len(txs))]
+        return results_for_rows(out, np.arange(len(txs)))
 
 
 # ------------------------------------------------------------ unpack
+def _be_blob(arr: np.ndarray) -> bytes:
+    """Little-endian 16-limb words -> one flat blob of 32-byte
+    BIG-endian values (limb order reversed, each limb written as a
+    big-endian u16): the bulk twin of the old per-entry join — the
+    unpack path runs once per LANE per window, and python-level byte
+    joins were ~20% of the whole replay wall on the specialized
+    erc20-machine profile."""
+    return np.ascontiguousarray(arr[..., ::-1]).astype(">u2").tobytes()
+
+
 class PackedOut:
     """View over the machine's single packed output tensor (one
-    device->host transfer; see machine.py 'packed')."""
+    device->host transfer; see machine.py 'packed').  Byte-level
+    views (storage keys/values, log topics/data) convert ONCE per
+    window via numpy and are sliced per entry."""
 
     def __init__(self, blob: np.ndarray, p: M.MachineParams):
         S, LC, LD = p.scache_cap, p.log_cap, p.log_data_cap
+        self.S, self.LC, self.LD = S, LC, LD
         o = 0
 
         def take(n, shape=None):
@@ -361,6 +431,33 @@ class PackedOut:
         self.log_cnt = take(1)[:, 0]
         self.log_top = take(LC * 4 * 16, (LC, 4, 16))
         self.log_data = take(LC * LD, (LC, LD))
+        self._kb = self._vb = self._ob = None
+        self._tb = self._db = None
+
+    def key_blob(self) -> bytes:
+        if self._kb is None:
+            self._kb = _be_blob(self.skey)
+        return self._kb
+
+    def val_blob(self) -> bytes:
+        if self._vb is None:
+            self._vb = _be_blob(self.sval)
+        return self._vb
+
+    def orig_blob(self) -> bytes:
+        if self._ob is None:
+            self._ob = _be_blob(self.sorig)
+        return self._ob
+
+    def topic_blob(self) -> bytes:
+        if self._tb is None:
+            self._tb = _be_blob(self.log_top)
+        return self._tb
+
+    def data_blob(self) -> bytes:
+        if self._db is None:
+            self._db = self.log_data.astype(np.uint8).tobytes()
+        return self._db
 
 
 def _key_bytes(limbs: np.ndarray) -> bytes:
@@ -380,37 +477,199 @@ def miss_keys(out: PackedOut, i: int) -> List[bytes]:
     """Storage keys lane i touched that were NOT in its seeded cache
     (F_MISS entries — executed against a speculative zero)."""
     keys = []
-    for j in range(int(out.scnt[i])):
-        if out.sflag[i, j] & M.F_MISS:
-            keys.append(_key_bytes(out.skey[i, j]))
+    n = int(out.scnt[i])
+    if not n:
+        return keys
+    kb = out.key_blob()
+    flags = out.sflag[i]
+    for j in range(n):
+        if flags[j] & M.F_MISS:
+            off = (i * out.S + j) * 32
+            keys.append(kb[off:off + 32])
     return keys
+
+
+def _kreq_ctx_bytes(op: int, t, env) -> bytes:
+    """The 32-byte context word a lane's traced keccak request reads —
+    must equal the DEVICE input word bit-for-bit (specialize.HOST_CTX
+    admits only full-width words, so these are plain paddings)."""
+    if op == 0x33:
+        return b"\x00" * 12 + t.caller
+    if op == 0x30:
+        return b"\x00" * 12 + t.address
+    if op == 0x32:
+        return b"\x00" * 12 + t.origin
+    if op == 0x34:
+        return t.value.to_bytes(32, "big")
+    if op == 0x3A:
+        return t.gas_price.to_bytes(32, "big")
+    if op == 0x41:
+        return b"\x00" * 12 + env.coinbase
+    if op == 0x46:
+        return env.chain_id.to_bytes(32, "big")
+    if op == 0x48:
+        return env.base_fee.to_bytes(32, "big")
+    # a HOST_CTX opcode this function does not know would silently
+    # produce a wrong keccak input the specialized kernel TRUSTS —
+    # fail loudly instead of diverging downstream at the root check
+    raise ValueError(f"unhandled kdig ctx opcode {op:#04x}")
+
+
+def fill_kdig(kdig: np.ndarray, jobs) -> None:
+    """Evaluate collected keccak requests and write their digest limbs.
+
+    jobs: (bi, fl, t, env, reqs) per specialized lane.  Requests
+    nest (("kdig", j) words reference earlier slots), so evaluation
+    batches by readiness level — one keccak256_many crossing per
+    level, vectorized limb scatter at the end."""
+    if not jobs:
+        return
+    done: List[List[Optional[bytes]]] = [
+        [None] * len(reqs) for (_bi, _fl, _t, _env, reqs) in jobs]
+    while True:
+        msgs, where = [], []
+        pending = False
+        for ji, (_bi, _fl, t, env, reqs) in enumerate(jobs):
+            for k, desc in enumerate(reqs):
+                if done[ji][k] is not None:
+                    continue
+                parts, ready = [], True
+                for d in desc:
+                    kind = d[0]
+                    if kind == "const":
+                        parts.append(d[1].to_bytes(32, "big"))
+                    elif kind == "ctx":
+                        parts.append(_kreq_ctx_bytes(d[1], t, env))
+                    elif kind == "data":
+                        b = t.calldata[d[1]:d[1] + 32]
+                        parts.append(b + b"\x00" * (32 - len(b)))
+                    else:  # ("kdig", j): an earlier slot's digest
+                        dj = done[ji][d[1]]
+                        if dj is None:
+                            ready = False
+                            break
+                        parts.append(dj)
+                if not ready:
+                    pending = True
+                    continue
+                msgs.append(b"".join(parts))
+                where.append((ji, k))
+        if not msgs:
+            break
+        for (ji, k), dg in zip(where, keccak256_many(msgs)):
+            done[ji][k] = dg
+        if not pending:
+            break
+    fills = [(jobs[ji][0], jobs[ji][1], k, dg)
+             for ji, row in enumerate(done)
+             for k, dg in enumerate(row) if dg is not None]
+    if fills:
+        idx = np.array([(bi, fl, k) for bi, fl, k, _ in fills],
+                       dtype=np.int64)
+        blob = b"".join(dg[::-1] for _bi, _fl, _k, dg in fills)
+        limbs = np.frombuffer(blob, dtype=np.uint16).reshape(
+            -1, u256.LIMBS).astype(np.int32)
+        kdig[idx[:, 0], idx[:, 1], idx[:, 2]] = limbs
 
 
 def result_from_row(out: PackedOut, i: int) -> TxResult:
     """One lane's TxResult from a PackedOut row."""
     reads: Dict[bytes, int] = {}
     writes: Dict[bytes, int] = {}
-    for j in range(int(out.scnt[i])):
-        fl = int(out.sflag[i, j])
-        if not fl & M.F_VALID:
-            continue
-        key = _key_bytes(out.skey[i, j])
-        if fl & M.F_READ:
-            reads[key] = _word_int(out.sorig[i, j])
-        if fl & M.F_WRITTEN:
-            writes[key] = _word_int(out.sval[i, j])
+    n = int(out.scnt[i])
+    if n:
+        kb, vb, ob = out.key_blob(), out.val_blob(), out.orig_blob()
+        flags = out.sflag[i]
+        for j in range(n):
+            fl = int(flags[j])
+            if not fl & M.F_VALID:
+                continue
+            off = (i * out.S + j) * 32
+            key = kb[off:off + 32]
+            if fl & M.F_READ:
+                reads[key] = int.from_bytes(ob[off:off + 32], "big")
+            if fl & M.F_WRITTEN:
+                writes[key] = int.from_bytes(vb[off:off + 32], "big")
     logs = []
-    for j in range(int(out.log_cnt[i])):
-        topics = [_word_int(out.log_top[i, j, k]).to_bytes(32, "big")
-                  for k in range(int(out.log_nt[i, j]))]
-        data = bytes(
-            out.log_data[i, j, :int(out.log_dlen[i, j])].astype(
-                np.uint8).tolist())
-        logs.append((topics, data))
+    nl = int(out.log_cnt[i])
+    if nl:
+        tb, db = out.topic_blob(), out.data_blob()
+        LC, LD = out.LC, out.LD
+        for j in range(nl):
+            base = ((i * LC + j) * 4) * 32
+            topics = [tb[base + 32 * k:base + 32 * (k + 1)]
+                      for k in range(int(out.log_nt[i, j]))]
+            doff = (i * LC + j) * LD
+            data = db[doff:doff + int(out.log_dlen[i, j])]
+            logs.append((topics, data))
     return TxResult(
         status=int(out.status[i]), gas_left=int(out.gas[i]),
         refund=int(out.refund[i]), logs=logs, reads=reads,
         writes=writes, host_reason=int(out.host_reason[i]))
+
+
+def results_for_rows(out: PackedOut, rows) -> List[TxResult]:
+    """TxResults for many PackedOut rows in one pass.
+
+    The per-lane ``result_from_row`` pays a numpy scalar index + bounds
+    check per field per lane (~86us/lane on the erc20-machine shape —
+    ~15% of replay wall).  Here the validity masks, flag tests, and
+    int conversions happen once per call as array ops; the remaining
+    Python loop touches only entries that exist (``nonzero`` of the
+    mask), not the padded S/LC capacity."""
+    rows = np.asarray(rows, dtype=np.int64)
+    n = rows.shape[0]
+    if not n:
+        return []
+    status = out.status[rows].tolist()
+    gas = out.gas[rows].tolist()
+    refund = out.refund[rows].tolist()
+    hreason = out.host_reason[rows].tolist()
+    reads_l: List[Dict[bytes, int]] = [{} for _ in range(n)]
+    writes_l: List[Dict[bytes, int]] = [{} for _ in range(n)]
+    logs_l: List[list] = [[] for _ in range(n)]
+    scnt = out.scnt[rows]
+    if scnt.any():
+        S = out.S
+        sf = out.sflag[rows]
+        valid = (np.arange(S)[None, :] < scnt[:, None]) \
+            & ((sf & M.F_VALID) != 0)
+        ki, si = np.nonzero(valid)
+        if ki.size:
+            kb, vb, ob = out.key_blob(), out.val_blob(), out.orig_blob()
+            fl = sf[ki, si]
+            rd = ((fl & M.F_READ) != 0).tolist()
+            wr = ((fl & M.F_WRITTEN) != 0).tolist()
+            offs = ((rows[ki] * S + si) * 32).tolist()
+            which = ki.tolist()
+            for t, o in enumerate(offs):
+                key = kb[o:o + 32]
+                k = which[t]
+                if rd[t]:
+                    reads_l[k][key] = int.from_bytes(ob[o:o + 32], "big")
+                if wr[t]:
+                    writes_l[k][key] = int.from_bytes(vb[o:o + 32], "big")
+    lc = out.log_cnt[rows]
+    if lc.any():
+        LC, LD = out.LC, out.LD
+        li, lj = np.nonzero(np.arange(LC)[None, :] < lc[:, None])
+        if li.size:
+            tb, db = out.topic_blob(), out.data_blob()
+            nt = out.log_nt[rows][li, lj].tolist()
+            dl = out.log_dlen[rows][li, lj].tolist()
+            base = (((rows[li] * LC + lj) * 4) * 32).tolist()
+            doff = ((rows[li] * LC + lj) * LD).tolist()
+            which = li.tolist()
+            for t, b in enumerate(base):
+                topics = [tb[b + 32 * k:b + 32 * (k + 1)]
+                          for k in range(nt[t])]
+                d = doff[t]
+                logs_l[which[t]].append((topics, db[d:d + dl[t]]))
+    return [TxResult(status=status[k], gas_left=gas[k],
+                     refund=refund[k], logs=logs_l[k],
+                     reads=reads_l[k], writes=writes_l[k],
+                     host_reason=hreason[k])
+            for k in range(n)]
 
 
 # ----------------------------------------------------------- OCC window
@@ -468,6 +727,7 @@ class MachineWindowRunner:
     RECIPE_CAP = 8   # learned keccak recipes per contract
     SLOT_SCAN = 4    # mapping slot indices a miss is explained against
     DATA_WORDS = 4   # calldata words considered as mapping sources
+    ARRAY_SPAN = 1 << 32  # max index an array recipe explains with
 
     def __init__(self, fork: str,
                  storage_resolver: Callable[[bytes, bytes], int],
@@ -481,11 +741,16 @@ class MachineWindowRunner:
         # contract -> {key32: None} (dict-as-ordered-set: deterministic
         # iteration, unlike a set)
         self.common: Dict[bytes, Dict[bytes, None]] = {}
-        # contract -> {recipe: None}; recipe =
+        # bytecode -> {recipe: None}; recipe =
         # (selector, "caller", slot) | (selector, "data", word, slot)
         # — selector-scoped so one function's mapping pattern never
-        # predicts (and permanently maps) keys for another's lanes
-        self.recipes: Dict[bytes, Dict[tuple, None]] = {}
+        # predicts (and permanently maps) keys for another's lanes.
+        # The store is MODULE-level (shared, monotone, capped): a
+        # recipe is a pure fact about a bytecode's keccak structure —
+        # like trace eligibility or an XLA compile, not state — so a
+        # fresh engine skips the discovery dispatches an earlier runner
+        # already paid for the same contract.
+        self.recipes = RECIPES
         self.table = None
         self.key_tab = None
         self.table_cap = 0
@@ -501,8 +766,35 @@ class MachineWindowRunner:
         # separately A/B-able under the prediction umbrella
         self._nest = bool(int(os.environ.get(
             "CORETH_PREMAP_NEST", "1")))
+        # array-slot arithmetic recipes (keccak(slot) + i) — the third
+        # learned premap shape (dynamic-array elements indexed by a
+        # calldata word), separately A/B-able
+        self._arr = bool(int(os.environ.get(
+            "CORETH_PREMAP_ARR", "1")))
         self._prebucket = bool(int(os.environ.get(
             "CORETH_GROWTH_PREBUCKET", "1")))
+        # per-contract traced specialization (evm/device/specialize):
+        # machine-eligible code whose bytecode traces to a straight-
+        # line program executes with no opcode switch; CORETH_
+        # SPECIALIZE=0 keeps every lane on the generic interpreter
+        self._specialize = bool(int(os.environ.get(
+            "CORETH_SPECIALIZE", "1")))
+        # code -> program index (sticky: the set only grows, so the
+        # kernel memo key ratchets like the feature set); codes the
+        # tracer rejected are cached separately
+        self._spec_progs: Dict[bytes, int] = {}
+        self._spec_bad: set = set()
+        # code -> host-evaluated keccak requests (specialize.
+        # spec_requests): the issue path computes these digests per
+        # lane in one C++ batch and ships them as the `kdig` input
+        self._spec_reqs: Dict[bytes, Tuple] = {}
+        # (code, code_cap) -> (dense code row, jdest row, len): the
+        # window packer copies these per lane instead of re-scanning
+        # bytecode and re-walking jumpdests (hot-path profile item)
+        self._code_rows: Dict[Tuple[bytes, int], Tuple] = {}
+        # window code-assignment signature -> converted device arrays
+        # (code, jdest, code_len); see issue() — capped at 2 entries
+        self._win_code_cache: Dict[Tuple, Tuple] = {}
         # pre-warm compiles ride the background compile thread by
         # default; CORETH_COMPILE_THREAD=0 restores the synchronous
         # compile for A/B (and the legacy CORETH_GROWTH_PREBUCKET=0
@@ -526,8 +818,12 @@ class MachineWindowRunner:
         self.premap_predicted = 0   # predicted keys seeded into premaps
         self.premap_hits = 0        # predicted keys lanes then touched
         self.premap_nested = 0      # keys derived via 2nd-level recipes
+        self.premap_array = 0       # keys derived via array recipes
         self.discovery_dispatches = 0  # re-dispatches for missed keys
         self.kernel_retraces = 0    # mid-run compiles at dispatch time
+        self.lanes_specialized = 0  # lanes run on a traced sub-program
+        self.specialize_escapes = 0  # lanes kept on the generic kernel
+        self.programs_traced = 0    # contracts compiled to sub-programs
 
     # ------------------------------------------------------------ state
     def reset(self) -> None:
@@ -584,6 +880,64 @@ class MachineWindowRunner:
         """Rows the (largest) table arena must hold right now."""
         return len(self.vals)
 
+    # ----------------------------------------------------- specialization
+    def _spec_id(self, code: bytes) -> int:
+        """Specialized-program index for `code` (-1 = generic kernel).
+        First sighting of eligible code ADDS it to the sticky program
+        set (the kernel key ratchets exactly like the feature set —
+        workloads stabilize their hot-contract set in the cold first
+        window, so steady state adds nothing)."""
+        if not self._specialize:
+            return -1
+        idx = self._spec_progs.get(code)
+        if idx is not None:
+            return idx
+        if code in self._spec_bad \
+                or len(self._spec_progs) >= SPEC_SET_CAP:
+            return -1
+        from coreth_tpu.evm.device.specialize import trace_eligible
+        ok, _reason = trace_eligible(code, self.fork)
+        if not ok:
+            self._spec_bad.add(code)
+            return -1
+        idx = len(self._spec_progs)
+        self._spec_progs[code] = idx
+        from coreth_tpu.evm.device.specialize import spec_requests
+        self._spec_reqs[code] = spec_requests(code, self.fork)
+        self.programs_traced += 1
+        return idx
+
+    def _spec_key(self) -> Tuple:
+        """The kernel-memo component: SpecProgram descriptors in
+        program-index order."""
+        if not self._spec_progs:
+            return ()
+        from coreth_tpu.evm.device.specialize import SpecProgram
+        return tuple(SpecProgram(code=c, fork=self.fork)
+                     for c, _i in sorted(self._spec_progs.items(),
+                                         key=lambda kv: kv[1]))
+
+    def _code_pack(self, code: bytes, code_cap: int) -> Tuple:
+        """Dense (code row, jdest row, code_len) for one bytecode under
+        one code_cap bucket (memoized; rows are assigned whole into the
+        batch tensors — a contiguous copy instead of per-lane scan +
+        jumpdest walk)."""
+        key = (code, code_cap)
+        rows = self._code_rows.get(key)
+        if rows is None:
+            cb = np.zeros((code_cap + 33,), dtype=np.int32)
+            arr = np.frombuffer(code, dtype=np.uint8)
+            cb[:len(arr)] = arr
+            jd = np.zeros((code_cap,), dtype=np.int32)
+            for d in T.scan_code(code, self.fork).jumpdests:
+                if d < code_cap:
+                    jd[d] = 1
+            cb.setflags(write=False)
+            jd.setflags(write=False)
+            rows = (cb, jd, len(arr))
+            self._code_rows[key] = rows
+        return rows
+
     # -------------------------------------------------------- prediction
     def _rc_src(self, t: TxSpec, tag: tuple) -> bytes:
         """A recipe source tag's padded 32-byte value for THIS lane."""
@@ -611,7 +965,7 @@ class MachineWindowRunner:
         (CORETH_PREMAP_NEST=0 restores the miss-and-rerun A/B)."""
         if not self._predict or not missed:
             return
-        recipes = self.recipes.setdefault(t.address, {})
+        recipes = self.recipes.setdefault(t.code, {})
         if len(recipes) >= self.RECIPE_CAP:
             return
         # recipes are scoped to the calldata SELECTOR they were learned
@@ -639,27 +993,58 @@ class MachineWindowRunner:
                     recipes[(sel,) + tag + (slot,)] = None
                     explained[_norm_slot_key(digs[i])] = None
                 i += 1
-        if not self._nest or len(recipes) >= self.RECIPE_CAP:
+        if self._nest and len(recipes) < self.RECIPE_CAP:
+            leftover = dict.fromkeys(
+                k for k in want if k not in explained)
+            if leftover:
+                # second level: outer keccaks over every first-level
+                # digest as the candidate inner hash — |srcs| * |srcs|
+                # * SLOT_SCAN keccaks, one batched call, only for
+                # unexplained misses
+                msgs2 = [src2 + digs[i]
+                         for _tag2, src2 in srcs
+                         for i in range(len(digs))]
+                digs2 = keccak256_many(msgs2)
+                j = 0
+                for tag2, _src2 in srcs:
+                    for i in range(len(digs)):
+                        k2 = _norm_slot_key(digs2[j])
+                        if k2 in leftover \
+                                and len(recipes) < self.RECIPE_CAP:
+                            tag1 = srcs[i // self.SLOT_SCAN][0]
+                            slot = i % self.SLOT_SCAN
+                            recipes[(sel, "nest", tag2, tag1,
+                                     slot)] = None
+                            explained[k2] = None
+                        j += 1
+        # third shape: array-slot arithmetic — a dynamic array at slot
+        # p stores element i at keccak(pad32(p)) + i (no keccak over
+        # the lane's inputs at all), the last discovery-fallback class.
+        # A leftover miss that equals base(slot) + v for a SMALL source
+        # word v (an index argument, never an address) records
+        # (sel, "arr", tag, slot); future lanes derive their element
+        # keys by pure host arithmetic before dispatch.
+        if not self._arr or len(recipes) >= self.RECIPE_CAP:
             return
-        leftover = dict.fromkeys(k for k in want if k not in explained)
-        if not leftover:
+        left2 = dict.fromkeys(k for k in want if k not in explained)
+        if not left2:
             return
-        # second level: outer keccaks over every first-level digest as
-        # the candidate inner hash — |srcs| * |srcs| * SLOT_SCAN
-        # keccaks, one batched call, only for unexplained misses
-        msgs2 = [src2 + digs[i]
-                 for _tag2, src2 in srcs
-                 for i in range(len(digs))]
-        digs2 = keccak256_many(msgs2)
-        j = 0
-        for tag2, _src2 in srcs:
-            for i in range(len(digs)):
-                if _norm_slot_key(digs2[j]) in leftover \
-                        and len(recipes) < self.RECIPE_CAP:
-                    tag1 = srcs[i // self.SLOT_SCAN][0]
-                    slot = i % self.SLOT_SCAN
-                    recipes[(sel, "nest", tag2, tag1, slot)] = None
-                j += 1
+        for tag, src in srcs:
+            v = int.from_bytes(src, "big")
+            if v >= self.ARRAY_SPAN:
+                continue
+            for slot in range(self.SLOT_SCAN):
+                cand = _norm_slot_key((
+                    (_arr_base(slot) + v) % (1 << 256)
+                ).to_bytes(32, "big"))
+                if cand in left2 and len(recipes) < self.RECIPE_CAP:
+                    recipes[(sel, "arr", tag, slot)] = None
+                    # a second source word carrying the same value must
+                    # not burn another RECIPE_CAP slot on the same key
+                    del left2[cand]
+                    explained[cand] = None
+            if not left2:
+                return
 
     # ------------------------------------------------------------- shape
     def _occ_params(self, items, premaps):
@@ -676,6 +1061,7 @@ class MachineWindowRunner:
                 if not info.eligible:
                     raise ValueError(
                         f"TxSpec code not device-eligible: {info.reason}")
+                self._spec_id(t.code)  # program set settles pre-build
                 feats |= set(info.features)
                 max_code = max(max_code, len(t.code))
                 max_data = max(max_data, len(t.calldata))
@@ -759,13 +1145,25 @@ class MachineWindowRunner:
         elif self._synced < n:
             # append newly mapped rows; already-synced rows are live on
             # device (committed by the kernel itself)
-            idx = np.arange(self._synced, n, dtype=np.int32)
-            tv = np.stack([word16(self.vals[g]) for g in idx])
-            tk = np.stack([word16(int.from_bytes(self.gid_keys[g][1],
-                                                 "big")) for g in idx])
+            cnt = n - self._synced
+            pad = 64
+            while pad < cnt:
+                pad *= 2
+            # pow2-padded batch (OOB rows drop): a fresh jit trace per
+            # distinct append length would serialize compiles mid-run
+            idx = np.full((pad,), G, dtype=np.int32)
+            idx[:cnt] = np.arange(self._synced, n, dtype=np.int32)
+            tv = np.zeros((pad, u256.LIMBS), dtype=np.int32)
+            tk = np.zeros((pad, u256.LIMBS), dtype=np.int32)
+            for j, g in enumerate(range(self._synced, n)):
+                tv[j] = word16(self.vals[g])
+                tk[j] = word16(int.from_bytes(self.gid_keys[g][1],
+                                              "big"))
             jidx = jnp.asarray(idx)
-            self.table = self.table.at[jidx].set(jnp.asarray(tv))
-            self.key_tab = self.key_tab.at[jidx].set(jnp.asarray(tk))
+            self.table = _scatter_rows(self.table, jidx,
+                                       jnp.asarray(tv))
+            self.key_tab = _scatter_rows(self.key_tab, jidx,
+                                         jnp.asarray(tk))
             self._synced = n
         return self.table, self.key_tab
 
@@ -789,7 +1187,7 @@ class MachineWindowRunner:
                 for t in specs:
                     sel = bytes(t.calldata[:4])
                     lane = []
-                    for rc in self.recipes.get(t.address, ()):
+                    for rc in self.recipes.get(t.code, ()):
                         if rc[0] != sel:
                             continue
                         if rc[1] == "nest":
@@ -800,6 +1198,17 @@ class MachineWindowRunner:
                                         + slot.to_bytes(32, "big"))
                             lane.append(("nest",
                                          self._rc_src(t, tag2)))
+                        elif rc[1] == "arr":
+                            if not self._arr:
+                                continue
+                            _sel, _a, tag, slot = rc
+                            v = int.from_bytes(self._rc_src(t, tag),
+                                               "big")
+                            if v >= self.ARRAY_SPAN:
+                                continue
+                            lane.append(("key", _norm_slot_key((
+                                (_arr_base(slot) + v) % (1 << 256)
+                            ).to_bytes(32, "big"))))
                         elif rc[1] == "caller":
                             msgs.append(b"\x00" * 12 + t.caller
                                         + rc[2].to_bytes(32, "big"))
@@ -820,6 +1229,8 @@ class MachineWindowRunner:
         for block_meta in meta:
             for lane in block_meta:
                 for entry in lane:
+                    if entry[0] == "key":
+                        continue  # host-derived; no digest consumed
                     if entry[0] == "nest":
                         msgs2.append(entry[1] + digs[di])
                     di += 1
@@ -840,13 +1251,17 @@ class MachineWindowRunner:
                         keys[k] = None
                         pred[k] = None
                     for entry in meta[bi][li]:
-                        if entry[0] == "nest":
+                        if entry[0] == "key":
+                            k = entry[1]
+                            self.premap_array += 1
+                        elif entry[0] == "nest":
                             k = _norm_slot_key(digs2[dj])
                             dj += 1
                             self.premap_nested += 1
+                            di += 1
                         else:
                             k = _norm_slot_key(digs[di])
-                        di += 1
+                            di += 1
                         keys[k] = None
                         pred[k] = None
                 for k in self.common.get(t.address, ()):
@@ -879,14 +1294,29 @@ class MachineWindowRunner:
         p, occ = self._occ_params(items, premaps)
         W, L, S, G = occ.blocks, p.batch, p.scache_cap, occ.table_cap
 
-        code = np.zeros((W, L, p.code_cap + 33), dtype=np.int32)
-        code_len = np.zeros((W, L), dtype=np.int32)
-        jdest = np.zeros((W, L, p.code_cap), dtype=np.int32)
+        # the lane -> bytecode assignment recurs window after window
+        # (workloads run a stable hot-contract set), and the code /
+        # jumpdest tensors are by far the largest window inputs — reuse
+        # the converted device arrays whenever the assignment signature
+        # matches instead of re-assembling ~100MB per window
+        code_sig = (W, L, p.code_cap,
+                    tuple(tuple(t.code for t in specs)
+                          for _env, specs in items))
+        code_cached = self._win_code_cache.get(code_sig)
+        if code_cached is None:
+            code = np.zeros((W, L, p.code_cap + 33), dtype=np.int32)
+            code_len = np.zeros((W, L), dtype=np.int32)
+            jdest = np.zeros((W, L, p.code_cap), dtype=np.int32)
+        else:
+            code = code_len = jdest = None
         calldata = np.zeros((W, L, p.data_cap), dtype=np.int32)
         data_len = np.zeros((W, L), dtype=np.int32)
         start_gas = np.zeros((W, L), dtype=np.int32)
         active = np.zeros((W, L), dtype=bool)
         sgid = np.full((W, L, S), G, dtype=np.int32)
+        prog_id = np.full((W, L), -1, dtype=np.int32)
+        kdig = np.zeros((W, L, KDIG_CAP, u256.LIMBS), dtype=np.int32)
+        kjobs: List[Tuple] = []
         words = {k: np.zeros((W, L, u256.LIMBS), dtype=np.int32)
                  for k in ("callvalue", "caller_w", "address_w",
                            "origin_w", "gasprice_w")}
@@ -905,33 +1335,56 @@ class MachineWindowRunner:
             basefee_w[bi] = word16(env.base_fee)
             chain_id = env.chain_id
             for li, t in enumerate(specs):
-                cb = np.frombuffer(t.code, dtype=np.uint8)
-                code[bi, li, :len(cb)] = cb
-                code_len[bi, li] = len(cb)
-                info = T.scan_code(t.code, self.fork)
-                for d in info.jumpdests:
-                    if d < p.code_cap:
-                        jdest[bi, li, d] = 1
+                if code_cached is None:
+                    cb, jd, ln = self._code_pack(t.code, p.code_cap)
+                    code[bi, li] = cb
+                    code_len[bi, li] = ln
+                    jdest[bi, li] = jd
                 db = np.frombuffer(t.calldata, dtype=np.uint8)
                 calldata[bi, li, :len(db)] = db
                 data_len[bi, li] = len(db)
                 start_gas[bi, li] = t.gas
                 active[bi, li] = True
-                words["callvalue"][bi, li] = word16(t.value)
-                words["caller_w"][bi, li] = word16(addr_word(t.caller))
-                words["address_w"][bi, li] = word16(addr_word(t.address))
-                words["origin_w"][bi, li] = word16(addr_word(t.origin))
-                words["gasprice_w"][bi, li] = word16(t.gas_price)
+                words["callvalue"][bi, li] = word16c(t.value)
+                words["caller_w"][bi, li] = word16c(addr_word(t.caller))
+                words["address_w"][bi, li] = word16c(
+                    addr_word(t.address))
+                words["origin_w"][bi, li] = word16c(addr_word(t.origin))
+                words["gasprice_w"][bi, li] = word16c(t.gas_price)
+                pid = self._spec_progs.get(t.code, -1) \
+                    if self._specialize else -1
+                prog_id[bi, li] = pid
+                if pid >= 0 and self._spec_reqs.get(t.code):
+                    kjobs.append((bi, li, t, env,
+                                  self._spec_reqs[t.code]))
+                if attempt == 1:
+                    if pid >= 0:
+                        self.lanes_specialized += 1
+                    elif self._specialize:
+                        self.specialize_escapes += 1
                 for j, key in enumerate(block_pre[li]):
                     sgid[bi, li, j] = self._gid(t.address, key)
+        fill_kdig(kdig, kjobs)
         table, key_tab = self._device_tables(G)
+        if code_cached is None:
+            code_cached = (jnp.asarray(code), jnp.asarray(jdest),
+                           jnp.asarray(code_len))
+            if len(self._win_code_cache) >= 2:
+                # steady state needs two signatures at most (the short
+                # lead window + the full window); a shifting workload
+                # just rebuilds
+                self._win_code_cache.clear()
+            self._win_code_cache[code_sig] = code_cached
+        code_j, jdest_j, code_len_j = code_cached
         inputs = dict(
-            code=jnp.asarray(code), jdest=jnp.asarray(jdest),
-            code_len=jnp.asarray(code_len),
+            code=code_j, jdest=jdest_j,
+            code_len=code_len_j,
             calldata=jnp.asarray(calldata),
             data_len=jnp.asarray(data_len),
             start_gas=jnp.asarray(start_gas),
             active=jnp.asarray(active), sgid=jnp.asarray(sgid),
+            prog_id=jnp.asarray(prog_id),
+            kdig=jnp.asarray(kdig),
             callvalue=jnp.asarray(words["callvalue"]),
             caller_w=jnp.asarray(words["caller_w"]),
             address_w=jnp.asarray(words["address_w"]),
@@ -969,12 +1422,14 @@ class MachineWindowRunner:
             self._hw["blocks"] = max(self._hw.get("blocks", 0),
                                      _pow2(max(1, blocks), 1))
 
-    def _kernel(self, p: M.MachineParams, occ: M.OccParams):
-        return M.get_occ_machine(p, occ)
+    def _kernel(self, p: M.MachineParams, occ: M.OccParams,
+                sk: Optional[Tuple] = None):
+        sk = self._spec_key() if sk is None else sk
+        return M.get_occ_machine(p, occ, sk)
 
     def _kernel_compiled(self, p: M.MachineParams,
                          occ: M.OccParams) -> bool:
-        return M.occ_compiled(p, occ)
+        return M.occ_compiled(p, occ, self._spec_key())
 
     def _get_kernel(self, p: M.MachineParams, occ: M.OccParams):
         """Kernel for a dispatch, accounting retraces: a shape bucket
@@ -983,8 +1438,11 @@ class MachineWindowRunner:
         recompile-regression test pins this at zero on the pre-bucketed
         path; the legacy path pays one per cap bucket).  Tracked
         per-runner, not via the process-global kernel cache, so the
-        count is deterministic across bench reps and test order."""
-        key = (p, occ)
+        count is deterministic across bench reps and test order.  The
+        specialized-program set is part of the bucket identity: a new
+        hot contract mid-run retraces exactly like a new op family
+        would."""
+        key = (p, occ, self._spec_key())
         if key not in self._buckets_used:
             self._buckets_used.add(key)
             if not self._cold:
@@ -1026,6 +1484,8 @@ class MachineWindowRunner:
             start_gas=jnp.zeros((W, L), dtype=i32),
             active=jnp.zeros((W, L), dtype=bool),
             sgid=jnp.full((W, L, S), G, dtype=i32),
+            prog_id=jnp.full((W, L), -1, dtype=i32),
+            kdig=jnp.zeros((W, L, KDIG_CAP, u256.LIMBS), dtype=i32),
             callvalue=word, caller_w=word, address_w=word,
             origin_w=word, gasprice_w=word,
             timestamp=jnp.zeros((W,), dtype=i32),
@@ -1071,28 +1531,32 @@ class MachineWindowRunner:
                           table_cap=max(occ.table_cap * 2,
                                         self._table_floor),
                           rounds=occ.rounds)
-        if (p, nxt) in self._buckets_used:
+        sk = self._spec_key()
+        if (p, nxt, sk) in self._buckets_used:
             return
-        self._buckets_used.add((p, nxt))
+        self._buckets_used.add((p, nxt, sk))
         if self._kernel_compiled(p, nxt):
             return  # cache-warm from an earlier runner/rep
         if self._compile_async:
             # the trace runs on the compile thread while the CURRENT
             # window executes on the main thread — on CPU hosts this
-            # hides the whole compile instead of serializing it here
-            self._warm_pending[(p, nxt)] = _compile_pool().submit(
-                self._warm_compile, p, nxt)
+            # hides the whole compile instead of serializing it here.
+            # The spec key is captured NOW: the warm must compile the
+            # bucket the scheduling dispatch saw, not whatever program
+            # set exists when the worker gets to it.
+            self._warm_pending[(p, nxt, sk)] = _compile_pool().submit(
+                self._warm_compile, p, nxt, sk)
             return
-        fn = self._kernel(p, nxt)
+        fn = self._kernel(p, nxt, sk)
         fn(*self._warm_args(p, nxt))
 
-    def _warm_compile(self, p: M.MachineParams,
-                      occ: M.OccParams) -> None:
+    def _warm_compile(self, p: M.MachineParams, occ: M.OccParams,
+                      sk: Tuple = ()) -> None:
         """Body of one background pre-warm: build + trace + dispatch
         the all-inactive warm batch for a bucket (compile-thread)."""
         with obs.span("device/prewarm_compile",
                       table_cap=occ.table_cap):
-            fn = self._kernel(p, occ)
+            fn = self._kernel(p, occ, sk)
             fn(*self._warm_args(p, occ))
 
     # ---------------------------------------------------------- complete
@@ -1155,7 +1619,8 @@ class MachineWindowRunner:
         for bi, (_env, specs) in enumerate(handle["items"]):
             slots = [self._lane_idx(handle, bi, li)
                      for li in range(len(specs))]
-            res = [result_from_row(pout, bi * Lp + fl) for fl in slots]
+            res = results_for_rows(
+                pout, np.asarray(slots, dtype=np.int64) + bi * Lp)
             if slots:
                 com = extra[bi, slots, 0].astype(bool)
                 esc = (extra[bi, slots, 1]
@@ -1192,10 +1657,12 @@ class MachineWindowRunner:
             for li, t in enumerate(specs):
                 row = bi * Lp + self._lane_idx(handle, bi, li)
                 touched: Dict[bytes, None] = {}
+                kb = pout.key_blob()
+                flags = pout.sflag[row]
                 for j in range(int(pout.scnt[row])):
-                    fl = int(pout.sflag[row, j])
-                    if fl & (M.F_READ | M.F_WRITTEN):
-                        touched[_key_bytes(pout.skey[row, j])] = None
+                    if flags[j] & (M.F_READ | M.F_WRITTEN):
+                        off = (row * pout.S + j) * 32
+                        touched[kb[off:off + 32]] = None
                 if predicted is not None:
                     self.premap_predicted += len(predicted[bi][li])
                     self.premap_hits += sum(

@@ -940,7 +940,8 @@ _OCC_RES = ("status", "gas", "refund", "host_reason", "scnt", "sflag",
             "log_dlen", "log_cnt")
 
 
-def build_occ_machine(params: MachineParams, occ: OccParams):
+def build_occ_machine(params: MachineParams, occ: OccParams,
+                      spec: Tuple = ()):
     """Fused multi-block OCC kernel; returns
     occ_run(table, key_tab, blocks_in) -> dict.
 
@@ -954,8 +955,21 @@ def build_occ_machine(params: MachineParams, occ: OccParams):
       address_w, origin_w, gasprice_w) each (W, B, ...); per-block
       scalars timestamp/number/gaslimit (W,) and coinbase_w/basefee_w
       (W, 16); plus sgid (W, B, S) int32 — the premapped global slot
-      id of each lane-cache entry (>= G marks an unused entry).
+      id of each lane-cache entry (>= G marks an unused entry); plus
+      prog_id (W, B) int32 — the per-lane specialized-program index
+      into `spec` (-1 = the generic interpreter kernel).
       chainid_w (16,) is shared across the window.
+
+    `spec` is a tuple of specialize.SpecProgram descriptors (part of
+    the kernel memo key): each traces its contract's bytecode into a
+    straight-line sub-program at build time (evm/device/specialize.py)
+    — no opcode switch, constants folded, jumps resolved to predicated
+    per-path segments.  Per OCC round, lanes split by prog_id: the
+    generic while_loop runs only the unspecialized lanes (and costs
+    ~one condition check when there are none), each specialized
+    program runs cond-gated on whether any of its lanes are pending,
+    and results merge by lane mask — the generic kernel IS the escape
+    hatch for trace-ineligible code.
 
     Returns {"table": (G,16), "packed": (W,B,PW+4)}: per-lane machine
     results in the pack_result layout plus 4 trailing columns —
@@ -968,6 +982,13 @@ def build_occ_machine(params: MachineParams, occ: OccParams):
     """
     p = params
     exec_lanes = _build_exec(p)
+    if spec:
+        from coreth_tpu.evm.device import specialize as SP
+        spec_fns = tuple(SP.build_spec_exec(prog, p) for prog in spec)
+        zero_res = lambda: SP._zero_res(p)  # noqa: E731 — cond branch
+    else:
+        spec_fns = ()
+        zero_res = None
     B, S = p.batch, p.scache_cap
     G, R = occ.table_cap, occ.rounds
     _EXEC_KEYS = ("code", "jdest", "code_len", "calldata", "data_len",
@@ -975,14 +996,44 @@ def build_occ_machine(params: MachineParams, occ: OccParams):
                   "origin_w", "gasprice_w", "timestamp", "number",
                   "gaslimit", "coinbase_w", "basefee_w")
 
+    def exec_mixed(exec_in, storage, active, prog_id):
+        """Per-lane program selection: generic interpreter for
+        prog_id < 0 (its while_loop exits immediately when no lane is
+        active), one cond-gated straight-line program per specialized
+        contract, merged by lane mask."""
+        if not spec_fns:
+            return exec_lanes(exec_in, storage, active)
+        st = exec_lanes(exec_in, storage, active & (prog_id < 0))
+        out = {f: st[f] for f in _OCC_RES}
+        for k, fn in enumerate(spec_fns):
+            mk = active & (prog_id == k)
+            stk = jax.lax.cond(
+                jnp.any(mk),
+                lambda fn=fn, mk=mk: fn(exec_in, storage, mk),
+                zero_res)
+            for f in _OCC_RES:
+                m = mk.reshape((B,) + (1,) * (out[f].ndim - 1))
+                out[f] = jnp.where(m, stk[f], out[f])
+        return out
+
     def occ_run(table, key_tab, blocks_in):
         chainid_w = blocks_in["chainid_w"]
 
         def block_body(tbl, binp):
             exec_in = {k: binp[k] for k in _EXEC_KEYS}
             exec_in["chainid_w"] = chainid_w
+            # host-evaluated keccak digests for specialized lanes
+            # (specialize.KDIG_CAP slots; direct callers without
+            # specialized programs may omit the input)
+            kd = binp.get("kdig")
+            if kd is None:
+                kd = jnp.zeros((B, 1, LIMBS), dtype=jnp.int32)
+            exec_in["kdig"] = kd
             sgid = binp["sgid"]                      # (B, S)
             active0 = binp["active"]                 # (B,)
+            prog_id = binp.get("prog_id")
+            if prog_id is None:
+                prog_id = jnp.full((B,), -1, dtype=jnp.int32)
             premapped = sgid < G                     # (B, S)
             nkeys = jnp.sum(premapped.astype(jnp.int32), axis=1)
             # entry keys gathered from the key table (OOB -> zeros)
@@ -1030,9 +1081,9 @@ def build_occ_machine(params: MachineParams, occ: OccParams):
 
             def occ_body(c):
                 rnd, pending, seeds, res, _ok, _esc, _t = c
-                st = exec_lanes(
+                st = exec_mixed(
                     exec_in, (skey0, seeds, seeds, sflag0, nkeys),
-                    pending)
+                    pending, prog_id)
                 res = {
                     f: jnp.where(
                         pending.reshape((B,) + (1,) * (res[f].ndim - 1)),
@@ -1073,11 +1124,67 @@ def build_occ_machine(params: MachineParams, occ: OccParams):
                     esc = esc.at[j].set(hosty[j] & active0[j])
                     return (t2, ok, pend2, seeds2, esc)
 
-                t2, ok, pend2, seeds2, esc = jax.lax.fori_loop(
-                    0, B, val_body,
-                    (tbl, jnp.zeros((B,), dtype=bool),
-                     jnp.zeros((B,), dtype=bool), seeds,
-                     jnp.zeros((B,), dtype=bool)))
+                # ---- vectorized validation fast path.  The B-step
+                # sequential sweep above is exact but runs a fori_loop
+                # of ~10 small ops per lane per round — the dominant
+                # kernel cost once exec is specialized.  When the
+                # block's premapped gid sets are CROSS-LANE DISJOINT
+                # (no lane reads or writes a gid another lane writes —
+                # the steady machine shape: erc20 transfers touch only
+                # their own sender/recipient rows), every prefix table
+                # a lane would validate against equals the block-start
+                # table, so validation collapses to one vector
+                # compare + one scatter, bit-identical to the sweep.
+                # Any overlap (or double-writer) falls back to the
+                # sweep, so conflicting blocks keep exact OCC
+                # semantics.
+                rflags = entry & ((res["sflag"] & F_READ) != 0) \
+                    & premapped
+                pot_w = entry & ((res["sflag"] & F_WRITTEN) != 0) \
+                    & premapped \
+                    & (~skip & ~hosty
+                       & (res["status"] == STOP))[:, None]
+                gids_w_all = jnp.where(pot_w, sgid, G).reshape(-1)
+                nw = jnp.zeros((G + 1,), jnp.int32).at[gids_w_all].add(
+                    1, mode="drop")
+                lane_ids = jnp.broadcast_to(
+                    jnp.arange(B, dtype=jnp.int32)[:, None], (B, S))
+                wlane = jnp.full((G + 1,), -1, jnp.int32).at[
+                    gids_w_all].set(lane_ids.reshape(-1), mode="drop")
+                conflict = jnp.any(nw[:G] > 1) | jnp.any(
+                    rflags & (nw.at[sgid].get(mode="fill",
+                                              fill_value=0) > 0)
+                    & (wlane.at[sgid].get(mode="fill", fill_value=-1)
+                       != lane_ids))
+
+                def fast_sweep(_):
+                    # under disjointness every lane's prefix table IS
+                    # the block-start table: validate reads against
+                    # it, apply all valid writes in one scatter, and
+                    # mirror the sweep's pending/seed updates exactly
+                    cur0 = gather(tbl, sgid)
+                    match0 = jnp.all(res["sorig"] == cur0, axis=-1)
+                    reads_ok0 = jnp.all(~rflags | match0, axis=1)
+                    valid0 = ~skip & ~hosty & reads_ok0
+                    wr0 = pot_w & valid0[:, None]
+                    t2f = tbl.at[
+                        jnp.where(wr0, sgid, G).reshape(-1)].set(
+                        res["sval"].reshape(-1, LIMBS), mode="drop")
+                    pend0 = ~skip & ~hosty & ~reads_ok0
+                    seeds2f = jnp.where(pend0[:, None, None], cur0,
+                                        seeds)
+                    return (t2f, valid0, pend0, seeds2f,
+                            hosty & active0)
+
+                def slow_sweep(_):
+                    return jax.lax.fori_loop(
+                        0, B, val_body,
+                        (tbl, jnp.zeros((B,), dtype=bool),
+                         jnp.zeros((B,), dtype=bool), seeds,
+                         jnp.zeros((B,), dtype=bool)))
+
+                t2, ok, pend2, seeds2, esc = jax.lax.cond(
+                    conflict, slow_sweep, fast_sweep, operand=None)
                 return (rnd + 1, pend2, seeds2, res, ok, esc, t2)
 
             rnd, pending, _seeds, res, committed, escape, tbl_f = \
@@ -1102,7 +1209,7 @@ def build_occ_machine(params: MachineParams, occ: OccParams):
     return occ_run
 
 
-_OCC_MACHINES: Dict[Tuple[MachineParams, OccParams], object] = {}
+_OCC_MACHINES: Dict[Tuple, object] = {}
 
 # Fused-OCC kernel builds this process has paid (each new
 # (MachineParams, OccParams) bucket = one jax trace + XLA compile).
@@ -1116,23 +1223,26 @@ def count_occ_build() -> None:
     OCC_BUILD_COUNT += 1
 
 
-def occ_compiled(params: MachineParams, occ: OccParams) -> bool:
-    """Whether the (params, occ) kernel bucket is already built — the
-    window runner distinguishes cold compiles (first dispatch of a
+def occ_compiled(params: MachineParams, occ: OccParams,
+                 spec: Tuple = ()) -> bool:
+    """Whether the (params, occ, spec) kernel bucket is already built —
+    the window runner distinguishes cold compiles (first dispatch of a
     bucket) from mid-run retraces with this."""
-    return (params, occ) in _OCC_MACHINES
+    return (params, occ, spec) in _OCC_MACHINES
 
 
-def get_occ_machine(params: MachineParams, occ: OccParams):
-    """Jitted OCC kernel memoized by (machine, occ) params.  The table
-    argument is donated on real accelerators so the window-to-window
-    table handoff aliases HBM instead of copying (CPU ignores donation
-    and would warn, so it is skipped there)."""
-    key = (params, occ)
+def get_occ_machine(params: MachineParams, occ: OccParams,
+                    spec: Tuple = ()):
+    """Jitted OCC kernel memoized by (machine, occ, specialized-
+    program-set) params.  The table argument is donated on real
+    accelerators so the window-to-window table handoff aliases HBM
+    instead of copying (CPU ignores donation and would warn, so it is
+    skipped there)."""
+    key = (params, occ, spec)
     fn = _OCC_MACHINES.get(key)
     if fn is None:
         donate = () if jax.default_backend() == "cpu" else (0,)
-        fn = jax.jit(build_occ_machine(params, occ),
+        fn = jax.jit(build_occ_machine(params, occ, spec),
                      donate_argnums=donate)
         _OCC_MACHINES[key] = fn
         count_occ_build()

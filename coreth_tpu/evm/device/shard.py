@@ -51,8 +51,9 @@ from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as T
 from coreth_tpu.evm.device.adapter import (
     PT_DISPATCH, MachineWindowRunner, _count_dispatch, _pow2, addr_word,
-    word16,
+    fill_kdig, word16, word16c,
 )
+from coreth_tpu.evm.device.specialize import KDIG_CAP
 from coreth_tpu.ops import u256
 from coreth_tpu.parallel import _shard_map, account_bucket, contract_bucket
 
@@ -85,8 +86,9 @@ def _next_seq() -> int:
 
 # blocks_in leaves whose axis 1 is the (sharded) lane axis
 _LANE_KEYS = ("code", "jdest", "code_len", "calldata", "data_len",
-              "start_gas", "active", "sgid", "callvalue", "caller_w",
-              "address_w", "origin_w", "gasprice_w")
+              "start_gas", "active", "sgid", "prog_id", "kdig",
+              "callvalue", "caller_w", "address_w", "origin_w",
+              "gasprice_w")
 # per-block (replicated) leaves
 _BLOCK_KEYS = ("timestamp", "number", "gaslimit", "coinbase_w",
                "basefee_w", "chainid_w")
@@ -101,12 +103,15 @@ _EXCHANGES: Dict[Tuple, object] = {}
 
 
 def build_sharded_occ_machine(params: M.MachineParams, occ: M.OccParams,
-                              mesh):
+                              mesh, spec: Tuple = ()):
     """Per-shard OCC: the single-chip fused kernel body runs unchanged
     on every device over its lane slice and table arena.  params.batch
     and occ.table_cap are PER-SHARD shapes; the caller passes
-    (n_shards * G, 16) tables and (W, n_shards * batch, ...) lanes."""
-    inner = M.build_occ_machine(params, occ)
+    (n_shards * G, 16) tables and (W, n_shards * batch, ...) lanes.
+    `spec` (the specialized-program set) composes transparently: the
+    per-lane prog_id selection happens inside the inner kernel body,
+    so each shard runs its own lanes' traced sub-programs."""
+    inner = M.build_occ_machine(params, occ, spec)
 
     def run(table, key_tab, blocks_in):
         return inner(table, key_tab, blocks_in)
@@ -124,17 +129,17 @@ def build_sharded_occ_machine(params: M.MachineParams, occ: M.OccParams,
 
 
 def occ_sharded_compiled(params: M.MachineParams, occ: M.OccParams,
-                         mesh) -> bool:
-    return (params, occ, _mesh_key(mesh)) in _OCC_SHARDED
+                         mesh, spec: Tuple = ()) -> bool:
+    return (params, occ, _mesh_key(mesh), spec) in _OCC_SHARDED
 
 
 def get_sharded_occ_machine(params: M.MachineParams, occ: M.OccParams,
-                            mesh):
-    key = (params, occ, _mesh_key(mesh))
+                            mesh, spec: Tuple = ()):
+    key = (params, occ, _mesh_key(mesh), spec)
     fn = _OCC_SHARDED.get(key)
     if fn is None:
         donate = () if jax.default_backend() == "cpu" else (0,)
-        fn = jax.jit(build_sharded_occ_machine(params, occ, mesh),
+        fn = jax.jit(build_sharded_occ_machine(params, occ, mesh, spec),
                      donate_argnums=donate)
         _OCC_SHARDED[key] = fn
         M.count_occ_build()
@@ -251,11 +256,13 @@ class ShardedWindowRunner(MachineWindowRunner):
         return max(len(v) for v in self.vals)
 
     # ------------------------------------------------------------ kernels
-    def _kernel(self, p, occ):
-        return get_sharded_occ_machine(p, occ, self.mesh)
+    def _kernel(self, p, occ, sk=None):
+        sk = self._spec_key() if sk is None else sk
+        return get_sharded_occ_machine(p, occ, self.mesh, sk)
 
     def _kernel_compiled(self, p, occ) -> bool:
-        return occ_sharded_compiled(p, occ, self.mesh)
+        return occ_sharded_compiled(p, occ, self.mesh,
+                                    self._spec_key())
 
     def _lane_count(self, p) -> int:
         return self.n_shards * p.batch
@@ -287,6 +294,7 @@ class ShardedWindowRunner(MachineWindowRunner):
                 if not info.eligible:
                     raise ValueError(
                         f"TxSpec code not device-eligible: {info.reason}")
+                self._spec_id(t.code)  # program set settles pre-build
                 feats |= set(info.features)
                 max_code = max(max_code, len(t.code))
                 max_data = max(max_data, len(t.calldata))
@@ -447,6 +455,9 @@ class ShardedWindowRunner(MachineWindowRunner):
         start_gas = np.zeros((W, Lp), dtype=np.int32)
         active = np.zeros((W, Lp), dtype=bool)
         sgid = np.full((W, Lp, S), G, dtype=np.int32)
+        prog_id = np.full((W, Lp), -1, dtype=np.int32)
+        kdig = np.zeros((W, Lp, KDIG_CAP, u256.LIMBS), dtype=np.int32)
+        kjobs = []
         words = {k: np.zeros((W, Lp, u256.LIMBS), dtype=np.int32)
                  for k in ("callvalue", "caller_w", "address_w",
                            "origin_w", "gasprice_w")}
@@ -466,25 +477,35 @@ class ShardedWindowRunner(MachineWindowRunner):
             chain_id = env.chain_id
             for li, t in enumerate(specs):
                 fl = lane_map[bi][li]
-                cb = np.frombuffer(t.code, dtype=np.uint8)
-                code[bi, fl, :len(cb)] = cb
-                code_len[bi, fl] = len(cb)
-                info = T.scan_code(t.code, self.fork)
-                for d in info.jumpdests:
-                    if d < p.code_cap:
-                        jdest[bi, fl, d] = 1
+                cb, jd, ln = self._code_pack(t.code, p.code_cap)
+                code[bi, fl] = cb
+                code_len[bi, fl] = ln
+                jdest[bi, fl] = jd
                 db = np.frombuffer(t.calldata, dtype=np.uint8)
                 calldata[bi, fl, :len(db)] = db
                 data_len[bi, fl] = len(db)
                 start_gas[bi, fl] = t.gas
                 active[bi, fl] = True
-                words["callvalue"][bi, fl] = word16(t.value)
-                words["caller_w"][bi, fl] = word16(addr_word(t.caller))
-                words["address_w"][bi, fl] = word16(addr_word(t.address))
-                words["origin_w"][bi, fl] = word16(addr_word(t.origin))
-                words["gasprice_w"][bi, fl] = word16(t.gas_price)
+                words["callvalue"][bi, fl] = word16c(t.value)
+                words["caller_w"][bi, fl] = word16c(addr_word(t.caller))
+                words["address_w"][bi, fl] = word16c(
+                    addr_word(t.address))
+                words["origin_w"][bi, fl] = word16c(addr_word(t.origin))
+                words["gasprice_w"][bi, fl] = word16c(t.gas_price)
+                pid = self._spec_progs.get(t.code, -1) \
+                    if self._specialize else -1
+                prog_id[bi, fl] = pid
+                if pid >= 0 and self._spec_reqs.get(t.code):
+                    kjobs.append((bi, fl, t, env,
+                                  self._spec_reqs[t.code]))
+                if attempt == 1:
+                    if pid >= 0:
+                        self.lanes_specialized += 1
+                    elif self._specialize:
+                        self.specialize_escapes += 1
                 for j, key in enumerate(block_pre[li]):
                     sgid[bi, fl, j] = self._gid(t.address, key)
+        fill_kdig(kdig, kjobs)
         table, key_tab = self._device_tables(G)
         active_j = jnp.asarray(active)
         inputs = dict(
@@ -494,6 +515,8 @@ class ShardedWindowRunner(MachineWindowRunner):
             data_len=jnp.asarray(data_len),
             start_gas=jnp.asarray(start_gas),
             active=active_j, sgid=jnp.asarray(sgid),
+            prog_id=jnp.asarray(prog_id),
+            kdig=jnp.asarray(kdig),
             callvalue=jnp.asarray(words["callvalue"]),
             caller_w=jnp.asarray(words["caller_w"]),
             address_w=jnp.asarray(words["address_w"]),

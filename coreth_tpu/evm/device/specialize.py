@@ -1,0 +1,1165 @@
+"""Per-contract traced specialization: bytecode -> straight-line JAX.
+
+The generic step machine (machine._build_exec) pays an opcode-switch
+per step: every compiled op family evaluates (or cond-gates) on every
+iteration of the while_loop, because the program cannot know which
+opcode any lane executes next.  But machine-eligible workloads are
+dominated by a handful of HOT CONTRACTS whose bytecode is static — the
+DTVM / EVMx observation (arXiv 2504.16552, 2507.23518): specialize per
+contract and the dispatch loop disappears.
+
+This module traces a contract's bytecode ONCE, at kernel-build time,
+into a straight-line jnp program:
+
+- the opcode switch is eliminated — each traced step emits exactly the
+  tensor ops that opcode needs, nothing else;
+- PUSH constants fold at trace time (including through arithmetic, so
+  computed jump targets and constant storage keys resolve statically;
+  a fully-constant KECCAK folds to its digest on the host);
+- the jump structure resolves at trace time: constant-condition
+  branches follow deterministically, data-dependent branches fork the
+  trace into per-path straight-line segments selected by a runtime
+  mask (both arms execute batch-wise, results merge by the condition —
+  the classic predication transform), and loops unroll under a bounded
+  step/leaf budget;
+- storage stays on the existing premap machinery: the traced SLOAD /
+  SSTORE ops run the same lane-cache search + EIP-2929/2200/3529 gas
+  ladder as the generic kernel against the premapped global-table
+  seeds, so predicted premaps, miss-and-rerun discovery (F_MISS), and
+  the OCC validation sweep all work unchanged.
+
+Anything the tracer cannot resolve — an unresolvable (symbolic) jump
+target, an op outside the traced subset, unbounded unrolling, a
+non-constant memory offset — raises :class:`TraceIneligible`; such
+code simply stays on the generic interpreter kernel (the escape hatch,
+counted by the adapter as ``specialize_escapes``).  Runtime capacity
+escapes (storage-cache overflow) mark the lane HOST exactly like the
+generic kernel.
+
+Equivalence contract: for eligible bytecode the traced program is
+bit-identical to the generic kernel — same statuses, gas, refunds,
+logs, storage cache layout (flags included) — pinned by the
+spec-vs-generic root-equivalence suite (tests/test_specialize.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from coreth_tpu.crypto import keccak256
+from coreth_tpu.evm import census
+from coreth_tpu.evm.device import machine as M
+from coreth_tpu.evm.device import tables as T
+from coreth_tpu.ops import u256, u256x
+from coreth_tpu.ops.keccak import keccak256_blocks
+from coreth_tpu.params import protocol as P
+
+LIMBS = u256.LIMBS
+U256_MASK = (1 << 256) - 1
+
+# trace budgets: a path longer than MAX_PATH_STEPS (a loop that does
+# not unroll within the budget) or a program with more than MAX_LEAVES
+# straight-line segments (branch explosion) is trace-ineligible
+MAX_PATH_STEPS = 512
+MAX_TOTAL_STEPS = 4096
+MAX_LEAVES = 16
+
+# caps the traced program is validated against (the MachineParams
+# floors — these dimensions never re-bucket, see adapter._occ_params)
+_STACK_CAP = 64
+_MEM_CAP = 4096
+_LOG_CAP = 8
+_LOG_DATA_CAP = 160
+_KECCAK_CAP = 272
+
+
+class TraceIneligible(Exception):
+    """Bytecode the specializer cannot compile to a straight-line
+    program; the lane set stays on the generic interpreter kernel."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+@dataclass(frozen=True)
+class SpecProgram:
+    """Hashable kernel-key descriptor of one specialized contract
+    (the traced closure itself is rebuilt per MachineParams bucket)."""
+    code: bytes
+    fork: str
+
+
+# opcodes the tracer can emit (census.trace_precheck pre-filter; the
+# symbolic walk itself may still reject — e.g. symbolic jump targets)
+SPEC_OPCODES = frozenset(
+    [0x00, 0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07, 0x08, 0x09,
+     0x0A, 0x0B, 0x10, 0x11, 0x12, 0x13, 0x14, 0x15, 0x16, 0x17,
+     0x18, 0x19, 0x1A, 0x1B, 0x1C, 0x1D, 0x20, 0x30, 0x32, 0x33,
+     0x34, 0x35, 0x36, 0x38, 0x3A, 0x41, 0x42, 0x43, 0x44, 0x45,
+     0x46, 0x48, 0x50, 0x51, 0x52, 0x54, 0x55, 0x56, 0x57, 0x58,
+     0x59, 0x5A, 0x5B, 0xF3, 0xFD, 0xFE]
+    + list(range(0x5F, 0xA5)))  # PUSH0-32, DUP, SWAP, LOG0-4
+
+
+def _word16_np(v: int) -> np.ndarray:
+    return np.frombuffer(
+        (v & U256_MASK).to_bytes(32, "little"),
+        dtype=np.uint16).astype(np.int32)
+
+
+class _SV:
+    """Symbolic stack value: a trace-time constant, a runtime (B, 16)
+    limb tensor, or (abstract mode) an opaque symbol.
+
+    ``src`` is host-evaluation provenance: ("ctx", op) for a context
+    word the issue path knows per lane, ("data", off) for a
+    calldataload word, ("kdig", k) for an already-requested digest.
+    It survives only on pristine words (any arithmetic drops it) and
+    feeds the keccak-request machinery below."""
+
+    __slots__ = ("const", "t", "src")
+
+    def __init__(self, const: Optional[int] = None, t=None, src=None):
+        self.const = const if const is None else (const & U256_MASK)
+        self.t = t
+        self.src = src
+
+
+_SYM = _SV()  # the shared abstract unknown
+
+# context ops whose 256-bit word the ISSUE path can reproduce exactly
+# from (TxSpec, BlockEnv) — full-width device inputs only (timestamp /
+# number / gaslimit are int32-clamped device scalars, so they stay off
+# the list to keep host and device digests bit-identical by
+# construction)
+HOST_CTX = frozenset((0x30, 0x32, 0x33, 0x34, 0x3A, 0x41, 0x46, 0x48))
+
+# per-lane host-evaluated digest slots fed to the kernel as the `kdig`
+# input (W, B, KDIG_CAP, 16); programs needing more fall back to the
+# in-kernel keccak for the overflow requests
+KDIG_CAP = 8
+
+# const-folding rules (must match the machine/u256x semantics exactly:
+# a folded constant REPLACES the runtime computation)
+def _fold2(op: int, a: int, b: int) -> Optional[int]:
+    if op == 0x01:
+        return a + b
+    if op == 0x02:
+        return a * b
+    if op == 0x03:
+        return a - b
+    if op == 0x04:
+        return a // b if b else 0
+    if op == 0x06:
+        return a % b if b else 0
+    if op == 0x10:
+        return int(a < b)
+    if op == 0x11:
+        return int(a > b)
+    if op == 0x14:
+        return int(a == b)
+    if op == 0x16:
+        return a & b
+    if op == 0x17:
+        return a | b
+    if op == 0x18:
+        return a ^ b
+    if op == 0x1B:  # SHL: a = shift, b = value
+        return (b << a) if a < 256 else 0
+    if op == 0x1C:  # SHR
+        return (b >> a) if a < 256 else 0
+    if op == 0x1A:  # BYTE: a = index, b = value
+        return (b >> (8 * (31 - a))) & 0xFF if a < 32 else 0
+    return None
+
+
+class _Path:
+    """One straight-line trace segment's threaded state.  Static parts
+    (stack of _SVs, word-aligned memory model, msize, accumulated
+    constant gas) live as Python values; runtime parts (gas, err/hosty
+    masks, the storage cache, the log pool) are jnp tensors in concrete
+    mode and None in abstract (eligibility) mode."""
+
+    __slots__ = ("stack", "mem", "msize", "accum", "steps", "pmask",
+                 "gas", "err", "hosty", "host_reason", "refund",
+                 "st5", "logs", "log_cnt", "nlogs")
+
+    def clone(self) -> "_Path":
+        p = _Path()
+        p.stack = list(self.stack)
+        p.mem = dict(self.mem)
+        p.msize = self.msize
+        p.accum = self.accum
+        p.steps = self.steps
+        p.pmask = self.pmask
+        p.gas = self.gas
+        p.err = self.err
+        p.hosty = self.hosty
+        p.host_reason = self.host_reason
+        p.refund = self.refund
+        p.st5 = self.st5
+        p.logs = self.logs
+        p.log_cnt = self.log_cnt
+        p.nlogs = self.nlogs
+        return p
+
+
+class _Tracer:
+    """Symbolic executor over one bytecode.  ``emit=False`` runs the
+    abstract (eligibility) walk — identical control decisions, no
+    tensors; ``emit=True`` builds the jnp program at JAX trace time."""
+
+    def __init__(self, code: bytes, fork: str,
+                 params: Optional[M.MachineParams] = None,
+                 inputs=None, storage=None, active=None):
+        self.code = code
+        self.fork = fork
+        self.p = params
+        self.emit = params is not None
+        self.inputs = inputs
+        self.active = active
+        ot = T.op_tables(fork)
+        self.CONST = ot.const_gas
+        self.NIN = ot.nin
+        self.NOUT = ot.nout
+        self.SUP = ot.supported
+        from coreth_tpu.evm.interpreter import analyze_jumpdests
+        self.jumpdests = set(analyze_jumpdests(code))
+        self.total_steps = 0
+        self.leaves: List[Tuple[object, dict]] = []
+        # host-evaluated keccak requests, discovered in the SAME order
+        # by the abstract walk (trace_eligible publishes them via
+        # spec_requests) and the emit walk (which reads kdig slots) —
+        # the walks traverse identical paths, so the indices agree
+        self.kreqs: List[Tuple] = []
+        self._kreq_idx: Dict[Tuple, int] = {}
+        if self.emit:
+            p = self.p
+            self.B = p.batch
+            self.S = p.scache_cap
+            self.rows = jnp.arange(self.B)
+            self.storage0 = storage
+        else:
+            self.B = 0
+            self.S = 0
+
+    # ------------------------------------------------------------ values
+    def _t(self, sv: _SV):
+        """Materialize an _SV as a (B, 16) limb tensor (concrete)."""
+        if sv.t is not None:
+            return sv.t
+        return jnp.broadcast_to(
+            jnp.asarray(_word16_np(sv.const)), (self.B, LIMBS))
+
+    def _const_sv(self, v: int) -> _SV:
+        return _SV(const=v)
+
+    def _bin(self, op: int, a: _SV, b: _SV) -> _SV:
+        if a.const is not None and b.const is not None:
+            f = _fold2(op, a.const, b.const)
+            if f is not None:
+                return _SV(const=f)
+        if not self.emit:
+            return _SYM
+        ta, tb = self._t(a), self._t(b)
+        if op == 0x01:
+            return _SV(t=u256.add(ta, tb))
+        if op == 0x02:
+            return _SV(t=u256x.mul(ta, tb))
+        if op == 0x03:
+            return _SV(t=u256.sub(ta, tb))
+        if op in (0x04, 0x05, 0x06, 0x07):
+            return _SV(t=self._div_like(op, ta, tb))
+        if op == 0x10:
+            return _SV(t=u256x.bool_word(u256x.lt(ta, tb)))
+        if op == 0x11:
+            return _SV(t=u256x.bool_word(u256x.gt(ta, tb)))
+        if op == 0x12:
+            return _SV(t=u256x.bool_word(u256x.slt(ta, tb)))
+        if op == 0x13:
+            return _SV(t=u256x.bool_word(u256x.sgt(ta, tb)))
+        if op == 0x14:
+            return _SV(t=u256x.bool_word(u256x.eq(ta, tb)))
+        if op == 0x16:
+            return _SV(t=ta & tb)
+        if op == 0x17:
+            return _SV(t=ta | tb)
+        if op == 0x18:
+            return _SV(t=ta ^ tb)
+        if op == 0x0B:  # SIGNEXTEND(b=index a, x=value b)
+            return _SV(t=u256x.signextend(ta, tb))
+        if op == 0x1A:  # BYTE(i=a, x=b)
+            return _SV(t=u256x.byte_op(ta, tb))
+        if op == 0x1B:  # SHL: value b shifted by a
+            return _SV(t=u256x.shl(tb, ta))
+        if op == 0x1C:
+            return _SV(t=u256x.shr(tb, ta))
+        if op == 0x1D:
+            return _SV(t=u256x.sar(tb, ta))
+        raise TraceIneligible(f"binop 0x{op:02x}")  # pragma: no cover
+
+    def _div_like(self, op: int, a, b):
+        """Mirror of the machine's div family for one op."""
+        signed = op in (0x05, 0x07)
+        xa = u256x._abs(a) if signed else a
+        xb = u256x._abs(b) if signed else b
+        q, r = u256x.divmod_(xa, xb)
+        if not signed:
+            return q if op == 0x04 else r
+        neg_q = (u256x._sign(a) ^ u256x._sign(b)) == 1
+        neg_r = u256x._sign(a) == 1
+        if op == 0x05:
+            return jnp.where(neg_q[:, None], u256x.neg(q), q)
+        return jnp.where(neg_r[:, None], u256x.neg(r), r)
+
+    # ------------------------------------------------------------- gas
+    def _live(self, path: _Path):
+        return path.pmask & ~path.err & ~path.hosty
+
+    def _flush(self, path: _Path) -> None:
+        """Charge the accumulated constant gas of the pure steps since
+        the last effectful op.  Lumping is exact: for a run of
+        non-negative per-step costs, some prefix OOGs iff the total
+        exceeds gas, and a pure step's value can only escape through a
+        later (masked) effectful op."""
+        if path.accum == 0 or not self.emit:
+            path.accum = 0
+            return
+        live = self._live(path)
+        oog = live & (path.gas < path.accum)
+        path.gas = jnp.where(live & ~oog, path.gas - path.accum,
+                             path.gas)
+        path.err = path.err | oog
+        path.accum = 0
+
+    def _charge(self, path: _Path, cost: int):
+        """Flush + charge one effectful step's static cost; returns the
+        ok mask (lanes that afford it; OOG lanes err)."""
+        self._flush(path)
+        if not self.emit:
+            return None
+        live = self._live(path)
+        oog = live & (path.gas < cost)
+        ok = live & ~oog
+        path.gas = jnp.where(ok, path.gas - cost, path.gas)
+        path.err = path.err | oog
+        return ok
+
+    def _mem_expand(self, path: _Path, need: int) -> int:
+        """Static memory-expansion gas for a constant byte demand."""
+        if need <= 0:
+            return 0
+        if need > _MEM_CAP:
+            raise TraceIneligible(f"memory demand {need} > cap")
+        new = max(path.msize, M._ceil32(need))
+        cost = (M._mem_cost_words(new // 32)
+                - M._mem_cost_words(path.msize // 32))
+        path.msize = new
+        return int(cost)
+
+    # ---------------------------------------------------------- memory
+    def _mem_word(self, path: _Path, off: int) -> _SV:
+        return path.mem.get(off, _SV(const=0))
+
+    def _mem_bytes(self, path: _Path, off: int, size: int):
+        """(B, size) byte tensor of the memory model at [off, off+size)
+        (concrete), or None when every byte is a constant — then the
+        second return is the constant bytes."""
+        w0 = off // 32
+        w1 = (off + size + 31) // 32
+        svs = [self._mem_word(path, 32 * w) for w in range(w0, w1)]
+        if all(sv.const is not None for sv in svs):
+            blob = b"".join(sv.const.to_bytes(32, "big") for sv in svs)
+            s = off - 32 * w0
+            return None, blob[s:s + size]
+        cols = jnp.concatenate(
+            [M._limbs_to_bytes(self._t(sv)) for sv in svs], axis=1)
+        s = off - 32 * w0
+        return cols[:, s:s + size], None
+
+    # ---------------------------------------------------------- keccak
+    def _kreq_of(self, path: _Path, off: int, size: int):
+        """Host-evaluable keccak request index, or None.
+
+        A keccak whose input words are all pristine context words,
+        calldata words, constants, or earlier requested digests can be
+        computed by the ISSUE path per lane (one C++ batch per window)
+        instead of on device — a device keccak costs a full 24-round
+        permutation over (B, 34) words PER LEAF, the single most
+        expensive emitted construct (the erc20 mapping keys).  The
+        host evaluates the exact same bytes the device would, so the
+        digest is identical by construction.  All-const inputs return
+        None so both walks leave them to the const-folder."""
+        if off % 32 or size % 32 or size == 0:
+            return None
+        w0 = off // 32
+        desc, any_src = [], False
+        for w in range(w0, w0 + size // 32):
+            sv = self._mem_word(path, 32 * w)
+            if sv.const is not None:
+                desc.append(("const", sv.const))
+            elif sv.src is not None:
+                desc.append(sv.src)
+                any_src = True
+            else:
+                return None
+        if not any_src:
+            return None  # pure-const: the fold path owns it
+        key = tuple(desc)
+        k = self._kreq_idx.get(key)
+        if k is None:
+            if len(self.kreqs) >= KDIG_CAP:
+                return None  # overflow: in-kernel keccak fallback
+            k = len(self.kreqs)
+            self._kreq_idx[key] = k
+            self.kreqs.append(key)
+        return k
+
+    def _keccak(self, path: _Path, off: int, size: int) -> _SV:
+        if size > _KECCAK_CAP - 1:
+            raise TraceIneligible(f"keccak size {size} > cap")
+        if not self.emit:
+            # abstract: constness of the digest matches concrete mode
+            w0, w1 = off // 32, (off + size + 31) // 32
+            svs = [self._mem_word(path, 32 * w) for w in range(w0, w1)]
+            if size and all(sv.const is not None for sv in svs):
+                blob = b"".join(
+                    sv.const.to_bytes(32, "big") for sv in svs)
+                s = off - 32 * w0
+                return _SV(const=int.from_bytes(
+                    keccak256(blob[s:s + size]), "big"))
+            if size == 0:
+                return _SV(const=int.from_bytes(keccak256(b""), "big"))
+            k = self._kreq_of(path, off, size)
+            if k is not None:
+                return _SV(src=("kdig", k))
+            return _SYM
+        if size == 0:
+            return _SV(const=int.from_bytes(keccak256(b""), "big"))
+        k = self._kreq_of(path, off, size)
+        if k is not None:
+            return _SV(t=self.inputs["kdig"][:, k],
+                       src=("kdig", k))
+        data, const_blob = self._mem_bytes(path, off, size)
+        if const_blob is not None:
+            return _SV(const=int.from_bytes(keccak256(const_blob),
+                                            "big"))
+        B = self.B
+        nb = size // 136 + 1
+        buf = jnp.zeros((B, nb * 136), dtype=jnp.int32)
+        buf = buf.at[:, :size].set(data)
+        bu = buf.astype(jnp.uint32)
+        words = (bu[:, 0::4] | (bu[:, 1::4] << 8)
+                 | (bu[:, 2::4] << 16) | (bu[:, 3::4] << 24))
+        # pad10*1 with a STATIC message length
+        pad = np.zeros((nb * 34,), dtype=np.uint32)
+        pad[size // 4] ^= np.uint32(1) << ((size % 4) * 8)
+        pad[nb * 34 - 1] ^= np.uint32(0x80000000)
+        words = words ^ jnp.asarray(pad)[None, :]
+        blocks = words.reshape(B, nb, 34)
+        digest = keccak256_blocks(blocks, jnp.full((B,), nb,
+                                                   dtype=jnp.int32))
+        return _SV(t=M._words8_to_limbs(digest))
+
+    # --------------------------------------------------------- storage
+    def _storage_op(self, path: _Path, key: _SV, new: Optional[_SV],
+                    op: int) -> Optional[_SV]:
+        """One SLOAD/SSTORE against the lane cache — the single-op twin
+        of the machine's storage_family (entry creation incl. F_MISS on
+        OOG, EIP-2929 warm/cold, the EIP-2200/3529 ladder + sentry,
+        cache-full HOST escape)."""
+        is_sstore = op == 0x55
+        if key.const is not None:
+            key = _SV(const=key.const & ~(1 << 248))
+        if not self.emit:
+            return None if is_sstore else _SYM
+        self._flush(path)
+        p, S, B, rows = self.p, self.S, self.B, self.rows
+        kt = self._t(key)
+        if key.const is None:
+            kt = kt.at[:, LIMBS - 1].set(kt[:, LIMBS - 1] & 0xFEFF)
+        skey, sval, sorig, sflag, scnt = path.st5
+        mask_any = self._live(path)
+        hit = jnp.all(skey == kt[:, None, :], axis=-1) \
+            & ((sflag & M.F_VALID) != 0)
+        found = jnp.any(hit, axis=-1)
+        hidx = jnp.argmax(hit, axis=-1)
+        need_app = mask_any & ~found
+        full = need_app & (scnt >= S)
+        eidx = jnp.where(found, hidx, jnp.clip(scnt, 0, S - 1))
+        eflag = sflag[rows, eidx]
+        warm = found & ((eflag & M.F_WARM) != 0)
+        cur = jnp.where(found[:, None], sval[rows, eidx], 0)
+        orig = jnp.where(found[:, None], sorig[rows, eidx], 0)
+        gas = path.gas
+        rd = jnp.zeros((B,), dtype=jnp.int32)
+        sentry = jnp.zeros((B,), dtype=bool)
+        if not is_sstore:
+            cost = int(self.CONST[op]) + jnp.where(
+                warm, P.WARM_STORAGE_READ_COST_EIP2929,
+                P.COLD_SLOAD_COST_EIP2929)
+        else:
+            nt = self._t(new)
+            sentry = mask_any & (gas <= P.SSTORE_SENTRY_GAS_EIP2200)
+            cold_sur = jnp.where(warm, 0, P.COLD_SLOAD_COST_EIP2929)
+            eq_cn = u256x.eq(cur, nt)
+            eq_oc = u256x.eq(orig, cur)
+            eq_on = u256x.eq(orig, nt)
+            o_zero = u256.is_zero(orig)
+            c_zero = u256.is_zero(cur)
+            n_zero = u256.is_zero(nt)
+            base = jnp.where(
+                eq_cn, P.WARM_STORAGE_READ_COST_EIP2929,
+                jnp.where(
+                    eq_oc,
+                    jnp.where(o_zero, P.SSTORE_SET_GAS_EIP2200,
+                              P.SSTORE_RESET_GAS_EIP2200
+                              - P.COLD_SLOAD_COST_EIP2929),
+                    P.WARM_STORAGE_READ_COST_EIP2929))
+            cost = int(self.CONST[op]) + cold_sur + base
+            if self.p.refunds:
+                CL = P.SSTORE_CLEARS_SCHEDULE_REFUND_EIP3529
+                dirty = ~eq_cn & ~eq_oc
+                rd = rd + jnp.where(
+                    ~eq_cn & eq_oc & ~o_zero & n_zero, CL, 0)
+                rd = rd + jnp.where(dirty & ~o_zero & c_zero, -CL, 0)
+                rd = rd + jnp.where(
+                    dirty & ~o_zero & ~c_zero & n_zero, CL, 0)
+                rd = rd + jnp.where(
+                    dirty & eq_on & o_zero,
+                    P.SSTORE_SET_GAS_EIP2200
+                    - P.WARM_STORAGE_READ_COST_EIP2929, 0)
+                rd = rd + jnp.where(
+                    dirty & eq_on & ~o_zero,
+                    P.SSTORE_RESET_GAS_EIP2200
+                    - P.COLD_SLOAD_COST_EIP2929
+                    - P.WARM_STORAGE_READ_COST_EIP2929, 0)
+        afford = gas >= cost
+        do_entry = mask_any & ~full
+        do_write = do_entry & ~sentry & afford
+        wflag = eflag | M.F_VALID | M.F_READ | M.F_WARM
+        wflag = jnp.where(need_app, wflag | M.F_MISS, wflag)
+        if is_sstore:
+            wflag = jnp.where(do_write, wflag | M.F_WRITTEN, wflag)
+        nkey = jnp.where((do_entry & need_app)[:, None], kt,
+                         skey[rows, eidx])
+        base_v = jnp.where((do_entry & need_app)[:, None], 0,
+                           sval[rows, eidx])
+        if is_sstore:
+            nval = jnp.where(do_write[:, None], self._t(new), base_v)
+        else:
+            nval = base_v
+        nori = jnp.where((do_entry & need_app)[:, None], 0,
+                         sorig[rows, eidx])
+        eidx_w = jnp.where(do_entry, eidx, S)
+        skey2 = skey.at[rows, eidx_w].set(nkey, mode="drop")
+        sval2 = sval.at[rows, eidx_w].set(nval, mode="drop")
+        sorig2 = sorig.at[rows, eidx_w].set(nori, mode="drop")
+        sflag2 = sflag.at[rows, eidx_w].set(
+            jnp.where(do_entry, wflag, 0), mode="drop")
+        scnt2 = scnt + (do_entry & need_app).astype(jnp.int32)
+        path.st5 = (skey2, sval2, sorig2, sflag2, scnt2)
+        # step resolution (mirrors the machine's final gas/status stage)
+        oog = mask_any & ~afford
+        err_new = mask_any & (sentry | oog)
+        host_new = mask_any & ~err_new & full
+        ok = mask_any & ~err_new & ~host_new
+        path.gas = jnp.where(ok, gas - cost, gas)
+        path.refund = path.refund + jnp.where(ok, rd, 0)
+        path.err = path.err | err_new
+        path.hosty = path.hosty | host_new
+        path.host_reason = jnp.where(host_new, M.R_SCACHE,
+                                     path.host_reason)
+        if is_sstore:
+            return None
+        return _SV(t=jnp.where(found[:, None], cur, 0))
+
+    # ------------------------------------------------------------- logs
+    def _log_op(self, path: _Path, off: int, size: int,
+                topics: List[_SV], op: int) -> None:
+        if size > _LOG_DATA_CAP:
+            raise TraceIneligible(f"log data {size} > cap")
+        if path.nlogs >= _LOG_CAP:
+            raise TraceIneligible("log pool overflow")
+        path.nlogs += 1
+        n = len(topics)
+        cost = (int(self.CONST[op]) + P.LOG_GAS
+                + n * P.LOG_TOPIC_GAS + size * P.LOG_DATA_GAS
+                + self._mem_expand(path, off + size if size else 0))
+        if not self.emit:
+            return
+        ok = self._charge(path, cost)
+        p, B, rows = self.p, self.B, self.rows
+        LC, LD = p.log_cap, p.log_data_cap
+        tws = [self._t(t) for t in topics]
+        tws += [jnp.zeros((B, LIMBS), dtype=jnp.int32)] * (4 - n)
+        tw = jnp.stack(tws, axis=1)
+        if size:
+            data, const_blob = self._mem_bytes(path, off, size)
+            if const_blob is not None:
+                data = jnp.broadcast_to(jnp.asarray(
+                    np.frombuffer(const_blob, dtype=np.uint8
+                                  ).astype(np.int32)), (B, size))
+        else:
+            data = jnp.zeros((B, 0), dtype=jnp.int32)
+        dsrc = jnp.zeros((B, LD), dtype=jnp.int32)
+        dsrc = dsrc.at[:, :size].set(data)
+        log_top, log_nt, log_data, log_dlen = path.logs
+        slot = jnp.where(ok, jnp.clip(path.log_cnt, 0, LC - 1), LC)
+        log_top = log_top.at[rows, slot].set(tw, mode="drop")
+        log_nt = log_nt.at[rows, slot].set(n, mode="drop")
+        log_data = log_data.at[rows, slot].set(dsrc, mode="drop")
+        log_dlen = log_dlen.at[rows, slot].set(size, mode="drop")
+        path.logs = (log_top, log_nt, log_data, log_dlen)
+        path.log_cnt = path.log_cnt + ok.astype(jnp.int32)
+
+    # ----------------------------------------------------------- leaves
+    def _leaf(self, path: _Path, base_status: int) -> None:
+        self._flush(path)
+        if len(self.leaves) >= MAX_LEAVES:
+            raise TraceIneligible("leaf budget exceeded")
+        if not self.emit:
+            self.leaves.append((None, {}))
+            return
+        B = self.B
+        status = jnp.full((B,), base_status, dtype=jnp.int32)
+        status = jnp.where(path.err, M.ERR, status)
+        status = jnp.where(path.hosty, M.HOST, status)
+        gas = jnp.where(status == M.ERR, 0, path.gas)
+        skey, sval, sorig, sflag, scnt = path.st5
+        log_top, log_nt, log_data, log_dlen = path.logs
+        self.leaves.append((path.pmask, dict(
+            status=status, gas=gas, refund=path.refund,
+            host_reason=path.host_reason, scnt=scnt, sflag=sflag,
+            skey=skey, sval=sval, sorig=sorig, log_top=log_top,
+            log_nt=log_nt, log_data=log_data, log_dlen=log_dlen,
+            log_cnt=path.log_cnt)))
+
+    def _leaf_err(self, path: _Path) -> None:
+        """Terminal static error (bad jump, underflow, undefined op):
+        every live lane errs — the failing step's gas is NOT charged
+        (machine: err lanes skip the deduction; ERR zeroes gas)."""
+        self._flush(path)
+        if self.emit:
+            path.err = path.err | self._live(path)
+        self._leaf(path, M.ERR)
+
+    def _leaf_host(self, path: _Path, reason: int) -> None:
+        """Terminal static HOST escape (host-only opcode, stack over
+        the machine cap): live lanes escape without paying the step."""
+        self._flush(path)
+        if self.emit:
+            live = self._live(path)
+            path.hosty = path.hosty | live
+            path.host_reason = jnp.where(live, reason,
+                                         path.host_reason)
+        self._leaf(path, M.HOST)
+
+    # ------------------------------------------------------------- walk
+    def _ctx_sv(self, op: int) -> _SV:
+        if not self.emit:
+            if op == 0x38:
+                return _SV(const=len(self.code))
+            if op == 0x44:
+                return _SV(const=1)
+            if op in HOST_CTX:
+                return _SV(src=("ctx", op))
+            return _SYM
+        inp, B = self.inputs, self.B
+        src = ("ctx", op) if op in HOST_CTX else None
+        if op == 0x30:
+            return _SV(t=inp["address_w"], src=src)
+        if op == 0x32:
+            return _SV(t=inp["origin_w"], src=src)
+        if op == 0x33:
+            return _SV(t=inp["caller_w"], src=src)
+        if op == 0x34:
+            return _SV(t=inp["callvalue"], src=src)
+        if op == 0x36:
+            return _SV(t=M.word_of_scalar(inp["data_len"], (B,)))
+        if op == 0x38:
+            return _SV(const=len(self.code))
+        if op == 0x3A:
+            return _SV(t=inp["gasprice_w"], src=src)
+        if op == 0x41:
+            return _SV(t=jnp.broadcast_to(inp["coinbase_w"],
+                                          (B, LIMBS)), src=src)
+        if op == 0x42:
+            return _SV(t=M.word_of_scalar(
+                jnp.broadcast_to(inp["timestamp"], (B,)), (B,)))
+        if op == 0x43:
+            return _SV(t=M.word_of_scalar(
+                jnp.broadcast_to(inp["number"], (B,)), (B,)))
+        if op == 0x44:
+            return _SV(const=1)
+        if op == 0x45:
+            return _SV(t=M.word_of_scalar(
+                jnp.broadcast_to(inp["gaslimit"], (B,)), (B,)))
+        if op == 0x46:
+            return _SV(t=jnp.broadcast_to(inp["chainid_w"],
+                                          (B, LIMBS)), src=src)
+        if op == 0x48:
+            return _SV(t=jnp.broadcast_to(inp["basefee_w"],
+                                          (B, LIMBS)), src=src)
+        raise TraceIneligible(f"context op 0x{op:02x}")
+
+    def _calldataload(self, path: _Path, off: int) -> _SV:
+        if off >= M._LIMIT_25:
+            return _SV(const=0)  # machine: ~a_fit -> all-zero word
+        if not self.emit:
+            return _SV(src=("data", off))
+        p, B = self.p, self.B
+        inp = self.inputs
+        idx = np.clip(np.arange(off + 31, off - 1, -1), 0,
+                      p.data_cap - 1)
+        valid = np.arange(off + 31, off - 1, -1) < p.data_cap
+        cd = self.inputs["calldata"][:, jnp.asarray(idx)]
+        in_len = (jnp.arange(off + 31, off - 1, -1)[None, :]
+                  < inp["data_len"][:, None])
+        cd = jnp.where(jnp.asarray(valid)[None, :] & in_len, cd, 0)
+        return _SV(t=jnp.stack(
+            [cd[:, 2 * l] | (cd[:, 2 * l + 1] << 8)
+             for l in range(LIMBS)], axis=-1), src=("data", off))
+
+    def _run(self, pc: int, path: _Path) -> None:
+        """Trace one straight-line segment from `pc`; forks recurse."""
+        code = self.code
+        n = len(code)
+        while True:
+            if path.steps > MAX_PATH_STEPS \
+                    or self.total_steps > MAX_TOTAL_STEPS:
+                raise TraceIneligible("step budget exceeded")
+            path.steps += 1
+            self.total_steps += 1
+            if pc >= n:
+                self._leaf(path, M.STOP)  # zero-padded code: STOP
+                return
+            op = code[pc]
+            sup = int(self.SUP[op])
+            if sup == 0:
+                self._leaf_err(path)     # undefined: INVALID-style
+                return
+            nin, nout = int(self.NIN[op]), int(self.NOUT[op])
+            if len(path.stack) < nin:
+                self._leaf_err(path)     # static underflow
+                return
+            if len(path.stack) - nin + nout > _STACK_CAP:
+                self._leaf_host(path, M.R_STACK)
+                return
+            if sup == 2:
+                self._leaf_host(path, M.R_OPCODE)
+                return
+            cg = int(self.CONST[op])
+            st = path.stack
+
+            # ---- terminals
+            if op == 0x00:               # STOP
+                path.accum += cg
+                self._leaf(path, M.STOP)
+                return
+            if op in (0xF3, 0xFD):       # RETURN / REVERT
+                a, b = st.pop(), st.pop()
+                if a.const is None or b.const is None:
+                    raise TraceIneligible("symbolic return offset")
+                size = b.const
+                need = a.const + size if size else 0
+                if need >= M._LIMIT_25:
+                    self._leaf_err(path)  # m_oog
+                    return
+                self._charge(path,
+                             cg + self._mem_expand(path, need))
+                self._leaf(path, M.STOP if op == 0xF3 else M.REVERT)
+                return
+            if op == 0xFE:               # INVALID
+                self._leaf_err(path)
+                return
+
+            # ---- jumps
+            if op == 0x56:               # JUMP
+                a = st.pop()
+                if a.const is None:
+                    raise TraceIneligible("unresolvable jump target")
+                if a.const not in self.jumpdests:
+                    self._leaf_err(path)
+                    return
+                path.accum += cg
+                pc = a.const
+                continue
+            if op == 0x57:               # JUMPI
+                a, b = st.pop(), st.pop()
+                if a.const is None:
+                    raise TraceIneligible("unresolvable jump target")
+                if b.const is not None:
+                    if b.const:
+                        if a.const not in self.jumpdests:
+                            self._leaf_err(path)
+                            return
+                        path.accum += cg
+                        pc = a.const
+                    else:
+                        path.accum += cg
+                        pc += 1
+                    continue
+                # data-dependent branch: fork the trace (predication)
+                taken = path.clone()
+                if self.emit:
+                    nz = ~u256.is_zero(self._t(b))
+                    taken.pmask = path.pmask & nz
+                    path.pmask = path.pmask & ~nz
+                if a.const not in self.jumpdests:
+                    self._leaf_err(taken)
+                else:
+                    taken.accum += cg
+                    self._run(a.const, taken)
+                path.accum += cg
+                pc += 1
+                continue
+
+            # ---- pushes / stack shuffles
+            if op == 0x5F:               # PUSH0
+                path.accum += cg
+                st.append(_SV(const=0))
+                pc += 1
+                continue
+            if 0x60 <= op <= 0x7F:       # PUSH1-32
+                ln = op - 0x5F
+                # zero-pad truncated immediates like the machine's
+                # zero-padded code tensor
+                v = int.from_bytes(
+                    code[pc + 1:pc + 1 + ln].ljust(ln, b"\x00"), "big")
+                path.accum += cg
+                st.append(_SV(const=v))
+                pc += 1 + ln
+                continue
+            if 0x80 <= op <= 0x8F:       # DUP1-16
+                path.accum += cg
+                st.append(st[-1 - (op - 0x80)])
+                pc += 1
+                continue
+            if 0x90 <= op <= 0x9F:       # SWAP1-16
+                k = op - 0x8F
+                path.accum += cg
+                st[-1], st[-1 - k] = st[-1 - k], st[-1]
+                pc += 1
+                continue
+            if op == 0x50:               # POP
+                path.accum += cg
+                st.pop()
+                pc += 1
+                continue
+
+            # ---- memory
+            if op == 0x52:               # MSTORE
+                a, b = st.pop(), st.pop()
+                if a.const is None:
+                    raise TraceIneligible("symbolic memory offset")
+                off = a.const
+                if off % 32:
+                    raise TraceIneligible("unaligned MSTORE")
+                if off + 32 >= M._LIMIT_25:
+                    self._leaf_err(path)
+                    return
+                path.accum += cg + self._mem_expand(path, off + 32)
+                # no live-masking: a frozen (err/HOST) lane's memory can
+                # only be observed through a LATER effectful op, and
+                # every effectful op masks on the live set — identical
+                # constness in abstract and concrete modes by design
+                path.mem[off] = b
+                pc += 1
+                continue
+            if op == 0x53:
+                raise TraceIneligible("MSTORE8")
+            if op == 0x51:               # MLOAD
+                a = st.pop()
+                if a.const is None:
+                    raise TraceIneligible("symbolic memory offset")
+                off = a.const
+                if off % 32:
+                    raise TraceIneligible("unaligned MLOAD")
+                if off + 32 >= M._LIMIT_25:
+                    self._leaf_err(path)
+                    return
+                path.accum += cg + self._mem_expand(path, off + 32)
+                st.append(self._mem_word(path, off))
+                pc += 1
+                continue
+
+            # ---- keccak
+            if op == 0x20:               # SHA3
+                a, b = st.pop(), st.pop()
+                if a.const is None or b.const is None:
+                    raise TraceIneligible("symbolic keccak range")
+                off, size = a.const, b.const
+                need = off + size if size else 0
+                if need >= M._LIMIT_25:
+                    self._leaf_err(path)
+                    return
+                words = (size + 31) // 32
+                path.accum += (cg + words * P.KECCAK256_WORD_GAS
+                               + self._mem_expand(path, need))
+                st.append(self._keccak(path, off, size))
+                pc += 1
+                continue
+
+            # ---- storage
+            if op in (0x54, 0x55):
+                key = st.pop()
+                new = st.pop() if op == 0x55 else None
+                v = self._storage_op(path, key, new, op)
+                if op == 0x54:
+                    st.append(v if v is not None else _SYM)
+                pc += 1
+                continue
+
+            # ---- logs
+            if 0xA0 <= op <= 0xA4:
+                a, b = st.pop(), st.pop()
+                ntop = op - 0xA0
+                topics = [st.pop() for _ in range(ntop)]
+                if a.const is None or b.const is None:
+                    raise TraceIneligible("symbolic log range")
+                self._log_op(path, a.const, b.const, topics, op)
+                pc += 1
+                continue
+
+            # ---- context / environment words
+            if op in (0x30, 0x32, 0x33, 0x34, 0x36, 0x38, 0x3A, 0x41,
+                      0x42, 0x43, 0x44, 0x45, 0x46, 0x48):
+                path.accum += cg
+                st.append(self._ctx_sv(op))
+                pc += 1
+                continue
+            if op == 0x35:               # CALLDATALOAD
+                a = st.pop()
+                if a.const is None:
+                    raise TraceIneligible("symbolic calldata offset")
+                path.accum += cg
+                st.append(self._calldataload(path, a.const))
+                pc += 1
+                continue
+            if op == 0x58:               # PC
+                path.accum += cg
+                st.append(_SV(const=pc))
+                pc += 1
+                continue
+            if op == 0x59:               # MSIZE
+                path.accum += cg
+                st.append(_SV(const=path.msize))
+                pc += 1
+                continue
+            if op == 0x5A:               # GAS
+                self._flush(path)
+                path.accum += cg
+                if self.emit:
+                    st.append(_SV(t=M.word_of_scalar(
+                        jnp.maximum(path.gas - cg, 0), (self.B,))))
+                else:
+                    st.append(_SYM)
+                pc += 1
+                continue
+            if op == 0x5B:               # JUMPDEST
+                path.accum += cg
+                pc += 1
+                continue
+
+            # ---- ALU
+            if op == 0x15:               # ISZERO
+                a = st.pop()
+                path.accum += cg
+                if a.const is not None:
+                    st.append(_SV(const=int(a.const == 0)))
+                elif self.emit:
+                    st.append(_SV(t=u256x.bool_word(
+                        u256.is_zero(self._t(a)))))
+                else:
+                    st.append(_SYM)
+                pc += 1
+                continue
+            if op == 0x19:               # NOT
+                a = st.pop()
+                path.accum += cg
+                if a.const is not None:
+                    st.append(_SV(const=~a.const & U256_MASK))
+                elif self.emit:
+                    st.append(_SV(t=u256x.not_(self._t(a))))
+                else:
+                    st.append(_SYM)
+                pc += 1
+                continue
+            if op in (0x08, 0x09):       # ADDMOD / MULMOD
+                a, b, c = st.pop(), st.pop(), st.pop()
+                path.accum += cg
+                if all(x.const is not None for x in (a, b, c)):
+                    if c.const == 0:
+                        st.append(_SV(const=0))
+                    elif op == 0x08:
+                        st.append(_SV(const=(a.const + b.const)
+                                      % c.const))
+                    else:
+                        st.append(_SV(const=(a.const * b.const)
+                                      % c.const))
+                elif self.emit:
+                    fn = u256x.addmod if op == 0x08 else u256x.mulmod
+                    st.append(_SV(t=fn(self._t(a), self._t(b),
+                                       self._t(c))))
+                else:
+                    st.append(_SYM)
+                pc += 1
+                continue
+            if op == 0x0A:               # EXP (const exponent only)
+                a, b = st.pop(), st.pop()
+                if b.const is None:
+                    raise TraceIneligible("symbolic EXP exponent")
+                ebytes = (b.const.bit_length() + 7) // 8
+                path.accum += (cg + P.EXP_GAS
+                               + ebytes * P.EXP_BYTE_EIP158)
+                if a.const is not None:
+                    st.append(_SV(const=pow(a.const, b.const,
+                                            1 << 256)))
+                elif self.emit:
+                    st.append(_SV(t=u256x.exp_(self._t(a),
+                                               self._t(b))))
+                else:
+                    st.append(_SYM)
+                pc += 1
+                continue
+            if op in (0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07, 0x0B,
+                      0x10, 0x11, 0x12, 0x13, 0x14, 0x16, 0x17, 0x18,
+                      0x1A, 0x1B, 0x1C, 0x1D):
+                a, b = st.pop(), st.pop()
+                path.accum += cg
+                st.append(self._bin(op, a, b))
+                pc += 1
+                continue
+
+            raise TraceIneligible(f"untraced opcode 0x{op:02x}")
+
+    # ------------------------------------------------------------ entry
+    def run(self):
+        """Trace from pc 0; returns the merged _OCC_RES state dict
+        (concrete) or None (abstract — success means eligible)."""
+        p = _Path()
+        p.stack = []
+        p.mem = {}
+        p.msize = 0
+        p.accum = 0
+        p.steps = 0
+        p.nlogs = 0
+        if self.emit:
+            mp, B = self.p, self.B
+            S, LC, LD = mp.scache_cap, mp.log_cap, mp.log_data_cap
+            p.pmask = self.active
+            p.gas = self.inputs["start_gas"].astype(jnp.int32)
+            p.err = jnp.zeros((B,), dtype=bool)
+            p.hosty = jnp.zeros((B,), dtype=bool)
+            p.host_reason = jnp.zeros((B,), dtype=jnp.int32)
+            p.refund = jnp.zeros((B,), dtype=jnp.int32)
+            p.st5 = self.storage0
+            p.logs = (
+                jnp.zeros((B, LC, 4, LIMBS), dtype=jnp.int32),
+                jnp.zeros((B, LC), dtype=jnp.int32),
+                jnp.zeros((B, LC, LD), dtype=jnp.int32),
+                jnp.zeros((B, LC), dtype=jnp.int32))
+            p.log_cnt = jnp.zeros((B,), dtype=jnp.int32)
+        else:
+            p.pmask = p.gas = p.err = p.hosty = None
+            p.host_reason = p.refund = p.st5 = None
+            p.logs = p.log_cnt = None
+        self._run(0, p)
+        if not self.emit:
+            return None
+        res = _zero_res(self.p)
+        for pmask, leaf in self.leaves:
+            for f in M._OCC_RES:
+                m = pmask.reshape((self.B,)
+                                  + (1,) * (res[f].ndim - 1))
+                res[f] = jnp.where(m, leaf[f], res[f])
+        return res
+
+
+def _zero_res(p: M.MachineParams) -> dict:
+    """An all-SKIP _OCC_RES state dict (the spec programs' merge base
+    and the skipped-cond branch of the kernel's per-program gate)."""
+    B, S, LC, LD = p.batch, p.scache_cap, p.log_cap, p.log_data_cap
+    return dict(
+        status=jnp.full((B,), M.SKIP, dtype=jnp.int32),
+        gas=jnp.zeros((B,), dtype=jnp.int32),
+        refund=jnp.zeros((B,), dtype=jnp.int32),
+        host_reason=jnp.zeros((B,), dtype=jnp.int32),
+        scnt=jnp.zeros((B,), dtype=jnp.int32),
+        sflag=jnp.zeros((B, S), dtype=jnp.int32),
+        skey=jnp.zeros((B, S, LIMBS), dtype=jnp.int32),
+        sval=jnp.zeros((B, S, LIMBS), dtype=jnp.int32),
+        sorig=jnp.zeros((B, S, LIMBS), dtype=jnp.int32),
+        log_top=jnp.zeros((B, LC, 4, LIMBS), dtype=jnp.int32),
+        log_nt=jnp.zeros((B, LC), dtype=jnp.int32),
+        log_data=jnp.zeros((B, LC, LD), dtype=jnp.int32),
+        log_dlen=jnp.zeros((B, LC), dtype=jnp.int32),
+        log_cnt=jnp.zeros((B,), dtype=jnp.int32),
+    )
+
+
+# ------------------------------------------------------- eligibility
+_ELIGIBLE: Dict[Tuple[bytes, str], Tuple[bool, str]] = {}
+_REQS: Dict[Tuple[bytes, str], Tuple] = {}
+
+
+def trace_eligible(code: bytes, fork: str) -> Tuple[bool, str]:
+    """Can `code` compile to a straight-line traced program?  Runs the
+    SAME symbolic walk as the program builder in abstract mode (every
+    control decision depends only on trace-time constants, so abstract
+    success implies the concrete build succeeds).  Memoized by code
+    hash; the adapter consults this before assigning a lane a
+    specialized program id."""
+    key = (keccak256(code), fork)
+    cached = _ELIGIBLE.get(key)
+    if cached is not None:
+        return cached
+    ok, reason = census.trace_precheck(code, SPEC_OPCODES)
+    if ok:
+        try:
+            tr = _Tracer(code, fork)
+            tr.run()
+            _REQS[key] = tuple(tr.kreqs)
+        except TraceIneligible as exc:
+            ok, reason = False, exc.reason
+        except RecursionError:
+            ok, reason = False, "branch recursion too deep"
+    out = (ok, reason)
+    _ELIGIBLE[key] = out
+    return out
+
+
+def spec_requests(code: bytes, fork: str) -> Tuple:
+    """The host-evaluated keccak requests of an eligible program, in
+    kdig-slot order (empty for ineligible code).  Each request is a
+    tuple of 32-byte-word descriptors — ("const", v) | ("ctx", op) |
+    ("data", off) | ("kdig", j with j < this request's index) — that
+    the issue path evaluates per lane and batch-hashes."""
+    if not trace_eligible(code, fork)[0]:
+        return ()
+    return _REQS.get((keccak256(code), fork), ())
+
+
+# corethlint: jit-factory — spec_exec runs inside the jitted OCC kernel
+def build_spec_exec(prog: SpecProgram, params: M.MachineParams):
+    """Program factory: the straight-line traced executor for one
+    contract under one shape bucket.  Returns
+    ``spec_exec(inputs, storage, active) -> _OCC_RES state dict`` —
+    the drop-in replacement for the generic ``exec_lanes`` over the
+    lanes whose code hash selected this program (machine.
+    build_occ_machine gates it per lane by ``prog_id``)."""
+    code, fork = prog.code, prog.fork
+
+    def spec_exec(inputs, storage, active):
+        tr = _Tracer(code, fork, params=params, inputs=inputs,
+                     storage=storage, active=active)
+        return tr.run()
+
+    return spec_exec

@@ -131,12 +131,13 @@ def scan_code(code: bytes, fork: str,
     skipped, reference core/vm/analysis.go) so data bytes never
     disqualify code.  Undefined opcodes do NOT disqualify: reaching one
     is a plain INVALID-style error the machine handles.  Memoized by
-    code hash (not the bytecode itself) so the cache stays small across
-    long replays.
+    the bytecode itself (dict equality dedupes, so the cache is still
+    one entry per distinct code): the window packer consults this per
+    LANE, and the old keccak-derived key paid a code-sized hash per
+    call on the hot packing path.
     """
-    from coreth_tpu.crypto import keccak256
     from coreth_tpu.evm.census import opcode_census
-    key = (keccak256(code), fork)
+    key = (code, fork)
     cached = _SCAN_CACHE.get(key)
     if cached is not None:
         return cached

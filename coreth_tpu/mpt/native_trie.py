@@ -60,6 +60,14 @@ def backend() -> str:
     return "native" if available() else "py"
 
 
+def trie_check_armed() -> bool:
+    """One parse for CORETH_TRIE_CHECK, shared by every consumer
+    (engine commit path, flat exporter): unset, empty, or "0" is off;
+    any other value arms the python-twin differential oracle."""
+    import os
+    return os.environ.get("CORETH_TRIE_CHECK", "").strip() not in ("", "0")
+
+
 class TrieOracleError(AssertionError):
     """CORETH_TRIE_CHECK divergence: native and Python roots differ."""
 
@@ -114,6 +122,13 @@ class NativeSecureTrie:
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
                 ctypes.c_uint64, ctypes.c_char_p]
             lib.coreth_trie_fold_accounts_root.restype = None
+        # ordered (derive_sha) ABI (PR 13); same per-symbol probe
+        if hasattr(lib, "coreth_trie_update_ordered"):
+            lib.coreth_trie_update_ordered.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint64]
+            lib.coreth_trie_update_ordered.restype = None
         lib._trie_decls = True
 
     def __del__(self):
@@ -249,6 +264,79 @@ class NativeSecureTrie:
         for nibs, value in trie.items():
             out.update_hashed(nibbles_to_key(nibs), value)
         return out
+
+
+class NativeOrderedTrie:
+    """derive_sha hasher over the C++ trie handle: the same streaming
+    ``update``/``hash`` surface as ``mpt.StackTrie``, but updates
+    buffer host-side and fold in ONE ctypes crossing at ``hash()`` —
+    the variable-length rlp(index) keys of tx/receipt tries go through
+    ``coreth_trie_update_ordered`` (the py stacktrie walk was ~15% of
+    the erc20-machine replay wall; native fold is the difference per
+    the commit-pipeline measurements).  Roots are self-checking at
+    every call site: derive_sha results compare against the block
+    header, so a divergence fails the replay loudly."""
+
+    def __init__(self):
+        self._lib = _native._require()
+        NativeSecureTrie._ensure_decls(self._lib)
+        self.h = self._lib.coreth_trie_new()
+        self._keys: List[bytes] = []
+        self._vals: List[bytes] = []
+
+    def __del__(self):
+        try:
+            if getattr(self, "h", None):
+                self._lib.coreth_trie_free(self.h)
+                self.h = None
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def update(self, key: bytes, value: bytes) -> None:
+        if len(key) > 16:
+            # the C++ ordered fold walks at most 16 key bytes (rlp(u64
+            # index) caps at 9) — a longer key (e.g. a 32-byte hashed
+            # account key) would be silently truncated into collisions
+            raise ValueError(
+                "NativeOrderedTrie keys cap at 16 bytes (rlp tx/receipt"
+                f" index); got {len(key)} — use SecureTrie for hashed"
+                " keys")
+        self._keys.append(key)
+        self._vals.append(value)
+
+    def hash(self) -> bytes:
+        n = len(self._keys)
+        if n:
+            kl = (ctypes.c_uint32 * n)(*map(len, self._keys))
+            vl = (ctypes.c_uint32 * n)(*map(len, self._vals))
+            self._lib.coreth_trie_update_ordered(
+                self.h, b"".join(self._keys), kl,
+                b"".join(self._vals), vl, n)
+            self._keys.clear()
+            self._vals.clear()
+        out = ctypes.create_string_buffer(32)
+        self._lib.coreth_trie_hash(self.h, out)
+        return out.raw
+
+
+def ordered_available() -> bool:
+    """Whether the loaded library exports the ordered-insert ABI (a
+    prebuilt .so from before PR 13 degrades to the py stacktrie)."""
+    if not available():
+        return False
+    return hasattr(_native.load(), "coreth_trie_update_ordered")
+
+
+def derive_hasher():
+    """The derive_sha hasher for the selected backend: a fresh
+    ``NativeOrderedTrie`` under ``CORETH_TRIE=native`` (or the auto
+    default), ``mpt.StackTrie`` under ``py`` — callers on the replay
+    hot path pick the backend with this instead of hard-coding the
+    python stacktrie."""
+    if backend() == "native" and ordered_available():
+        return NativeOrderedTrie()
+    from coreth_tpu.mpt.stacktrie import StackTrie
+    return StackTrie()
 
 
 class CheckedSecureTrie:

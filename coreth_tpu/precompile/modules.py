@@ -12,12 +12,13 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 _RESERVED_PREFIXES = (b"\x01", b"\x02", b"\x03")
+_RESERVED_BODY = b"\x00" * 18
 
 
 def reserved_address(addr: bytes) -> bool:
     """modules/registerer.go:37 ReservedAddress."""
-    return any(addr[:1] == p and addr[1:19] == b"\x00" * 18
-               for p in _RESERVED_PREFIXES)
+    return addr[:1] in _RESERVED_PREFIXES \
+        and addr[1:19] == _RESERVED_BODY
 
 
 @dataclass

@@ -50,7 +50,7 @@ from coreth_tpu.workloads.erc20 import (
     TOKEN_CODE_HASH, TRANSFER_TOPIC, balance_slot,
     measure_transfer_exec_gas, parse_transfer_calldata,
 )
-from coreth_tpu.mpt import StackTrie
+from coreth_tpu.mpt.native_trie import derive_hasher
 from coreth_tpu.types import (
     Block, LatestSigner, Log, Receipt, StateAccount, Transaction,
     create_bloom, derive_sha,
@@ -297,6 +297,16 @@ def _transfer_step(balances, nonces, sender_idx, recip_idx, value16, fee16,
     return new_balances, new_nonces, ok
 
 
+@jax.jit
+def _scatter_drop(arr, idx, val):
+    """Jitted OOB-dropping scatter: the eager ``.at[].set`` pays
+    several ms of host-side primitive lowering per call (gather-index
+    normalization + broadcast), which flush_staged pays per block; the
+    jitted twin amortizes it to a cache hit per (shape, dtype)
+    bucket — the pow2 padding below bounds the bucket count."""
+    return arr.at[idx].set(val, mode="drop")
+
+
 class DeviceState:
     """Account- and storage-slot-indexed device arrays (the flat-state /
     snapshot analog, reference core/state/snapshot/ — here resident in
@@ -502,10 +512,11 @@ class DeviceState:
                                + [0] * (pad - n))
             non = np.zeros(pad, dtype=np.int32)
             non[:n] = [s[2] for s in self._staged]
-            self.balances = self.balances.at[jnp.asarray(idx)].set(
-                jnp.asarray(bal), mode="drop")
-            self.nonces = self.nonces.at[jnp.asarray(idx)].set(
-                jnp.asarray(non), mode="drop")
+            jidx = jnp.asarray(idx)
+            self.balances = _scatter_drop(self.balances, jidx,
+                                          jnp.asarray(bal))
+            self.nonces = _scatter_drop(self.nonces, jidx,
+                                        jnp.asarray(non))
             self._staged = []
         if self._staged_slots:
             n = len(self._staged_slots)
@@ -515,8 +526,8 @@ class DeviceState:
                        for s in self._staged_slots]
             val = u256.pack_np([s[1] for s in self._staged_slots]
                                + [0] * (pad - n))
-            self.slot_vals = self.slot_vals.at[jnp.asarray(idx)].set(
-                jnp.asarray(val), mode="drop")
+            self.slot_vals = _scatter_drop(
+                self.slot_vals, jnp.asarray(idx), jnp.asarray(val))
             self._staged_slots = []
         return flushed_a, flushed_s
 
@@ -704,7 +715,7 @@ class ReplayEngine:
         # when the library loads); CORETH_TRIE_CHECK=1 arms the
         # python-twin differential oracle on every root derivation
         self._native = native_trie.backend() == "native"
-        self._trie_check = bool(os.environ.get("CORETH_TRIE_CHECK"))
+        self._trie_check = native_trie.trie_check_armed()
         self.trie = db.open_trie(state_root)
         if self._native:
             # C++ trie for the hot fold (bit-identical roots pinned by
@@ -1600,7 +1611,8 @@ class ReplayEngine:
                 gas_used=gas_list[i],
                 logs=[logs[i]] if logs[i] is not None else [])
                 for i, tx in enumerate(block.transactions)]
-            if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
+            if derive_sha(receipts, derive_hasher()) \
+                    != block.header.receipt_hash:
                 raise ReplayError("receipt root mismatch")
             if create_bloom(receipts) != block.header.bloom:
                 raise ReplayError("bloom mismatch")
@@ -1980,7 +1992,8 @@ class ReplayEngine:
             if strict:
                 raise _block_error("gas used mismatch (fallback)", block)
             reasons.append("gas used mismatch")
-        if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
+        if derive_sha(receipts, derive_hasher()) \
+                != block.header.receipt_hash:
             if strict:
                 raise _block_error(
                     "receipt root mismatch (fallback)", block)

@@ -42,7 +42,7 @@ from coreth_tpu.params import protocol as P
 from coreth_tpu.processor.state_transition import (
     intrinsic_gas, is_prohibited,
 )
-from coreth_tpu.mpt import StackTrie
+from coreth_tpu.mpt.native_trie import derive_hasher
 from coreth_tpu.types import (
     Block, Log, Receipt, StateAccount, create_bloom, derive_sha,
 )
@@ -109,7 +109,9 @@ class MachineBlockExecutor:
         # across runner rebuilds (an epoch bump discards the runner)
         self._runner_totals = dict(
             premap_predicted=0, premap_hits=0, premap_nested=0,
-            discovery_dispatches=0, kernel_retraces=0)
+            premap_array=0, discovery_dispatches=0, kernel_retraces=0,
+            lanes_specialized=0, specialize_escapes=0,
+            programs_traced=0)
 
     def machine_counters(self) -> dict:
         """Predicted-premap + kernel-retrace counters over every
@@ -447,7 +449,15 @@ class MachineBlockExecutor:
             return st
 
         from coreth_tpu.replay.engine import _block_error
-        receipts: List[Receipt] = []
+        # rows: (tx_type, status, used, cum, logs) — Receipt objects
+        # materialize only on the non-uniform fallback; the uniform
+        # Transfer log shape (status-1, <=1 log of 3*topic32+data32)
+        # derives root AND bloom in ONE C++ call (native.receipt_root,
+        # the engine _validate_and_advance twin) — Python Receipt
+        # construction + consensus-RLP was ~8% of the specialized
+        # erc20-machine replay wall
+        rows: List[tuple] = []
+        uniform = bool(e._native)
         cum = 0
         writes_final: Dict[Tuple[bytes, bytes], int] = {}
         for i, pl in enumerate(plans):
@@ -483,17 +493,48 @@ class MachineBlockExecutor:
                 acct(pl.to)[0] += pl.value
             acct(block.header.coinbase)[0] += used * pl.price
             cum += used
-            receipts.append(Receipt(
-                tx_type=block.transactions[i].tx_type, status=status,
-                cumulative_gas_used=cum, gas_used=used, logs=logs))
+            if uniform and not (
+                    status == 1 and len(logs) <= 1
+                    and (not logs or (len(logs[0].topics) == 3
+                                      and all(len(t) == 32
+                                              for t in logs[0].topics)
+                                      and len(logs[0].data) == 32))):
+                uniform = False
+            rows.append((block.transactions[i].tx_type, status, used,
+                         cum, logs))
         if cum != block.header.gas_used:
             raise _block_error("machine block: gas used mismatch", block)
-        if derive_sha(receipts, StackTrie()) != block.header.receipt_hash:
-            raise _block_error(
-                "machine block: receipt root mismatch", block)
-        if create_bloom(receipts) != block.header.bloom:
-            raise _block_error("machine block: bloom mismatch", block)
+        receipts: Optional[List[Receipt]] = None
+        if uniform:
+            from coreth_tpu.crypto import native as _n
+            root, bloom = _n.receipt_root(
+                [r[3] for r in rows],
+                bytes(r[0] for r in rows),
+                bytes(1 if r[4] else 0 for r in rows),
+                b"".join(lg.address + b"".join(lg.topics) + lg.data
+                         for r in rows for lg in r[4]))
+            if root != block.header.receipt_hash:
+                raise _block_error(
+                    "machine block: receipt root mismatch", block)
+            if bloom != block.header.bloom:
+                raise _block_error("machine block: bloom mismatch",
+                                   block)
+        else:
+            receipts = [Receipt(tx_type=t, status=st,
+                                cumulative_gas_used=c, gas_used=u,
+                                logs=lgs)
+                        for t, st, u, c, lgs in rows]
+            if derive_sha(receipts, derive_hasher()) \
+                    != block.header.receipt_hash:
+                raise _block_error(
+                    "machine block: receipt root mismatch", block)
+            if create_bloom(receipts) != block.header.bloom:
+                raise _block_error("machine block: bloom mismatch",
+                                   block)
         if e.config.is_apricot_phase4(block.time):
+            if receipts is None:
+                # verify_block_fee reads only gas_used per receipt
+                receipts = [Receipt(gas_used=r[2]) for r in rows]
             from coreth_tpu.consensus.engine import ConsensusError
             try:
                 e.engine.verify_block_fee(

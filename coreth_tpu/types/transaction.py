@@ -33,6 +33,44 @@ def _al_from_rlp(items) -> AccessList:
     return [(tup[0], list(tup[1])) for tup in items]
 
 
+def _rlp_item_end(buf: bytes, pos: int) -> int:
+    """End offset of the RLP item starting at ``pos`` (no decode)."""
+    b0 = buf[pos]
+    if b0 < 0x80:
+        return pos + 1
+    if b0 < 0xB8:
+        return pos + 1 + (b0 - 0x80)
+    if b0 < 0xC0:
+        ll = b0 - 0xB7
+        return pos + 1 + ll + int.from_bytes(buf[pos + 1:pos + 1 + ll],
+                                             "big")
+    if b0 < 0xF8:
+        return pos + 1 + (b0 - 0xC0)
+    ll = b0 - 0xF7
+    return pos + 1 + ll + int.from_bytes(buf[pos + 1:pos + 1 + ll],
+                                         "big")
+
+
+def _typed_sighash_from_wire(wire: bytes, keep: int) -> bytes:
+    """Signing hash of a DECODED typed tx straight from its wire bytes.
+
+    The typed sighash is keccak(type || rlp(items[:-3])) and the wire
+    encoding is type || rlp(items): the unsigned payload is a contiguous
+    SLICE of the wire bytes, so re-wrapping that slice in a fresh list
+    header replaces a full per-field RLP re-encode (visible at replay
+    scale: the native baseline gets its sighashes packed outside the
+    timed loop, this is the decoded-object equivalent)."""
+    b0 = wire[1]
+    hs = 1 if b0 < 0xF8 else 1 + (b0 - 0xF7)
+    start = 1 + hs
+    pos = start
+    for _ in range(keep):
+        pos = _rlp_item_end(wire, pos)
+    body = wire[start:pos]
+    return keccak256(
+        wire[:1] + rlp._encode_length(len(body), 0xC0) + body)
+
+
 @dataclass
 class LegacyTx:
     nonce: int = 0
@@ -162,6 +200,9 @@ class AccessListTx:
         if chain_id is not None and chain_id != self.chain_id_:
             raise ValueError(
                 f"tx chain id {self.chain_id_} != signer chain id {chain_id}")
+        wire = getattr(self, "_wire", None)
+        if wire is not None:
+            return _typed_sighash_from_wire(wire, 8)
         fields = self.payload_rlp_items()[:-3]
         return keccak256(bytes([self.tx_type]) + rlp.encode(fields))
 
@@ -234,6 +275,9 @@ class DynamicFeeTx:
         if chain_id is not None and chain_id != self.chain_id_:
             raise ValueError(
                 f"tx chain id {self.chain_id_} != signer chain id {chain_id}")
+        wire = getattr(self, "_wire", None)
+        if wire is not None:
+            return _typed_sighash_from_wire(wire, 9)
         fields = self.payload_rlp_items()[:-3]
         return keccak256(bytes([self.tx_type]) + rlp.encode(fields))
 
@@ -339,7 +383,7 @@ class Transaction:
         if typ == ACCESS_LIST_TX_TYPE:
             if len(items) != 11:
                 raise ValueError("malformed access-list tx")
-            return cls(AccessListTx(
+            inner = AccessListTx(
                 chain_id_=rlp.decode_uint(items[0]),
                 nonce=rlp.decode_uint(items[1]),
                 gas_price=rlp.decode_uint(items[2]),
@@ -351,11 +395,13 @@ class Transaction:
                 v=rlp.decode_uint(items[8]),
                 r=rlp.decode_uint(items[9]),
                 s=rlp.decode_uint(items[10]),
-            ))
+            )
+            inner._wire = data  # sighash slices the original bytes
+            return cls(inner)
         if typ == DYNAMIC_FEE_TX_TYPE:
             if len(items) != 12:
                 raise ValueError("malformed dynamic-fee tx")
-            return cls(DynamicFeeTx(
+            inner = DynamicFeeTx(
                 chain_id_=rlp.decode_uint(items[0]),
                 nonce=rlp.decode_uint(items[1]),
                 gas_tip_cap_=rlp.decode_uint(items[2]),
@@ -368,7 +414,9 @@ class Transaction:
                 v=rlp.decode_uint(items[9]),
                 r=rlp.decode_uint(items[10]),
                 s=rlp.decode_uint(items[11]),
-            ))
+            )
+            inner._wire = data  # sighash slices the original bytes
+            return cls(inner)
         raise ValueError(f"unknown tx type {typ:#x}")
 
     def hash(self) -> bytes:

@@ -459,6 +459,32 @@ void coreth_trie_hash(void* h, uint8_t out32[32]) {
   ((Trie*)h)->hash_root(out32);
 }
 
+// Ordered (derive_sha-shaped) batch insert: VARIABLE-length keys — the
+// rlp(index) keys of tx/receipt tries are 1..9 bytes, not the
+// pre-hashed 32-byte secure keys above.  Insert order is free (the
+// handle trie is pointer-based, not streaming); one crossing folds a
+// whole block's receipts, coreth_trie_hash reads the root.
+void coreth_trie_update_ordered(void* h, const uint8_t* keys,
+                                const uint32_t* key_lens,
+                                const uint8_t* vals,
+                                const uint32_t* val_lens, uint64_t n) {
+  Trie* t = (Trie*)h;
+  uint8_t nib[32];
+  size_t ko = 0, vo = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint32_t kl = key_lens[i];
+    uint32_t use = kl > 16 ? 16 : kl;  // rlp(u64 index) caps at 9
+    for (uint32_t j = 0; j < use; ++j) {
+      nib[2 * j] = keys[ko + j] >> 4;
+      nib[2 * j + 1] = keys[ko + j] & 0x0F;
+    }
+    ko += kl;
+    uint32_t vl = val_lens[i];
+    t->insert(nib, 2 * use, Bytes(vals + vo, vals + vo + vl));
+    vo += vl;
+  }
+}
+
 // Batched account fold (the statedb updateTrie + IntermediateRoot hot
 // loop in one call): n records of pre-hashed key, 32-byte BE balance,
 // nonce, storage root, code hash, multicoin flag; del[i] != 0 deletes.

@@ -407,6 +407,38 @@ def test_background_checkpoint_off_execute_thread(tmp_path):
     kv2.close()
 
 
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_exporter_shadow_trie_backend(tmp_path, monkeypatch, backend):
+    """PR-11 follow-up: the exporter's shadow tries derive through the
+    SELECTED trie backend (CORETH_TRIE=native moves the background
+    Merkleization to the C++ trie; =py keeps the pure-Python twin).
+    Both backends land the same records and the same resume root, and
+    every export is still root-checked against the generation's header
+    root — an erc20 chain so per-contract storage tries fold too."""
+    monkeypatch.setenv("CORETH_TRIE", backend)
+    genesis, blocks = build_token_chain(n_blocks=6)
+    kv, db, eng = _disk_engine(tmp_path, genesis)
+    pipe = StreamingPipeline(eng, ChainFeed(list(blocks)),
+                             checkpoint_every=2)
+    rep = pipe.run()
+    ck = rep.checkpoint
+    assert ck["written"] >= 2
+    exp = ck["exporter"]
+    assert exp["backend"] == backend
+    assert exp["records"] == ck["written"]
+    assert not exp["failed"]
+    assert eng.root == blocks[-1].header.root
+    kv.close()
+    del eng, db
+
+    kv2, db2 = open_db(str(tmp_path))
+    from coreth_tpu.replay.checkpoint import resume_engine
+    eng2, ckpt = resume_engine(genesis.config, db2, kv2, capacity=256,
+                               batch_pad=64, window=4)
+    assert ckpt.root == blocks[ckpt.number - 1].header.root
+    kv2.close()
+
+
 def test_checkpoint_sync_mode_ab(tmp_path, monkeypatch):
     """CORETH_CHECKPOINT_SYNC=1 restores the PR-10 on-thread export —
     same records, no exporter thread."""

@@ -409,6 +409,49 @@ def test_jit_factory_tuple_return_and_decorated_marker():
     assert codes(check_jit_purity([s])) == ["JIT001"]
 
 
+def test_jit_factory_listcomp_program_set_traced():
+    """Program-SET factories (the specialize.py shape) returning a
+    comprehension of per-item factory calls are followed into each
+    element factory's closures."""
+    s = src("""
+        import jax
+        # corethlint: jit-factory
+        def build_programs(codes):
+            return [build_one(c) for c in codes]
+        def build_one(code):
+            def prog(x):
+                print(x)
+                return x
+            return prog
+    """)
+    assert codes(check_jit_purity([s])) == ["JIT001"]
+
+
+def test_jit_factory_tuple_genexp_traced_and_clean_ok():
+    """``return tuple(build_one(c) for c in cs)`` is traced too; a
+    clean program set produces no findings."""
+    s = src("""
+        import jax
+        import numpy as np
+        # corethlint: jit-factory
+        def build_programs(codes):
+            return tuple(build_one(c) for c in codes)
+        def build_one(code):
+            def prog(x):
+                return np.sum(x)
+            return prog
+        # clean variant never jitted nor marked: ignored
+        def host_set(codes):
+            return [host_one(c) for c in codes]
+        def host_one(code):
+            def probe(x):
+                print(x)
+                return x
+            return probe
+    """)
+    assert codes(check_jit_purity([s])) == ["JIT002"]
+
+
 def test_jit_factory_clean_and_untraced_factory_ignored():
     """Factories whose results are never jitted (and carry no marker)
     stay unchecked; clean factory closures produce no findings."""

@@ -91,6 +91,39 @@ def spend_calldata(spender: bytes, amount: int) -> bytes:
             + amount.to_bytes(32, "big"))
 
 
+# ------------------------------------------------- array-slot fixture
+# dynamic-array contract: setAt(uint256 i, uint256 v) does
+#   data[i] += v
+# with data = a dynamic array at slot 3, i.e. the element slot is
+# keccak(pad32(3)) + i — ARITHMETIC past a keccak, the third recipe
+# shape (no keccak over the lane's inputs at all; neither flat nor
+# nested recipes can explain it).
+SETAT_SELECTOR = bytes.fromhex("aa001122")
+ARR = b"\x7a" * 20
+ARR_RUNTIME = _assemble([
+    _b1(0x00), "CALLDATALOAD", _b1(0xE0), "SHR",
+    "DUP1", ("PUSH", SETAT_SELECTOR), "EQ", ("PUSHL", "setAt"),
+    "JUMPI",
+    _b1(0x00), _b1(0x00), "REVERT",
+
+    ("LABEL", "setAt"),
+    # base = keccak(pad32(3))
+    _b1(0x03), _b1(0x00), "MSTORE",
+    _b1(0x20), _b1(0x00), "SHA3",                    # [base]
+    _b1(0x04), "CALLDATALOAD", "ADD",                # [base + i]
+    "DUP1", "SLOAD",                                 # [key, old]
+    _b1(0x24), "CALLDATALOAD", "ADD",                # [key, old+v]
+    "SWAP1", "SSTORE",                               # []
+    _b1(0x01), _b1(0x00), "MSTORE",
+    _b1(0x20), _b1(0x00), "RETURN",
+])
+
+
+def setat_calldata(i: int, v: int) -> bytes:
+    return (SETAT_SELECTOR + i.to_bytes(32, "big")
+            + v.to_bytes(32, "big"))
+
+
 def _alloc(extra=None):
     alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
     alloc[POOL] = pool_genesis_account(10**15, 10**15)
@@ -409,6 +442,50 @@ def test_occ_nested_premap_allowance(monkeypatch):
     assert legacy.root == eng.root == blocks[-1].root
     lc = legacy._machine.machine_counters()
     assert lc["premap_nested"] == 0
+    assert lc["discovery_dispatches"] > mc["discovery_dispatches"]
+
+
+def test_occ_array_premap(monkeypatch):
+    """Array-slot arithmetic CI gate (the last discovery-fallback
+    class, ROADMAP "Premap recipes"): element keys ``keccak(slot) + i``
+    learn as the third recipe shape — a leftover miss that equals
+    base(slot) + calldata-word records (sel, "arr", (data, 0), 3), and
+    every later window derives fresh indices' keys by pure host
+    arithmetic BEFORE dispatch (no keccak at premap time at all).
+    Pins dispatches_per_block <= 1.1, premap_array > 0, and
+    bit-identical roots vs the arithmetic-disabled miss-and-rerun A/B
+    (CORETH_PREMAP_ARR=0)."""
+    from coreth_tpu.chain import GenesisAccount
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+    extra = {ARR: GenesisAccount(balance=0, code=ARR_RUNTIME, nonce=1)}
+
+    def gen(i, nonces):
+        # fresh array index every tx: a fresh key = base + i each time
+        # that no keccak-over-inputs recipe could ever derive
+        return [_tx(k, nonces, ARR,
+                    setat_calldata(1000 * i + 7 * k, 5 + k))
+                for k in range(6)]
+
+    gblock, blocks = _build_chain(8, gen, extra)
+    d0 = ADP.DISPATCH_COUNT
+    eng = _replay(gblock, blocks, extra)
+    disp = ADP.DISPATCH_COUNT - d0
+    mx = eng._machine
+    assert mx.blocks == 8
+    mc = mx.machine_counters()
+    assert mc["premap_array"] > 0
+    assert mc["premap_hits"] > 0
+    # only the first window's discovery cycle re-dispatches
+    assert mc["discovery_dispatches"] <= 2
+    assert disp / mx.blocks <= 1.1
+
+    # A/B: without array recipes the same chain lands the same root,
+    # paying a discovery re-dispatch for (almost) every window
+    monkeypatch.setenv("CORETH_PREMAP_ARR", "0")
+    legacy = _replay(gblock, blocks, extra)
+    assert legacy.root == eng.root == blocks[-1].root
+    lc = legacy._machine.machine_counters()
+    assert lc["premap_array"] == 0
     assert lc["discovery_dispatches"] > mc["discovery_dispatches"]
 
 

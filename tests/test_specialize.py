@@ -1,0 +1,300 @@
+"""Per-contract traced specialization: equivalence + escape suite.
+
+The specializer (evm/device/specialize.py) traces hot bytecode into
+straight-line JAX sub-programs selected per lane inside the fused OCC
+kernel; the generic interpreter kernel is the escape hatch.  These
+tests pin the tentpole's invariants:
+
+- spec-vs-generic BIT-IDENTICAL roots (CORETH_SPECIALIZE=0 A/B) on
+  erc20-machine, swap (full-conflict), mixed, and revert-path shapes,
+  across both trie backends and sharded/unsharded window runners —
+  both paths validate every block against the host-generated headers,
+  so a passing replay is bit-equivalence and the final roots compare
+  on top;
+- trace-INELIGIBLE code (an unresolvable computed jump) stays on the
+  generic kernel (``specialize_escapes`` counted) while the chain
+  still replays exactly;
+- ``kernel_retraces == 0`` holds with specialization enabled across a
+  forced table-cap growth — the program set is part of the kernel
+  bucket identity and must not reintroduce mid-run retraces.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+import jax
+
+from coreth_tpu.chain import Genesis, GenesisAccount
+from coreth_tpu.chain.chain_makers import generate_chain
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+from coreth_tpu.parallel import make_mesh
+from coreth_tpu.replay import ReplayEngine
+from coreth_tpu.state import Database
+from coreth_tpu.types import DynamicFeeTx, sign_tx
+from coreth_tpu.workloads.erc20 import (
+    TOKEN_RUNTIME, token_genesis_account, transfer_calldata,
+)
+from coreth_tpu.workloads.swap import (
+    POOL_RUNTIME, pool_genesis_account, swap_calldata,
+)
+
+GWEI = 10**9
+KEYS = [0x7200 + i for i in range(8)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+POOL = b"\x74" * 20
+TOKEN = b"\x75" * 20
+
+# trace-INELIGIBLE but device-ELIGIBLE code: the jump target comes
+# from calldata, so the specializer cannot resolve it statically while
+# the generic kernel executes it fine (calldata word 0 = 4 lands on
+# the JUMPDEST).  PUSH1 0; CALLDATALOAD; JUMP; JUMPDEST; STOP.
+JUMPER = b"\x79" * 20
+JUMPER_CODE = bytes.fromhex("600035565b00")
+JUMPER_DATA = (4).to_bytes(32, "big")
+
+_trie_backends = ["py"]
+from coreth_tpu.crypto import native as _native  # noqa: E402
+if _native.load() is not None:
+    _trie_backends.append("native")
+
+
+def _alloc(extra=None):
+    alloc = {a: GenesisAccount(balance=10**24) for a in ADDRS}
+    alloc[POOL] = pool_genesis_account(10**15, 10**15)
+    alloc[TOKEN] = token_genesis_account({a: 10**21 for a in ADDRS})
+    if extra:
+        alloc.update(extra)
+    return alloc
+
+
+def _tx(k, nonces, to, data=b"", gas=200_000, value=0):
+    t = sign_tx(DynamicFeeTx(
+        chain_id_=CFG.chain_id, nonce=nonces[k], gas_tip_cap_=GWEI,
+        gas_fee_cap_=300 * GWEI, gas=gas, to=to, value=value,
+        data=data), KEYS[k], CFG.chain_id)
+    nonces[k] += 1
+    return t
+
+
+def _build_chain(n_blocks, gen_txs, extra=None):
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc=_alloc(extra))
+    db = Database()
+    gblock = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    def gen(i, bg):
+        for t in gen_txs(i, nonces):
+            bg.add_tx(t)
+
+    blocks, _ = generate_chain(CFG, gblock, db, n_blocks, gen, gap=2)
+    return blocks
+
+
+def _replay(blocks, extra=None, mesh=None, expect_fallbacks=0):
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc=_alloc(extra))
+    db = Database()
+    g = genesis.to_block(db)
+    eng = ReplayEngine(CFG, db, g.root, parent_header=g.header,
+                       window=4, mesh=mesh,
+                       **({"capacity": 256, "batch_pad": 64}
+                          if mesh is not None else {}))
+    root = eng.replay(blocks)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == expect_fallbacks, \
+        eng.stats.row()
+    return eng
+
+
+def _ab(blocks, extra=None, mesh=None, expect_fallbacks=0):
+    """Replay with specialization ON, then the CORETH_SPECIALIZE=0
+    generic A/B; both must land the exact header roots."""
+    spec = _replay(blocks, extra, mesh, expect_fallbacks)
+    os.environ["CORETH_SPECIALIZE"] = "0"
+    try:
+        gen = _replay(blocks, extra, mesh, expect_fallbacks)
+    finally:
+        del os.environ["CORETH_SPECIALIZE"]
+    assert spec.root == gen.root == blocks[-1].root
+    sc = spec._machine.machine_counters()
+    gc = gen._machine.machine_counters()
+    assert sc["lanes_specialized"] > 0
+    assert sc["programs_traced"] >= 1
+    assert gc["lanes_specialized"] == 0
+    assert gc["programs_traced"] == 0
+    return spec, gen
+
+
+# ------------------------------------------------------- eligibility
+def test_trace_eligibility():
+    from coreth_tpu.evm.device import specialize as SP
+    assert SP.trace_eligible(TOKEN_RUNTIME, "durango") == (True, "")
+    assert SP.trace_eligible(POOL_RUNTIME, "durango") == (True, "")
+    ok, reason = SP.trace_eligible(JUMPER_CODE, "durango")
+    assert not ok and "jump" in reason
+    # MSTORE8 is outside the traced subset
+    ok, reason = SP.trace_eligible(bytes.fromhex("600060005300"),
+                                   "durango")
+    assert not ok and "0x53" in reason
+
+
+# ------------------------------------------------------- equivalence
+def test_spec_equiv_erc20_machine(monkeypatch):
+    """The token workload through the general machine: keccak mapping
+    keys, fresh recipients, the revert branch traced as a predicated
+    path — spec and generic roots bit-identical."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen(i, nonces):
+        return [_tx(k, nonces, TOKEN,
+                    transfer_calldata(
+                        bytes([0x80 + i]) + bytes([k]) * 19, 3 + k))
+                for k in range(6)]
+
+    blocks = _build_chain(4, gen)
+    spec, _gen = _ab(blocks)
+    mx = spec._machine
+    assert mx.blocks == 4
+    assert mx.host_txs == 0
+    mc = mx.machine_counters()
+    assert mc["specialize_escapes"] == 0
+    assert mc["programs_traced"] == 1
+
+
+def test_spec_equiv_swap_full_conflict(monkeypatch):
+    """Every tx conflicts through the pool's reserve slots: the traced
+    program re-executes inside the device OCC rounds exactly like the
+    generic kernel (host_txs stays 0)."""
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+
+    def gen(i, nonces):
+        return [_tx(k, nonces, POOL, swap_calldata(1000 + 17 * i + k))
+                for k in range(6)]
+
+    blocks = _build_chain(4, gen)
+    spec, _gen = _ab(blocks)
+    assert spec._machine.host_txs == 0
+    assert spec._machine.rounds > 0   # the conflict chain did re-run
+
+
+def test_spec_equiv_mixed_and_revert(monkeypatch):
+    """Token + pool + plain transfers in one block, plus a transfer
+    whose amount exceeds the sender's token balance (the traced revert
+    leaf) — roots identical, receipts validated per block."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATHS", "0")
+
+    def gen(i, nonces):
+        return [
+            _tx(0, nonces, POOL, swap_calldata(500 + i)),
+            _tx(1, nonces, TOKEN,
+                transfer_calldata(b"\x45" * 20, 77)),
+            # amount 10**24 > the 10**21 grant: REVERT status receipt
+            _tx(2, nonces, TOKEN,
+                transfer_calldata(b"\x46" * 20, 10**24)),
+            _tx(3, nonces, bytes([0x47]) * 20, gas=21_000, value=5),
+        ]
+
+    blocks = _build_chain(3, gen)
+    _ab(blocks)
+
+
+@pytest.mark.parametrize("trie", _trie_backends)
+def test_spec_equiv_trie_backends(monkeypatch, trie):
+    """Spec-vs-generic equivalence under both trie backends."""
+    monkeypatch.setenv("CORETH_TRIE", trie)
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+
+    def gen(i, nonces):
+        return [_tx(k, nonces, TOKEN,
+                    transfer_calldata(ADDRS[(k + 1) % 8], 5 + k))
+                for k in range(5)]
+
+    blocks = _build_chain(3, gen)
+    _ab(blocks)
+
+
+@pytest.mark.parametrize("trie", _trie_backends)
+def test_spec_equiv_sharded(monkeypatch, trie):
+    """The sharded window runner composes with specialization: the
+    per-lane prog_id selection runs inside each shard's kernel body.
+    Roots bit-identical to the generic sharded path at 2 devices."""
+    monkeypatch.setenv("CORETH_TRIE", trie)
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen(i, nonces):
+        return [
+            _tx(0, nonces, POOL, swap_calldata(500 + i)),
+            _tx(1, nonces, TOKEN,
+                transfer_calldata(ADDRS[(i + 3) % 8], 7)),
+            _tx(2, nonces, TOKEN,
+                transfer_calldata(bytes([0x60 + i]) + b"\x01" * 19,
+                                  9 + i)),
+            _tx(3, nonces, POOL, swap_calldata(900 + i)),
+        ]
+
+    blocks = _build_chain(3, gen)
+    mesh = make_mesh(jax.devices("cpu")[:2])
+    spec, _gen = _ab(blocks, mesh=mesh)
+    from coreth_tpu.evm.device.shard import ShardedWindowRunner
+    assert isinstance(spec._machine._runner, ShardedWindowRunner)
+
+
+# ------------------------------------------------------------ escape
+def test_spec_unresolvable_jump_escapes(monkeypatch):
+    """A computed-jump contract is trace-ineligible: its lanes stay on
+    the generic interpreter kernel (specialize_escapes counted), token
+    lanes in the same blocks still specialize, and the chain root is
+    exact."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    extra = {JUMPER: GenesisAccount(balance=0, nonce=1,
+                                    code=JUMPER_CODE)}
+
+    def gen(i, nonces):
+        return [
+            _tx(0, nonces, JUMPER, data=JUMPER_DATA, gas=100_000),
+            _tx(1, nonces, TOKEN,
+                transfer_calldata(ADDRS[(i + 2) % 8], 11)),
+            _tx(2, nonces, JUMPER, data=JUMPER_DATA, gas=100_000),
+        ]
+
+    blocks = _build_chain(3, gen, extra)
+    eng = _replay(blocks, extra)
+    mx = eng._machine
+    assert mx.blocks == 3
+    mc = mx.machine_counters()
+    assert mc["specialize_escapes"] >= 6   # 2 jumper lanes x 3 blocks
+    assert mc["lanes_specialized"] >= 3    # the token lanes
+    assert mc["programs_traced"] == 1      # only the token traced
+
+
+# ------------------------------------------------------ recompile gate
+def test_spec_kernel_retraces_zero(monkeypatch):
+    """Tentpole CI gate: with specialization ENABLED, a forced
+    table-cap growth (fresh recipient slots every block, 64 -> 128
+    rows) still dispatches through pre-warmed kernels — zero mid-run
+    retraces, and the growth path's padded tables keep the roots."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+
+    def gen(i, nonces):
+        return [_tx(k, nonces, TOKEN,
+                    transfer_calldata(
+                        bytes([0xC0 + i]) + bytes([k]) * 19, 3 + k))
+                for k in range(8)]
+
+    blocks = _build_chain(8, gen)
+    eng = _replay(blocks)
+    mx = eng._machine
+    assert mx.blocks == 8
+    assert mx._runner.table_cap >= 128           # the cap DID grow
+    mc = mx.machine_counters()
+    assert mc["lanes_specialized"] > 0
+    assert mc["kernel_retraces"] == 0

@@ -105,13 +105,36 @@ def _own_nodes(fn: ast.AST):
 def _factory_returns(factory, by_name, seen):
     """Closures a factory hands to its caller: nested (or module-level)
     functions returned by name, plus — transitively — the returns of
-    any module-level factory whose *call result* is returned."""
+    any module-level factory whose *call result* is returned.  Program-
+    SET factories (the specialize.py shape: one traced sub-program per
+    contract) return comprehensions of factory calls —
+    ``return [build_one(c) for c in contracts]`` /
+    ``return tuple(build_one(c) for c in contracts)`` — whose element
+    factories are followed the same way."""
     if id(factory) in seen:
         return []
     seen.add(id(factory))
     nested = {n.name: n for n in _own_nodes(factory)
               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
     out = []
+
+    def follow(val):
+        if isinstance(val, ast.Name):
+            target = nested.get(val.id) or by_name.get(val.id)
+            if target is not None:
+                out.append(target)
+        elif isinstance(val, ast.Call):
+            leaf = _dotted_leaf(val.func)
+            if leaf in ("tuple", "list"):
+                for a in val.args:     # tuple(gen-expr of factory calls)
+                    follow(a)
+                return
+            inner = nested.get(leaf) or by_name.get(leaf)
+            if inner is not None:
+                out.extend(_factory_returns(inner, by_name, seen))
+        elif isinstance(val, (ast.ListComp, ast.GeneratorExp)):
+            follow(val.elt)
+
     for node in _own_nodes(factory):
         if not isinstance(node, ast.Return) or node.value is None:
             continue
@@ -119,14 +142,7 @@ def _factory_returns(factory, by_name, seen):
                 if isinstance(node.value, (ast.Tuple, ast.List))
                 else [node.value])  # `return init_fn, step_fn` counts
         for val in vals:
-            if isinstance(val, ast.Name):
-                target = nested.get(val.id) or by_name.get(val.id)
-                if target is not None:
-                    out.append(target)
-            elif isinstance(val, ast.Call):
-                inner = by_name.get(_dotted_leaf(val.func))
-                if inner is not None:
-                    out.extend(_factory_returns(inner, by_name, seen))
+            follow(val)
     return out
 
 
