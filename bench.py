@@ -621,6 +621,13 @@ def run_tpu(genesis, wire_blocks, txs_per_block, machine_stats=None):
                     / max(1, mc["premap_predicted"]), 3),
                 premap_array=mc["premap_array"],
                 kernel_retraces=mc["kernel_retraces"],
+                # key-range placement surface (0 on single-device /
+                # cold-contract runs): lanes placed by key range and
+                # the max/mean per-shard occupancy ratio
+                kr_lanes=mc["kr_lanes"],
+                load_imbalance=round(
+                    mc["load_imb_sum"]
+                    / max(1, mc["load_imb_windows"]) / 1000, 3),
                 # per-contract traced specialization (ISSUE 13): how
                 # many lanes ran straight-line sub-programs vs the
                 # generic interpreter escape hatch
@@ -1189,7 +1196,7 @@ def run_faults():
     return out
 
 
-def run_multichip_section():
+def run_multichip_section(env_extra=None, out_name="multichip_bench"):
     """Fold the virtual-mesh scaling curve (tools/mesh_scaling.py)
     into the same deadline budget: a truncated shape in a subprocess
     (the virtual device count must be set before jax initializes, so
@@ -1200,10 +1207,11 @@ def run_multichip_section():
     env.setdefault("SCALE_BLOCKS", "4")
     env.setdefault("SCALE_TXS", "128")
     env.setdefault("SCALE_REPS", "1")
+    env.update(env_extra or {})
     # the truncated in-bench shape must not clobber the standalone
     # harness's committed artifact
     env["SCALE_OUT"] = os.path.join(_DIR, ".bench_cache",
-                                    "multichip_bench.json")
+                                    f"{out_name}.json")
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(_DIR, "tools",
@@ -1218,6 +1226,127 @@ def run_multichip_section():
         return json.loads(r.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError) as exc:
         return {"error": f"parse: {exc}"}
+
+
+def run_hot_contract():
+    """Single-hot-contract section (ISSUE 14): ONE ERC-20-shaped
+    contract takes 100% of txs with Zipf sender/recipient skew, forced
+    through the general machine path (the key-range placement shape).
+    Per the bench-drift rule the section reports sustained txs/s plus
+    RATIOS only: vs_native (compiled C++ EVM replay of the same chain)
+    and vs_1dev (2-device / 1-device sustained txs/s from the
+    mesh-scaling subprocess — the flat-curve acceptance number),
+    plus the load_imbalance placement counter."""
+    from coreth_tpu import rlp
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.state import Database
+    from coreth_tpu.types import Block
+    from coreth_tpu.crypto import native as _native
+    from coreth_tpu.workloads import hot_contract as HC
+    n_blocks = int(os.environ.get("BENCH_HOT_BLOCKS", "64"))
+    txs = int(os.environ.get("BENCH_HOT_TXS", "128"))
+    if _section_left() < 120:
+        n_blocks = min(n_blocks, 16)
+    n_keys = min(256, N_KEYS)
+    seed, alpha = 20260804, 1.1
+    # genesis comes from the workload module (one key-derivation
+    # site), and the cache name carries every chain parameter so a
+    # workload-default change can never replay a stale cached chain
+    # against a fresh genesis
+    genesis, _keys, _addrs = HC.hot_genesis(CFG, n_keys)
+    cache = os.path.join(
+        _DIR, ".bench_cache",
+        f"hot_{n_blocks}x{txs}k{n_keys}s{seed}a{alpha}.bin")
+    if os.path.exists(cache):
+        blocks = [Block.decode(b)
+                  for b in rlp.decode(open(cache, "rb").read())]
+    else:
+        _g, blocks = HC.build_hot_chain(CFG, n_blocks, txs,
+                                        n_keys=n_keys, alpha=alpha,
+                                        seed=seed)
+        os.makedirs(os.path.dirname(cache), exist_ok=True)
+        with open(cache, "wb") as f:
+            f.write(rlp.encode([b.encode() for b in blocks]))
+    wire = [b.encode() for b in blocks]
+    out = {"blocks": len(blocks), "txs_per_block": txs}
+
+    saved = os.environ.get("CORETH_NO_TOKEN_FASTPATH")
+    os.environ["CORETH_NO_TOKEN_FASTPATH"] = "1"
+    try:
+        def one_rep():
+            fresh = [Block.decode(w) for w in wire]
+            db = Database()
+            gb = genesis.to_block(db)
+            eng = ReplayEngine(CFG, db, gb.root,
+                               parent_header=gb.header,
+                               capacity=1 << 13,
+                               slot_capacity=1 << 13,
+                               batch_pad=txs, window=16)
+            eng.replay_block(fresh[0])
+            t0 = time.monotonic()
+            eng.replay(fresh[1:])
+            dt = time.monotonic() - t0
+            assert eng.root == fresh[-1].header.root
+            assert eng.stats.blocks_fallback == 0, eng.stats.row()
+            n_txs = sum(len(b.transactions) for b in fresh[1:])
+            return n_txs / dt, eng
+
+        one_rep()  # compile warm-up, untimed
+        tps_runs = []
+        eng = None
+        for _ in range(REPS):
+            tps, eng = one_rep()
+            tps_runs.append(tps)
+            if _deadline_tight():
+                break
+        mc = eng._machine.machine_counters()
+        out.update({
+            "txs_s": round(_median(tps_runs), 1),
+            "spread_txs_s": _spread(tps_runs),
+            # single-device in-process reps: key-range placement only
+            # exists on a mesh, so kr_lanes/load_imbalance here would
+            # read as a structural 0 — the placement surface comes
+            # from the multichip subprocess below
+            "machine": {
+                "kernel_retraces": mc["kernel_retraces"],
+                "premap_hit_rate": round(
+                    mc["premap_hits"]
+                    / max(1, mc["premap_predicted"]), 3),
+                "lanes_specialized": mc["lanes_specialized"],
+            },
+        })
+        if _native.load() is not None and not _deadline_tight(60.0):
+            native_runs, _phases = run_native_evm(genesis, wire)
+            out["vs_native"] = round(
+                _median(tps_runs) / _median(native_runs), 3)
+    finally:
+        if saved is None:
+            os.environ.pop("CORETH_NO_TOKEN_FASTPATH", None)
+        else:
+            os.environ["CORETH_NO_TOKEN_FASTPATH"] = saved
+
+    # the flat-curve acceptance ratio: 2-device vs 1-device sustained
+    # txs/s on the SAME hot shape (machine path, key-range placement),
+    # measured by the mesh-scaling subprocess on the virtual mesh
+    if not _deadline_tight(45.0):
+        curve = run_multichip_section(
+            env_extra={"SCALE_WORKLOAD": "hot_contract",
+                       "SCALE_POINTS": "1,2",
+                       "SCALE_BLOCKS": "4",
+                       "SCALE_TXS": str(min(txs, 128)),
+                       "SCALE_REPS": "2"},
+            out_name="hot_multichip_bench")
+        pts = {p["n_devices"]: p for p in curve.get("points", [])}
+        if 1 in pts and 2 in pts:
+            out["vs_1dev"] = round(
+                pts[2]["txs_s_median"] / pts[1]["txs_s_median"], 3)
+            # max/mean per-shard lane occupancy at 2 devices (the
+            # key-range placement surface; n == collapse)
+            out["load_imbalance_2dev"] = pts[2].get("load_imbalance")
+        elif "error" in curve:
+            out["multichip_error"] = curve["error"]
+    return out
 
 
 def _begin_section(frac_end):
@@ -1413,7 +1542,7 @@ def main():
         else:
             skipped.append("tracing")
 
-        _begin_section(0.97)
+        _begin_section(0.96)
         if _remaining() > 30:
             # flat-state layer: cold-read speedup ratio + checkpoint
             # stamp-vs-export attribution (state/flat)
@@ -1421,6 +1550,16 @@ def main():
             _section_done("flat_state")
         else:
             skipped.append("flat_state")
+
+        _begin_section(0.985)
+        if _remaining() > 40:
+            # single-hot-contract (ISSUE 14): sustained txs/s +
+            # vs_native/vs_1dev ratios + load_imbalance — the
+            # key-range flat-curve acceptance surface
+            result["hot_contract"] = run_hot_contract()
+            _section_done("hot_contract")
+        else:
+            skipped.append("hot_contract")
 
         _begin_section(0.99)
         if _remaining() > 40:

@@ -824,6 +824,17 @@ class MachineWindowRunner:
         self.lanes_specialized = 0  # lanes run on a traced sub-program
         self.specialize_escapes = 0  # lanes kept on the generic kernel
         self.programs_traced = 0    # contracts compiled to sub-programs
+        # key-range sharding surface (evm/device/shard.py overrides
+        # populate these; the single-chip runner has no shards, so they
+        # stay zero — machine_counters() reads them uniformly)
+        self.kr_lanes = 0           # lanes placed by key-range bucket
+        self.load_imb_sum = 0       # sum of per-window max/mean lane
+        #                             occupancy ratios, in PERMILLE
+        #                             (integer: this package is in the
+        #                             determinism lint scope)
+        self.load_imb_windows = 0   # windows that ratio covers
+        self.exchange_psum = 0      # sync-exchange windows by mode
+        self.exchange_ppermute = 0
 
     # ------------------------------------------------------------ state
     def reset(self) -> None:
@@ -1431,6 +1442,14 @@ class MachineWindowRunner:
                          occ: M.OccParams) -> bool:
         return M.occ_compiled(p, occ, self._spec_key())
 
+    def _bucket_key(self, p: M.MachineParams, occ: M.OccParams,
+                    sk: Tuple) -> Tuple:
+        """Identity of one compiled kernel bucket for the retrace
+        accounting and the pre-warm joins.  The sharded runner extends
+        it with its exchange bucket, so an exchange-capacity re-bucket
+        counts (and pre-warms) exactly like a table-cap one."""
+        return (p, occ, sk)
+
     def _get_kernel(self, p: M.MachineParams, occ: M.OccParams):
         """Kernel for a dispatch, accounting retraces: a shape bucket
         this runner first reaches AFTER its first dispatch — without
@@ -1442,7 +1461,7 @@ class MachineWindowRunner:
         specialized-program set is part of the bucket identity: a new
         hot contract mid-run retraces exactly like a new op family
         would."""
-        key = (p, occ, self._spec_key())
+        key = self._bucket_key(p, occ, self._spec_key())
         if key not in self._buckets_used:
             self._buckets_used.add(key)
             if not self._cold:
@@ -1532,23 +1551,35 @@ class MachineWindowRunner:
                                         self._table_floor),
                           rounds=occ.rounds)
         sk = self._spec_key()
-        if (p, nxt, sk) in self._buckets_used:
+        bk = self._bucket_key(p, nxt, sk)
+        if bk in self._buckets_used:
             return
-        self._buckets_used.add((p, nxt, sk))
+        self._buckets_used.add(bk)
         if self._kernel_compiled(p, nxt):
             return  # cache-warm from an earlier runner/rep
         if self._compile_async:
             # the trace runs on the compile thread while the CURRENT
             # window executes on the main thread — on CPU hosts this
             # hides the whole compile instead of serializing it here.
-            # The spec key is captured NOW: the warm must compile the
-            # bucket the scheduling dispatch saw, not whatever program
-            # set exists when the worker gets to it.
-            self._warm_pending[(p, nxt, sk)] = _compile_pool().submit(
-                self._warm_compile, p, nxt, sk)
+            # The FULL bucket identity is captured NOW via the thunk
+            # (spec key here; the sharded runner adds its exchange
+            # bucket/mode): the warm must compile the bucket the
+            # scheduling dispatch saw, not whatever state exists when
+            # the worker gets to it.
+            self._warm_pending[bk] = _compile_pool().submit(
+                self._warm_thunk(p, nxt, sk))
             return
         fn = self._kernel(p, nxt, sk)
         fn(*self._warm_args(p, nxt))
+
+    def _warm_thunk(self, p: M.MachineParams, occ: M.OccParams,
+                    sk: Tuple):
+        """Zero-arg warm-compile body with the bucket identity bound
+        at SCHEDULING time (the sharded override additionally pins its
+        live exchange bucket/mode — the pool worker must compile the
+        bucket recorded in _buckets_used, not whatever those values
+        are when it runs)."""
+        return lambda: self._warm_compile(p, occ, sk)
 
     def _warm_compile(self, p: M.MachineParams, occ: M.OccParams,
                       sk: Tuple = ()) -> None:
@@ -1572,6 +1603,14 @@ class MachineWindowRunner:
     def _on_result_fetch(self, handle: dict) -> None:
         """Hook for the sharded runner's dispatch-ordering trace."""
         obs.instant("device/result_fetch")
+
+    def _discover_key(self, handle: dict, bi: int, li: int,
+                      contract: bytes, key: bytes) -> None:
+        """Map a key a lane's F_MISS escape discovered.  The sharded
+        override allocates it on the DISCOVERING lane's shard — the
+        retry premaps it locally instead of minting a replica of a
+        hash-bucket copy no lane runs next to."""
+        self._gid(contract, key)
 
     def complete(self, handle: dict) -> WindowResult:
         """Fetch a window's results; resolve any storage keys that
@@ -1598,7 +1637,8 @@ class MachineWindowRunner:
                     fresh: List[bytes] = []
                     for key in miss_keys(pout, bi * Lp + fl):
                         if not self._key_mapped(t.address, key):
-                            self._gid(t.address, key)
+                            self._discover_key(handle, bi, li,
+                                               t.address, key)
                         if key not in disc:
                             disc[key] = None
                             fresh.append(key)

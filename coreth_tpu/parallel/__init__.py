@@ -9,6 +9,7 @@ per-account reductions cross shards with psum_scatter.
 
 from coreth_tpu.parallel.mesh import (  # noqa: F401
     _shard_map,
+    collective_reduce,
     make_mesh,
     sharded_recover,
     sharded_slot_step,
@@ -17,5 +18,7 @@ from coreth_tpu.parallel.mesh import (  # noqa: F401
 from coreth_tpu.parallel.shard import (  # noqa: F401
     account_bucket,
     contract_bucket,
+    exchange_mode,
     remap_rows,
+    slot_bucket,
 )

@@ -25,6 +25,8 @@ on the address, never on discovery order.
 
 from __future__ import annotations
 
+import os
+
 
 def account_bucket(addr_hash: bytes, n_shards: int) -> int:
     """Owning shard of an account row, from keccak256(address)."""
@@ -39,9 +41,45 @@ def contract_bucket(addr_hash: bytes, n_shards: int) -> int:
     return account_bucket(addr_hash, n_shards)
 
 
+def slot_bucket(key_hash: bytes, n_shards: int) -> int:
+    """Owning shard of ONE storage slot under KEY-RANGE placement
+    (keccak256 of the raw 32-byte slot key): the intra-contract
+    partition for HOT contracts (ISSUE 14 / the FAFO ceiling) — one
+    contract's storage spreads over every shard instead of landing
+    wholesale on ``contract_bucket``.  Hashing the key (rather than
+    using ``key[0]`` directly) keeps PUSH-constant slots (0, 1, ...)
+    as uniform as keccak-derived mapping keys."""
+    if n_shards <= 1:
+        return 0
+    return key_hash[0] % n_shards
+
+
 def remap_rows(rows, old_arena: int, new_arena: int):
     """Row ids after an arena doubling: shard-major layout means every
     row moves to ``shard*new_arena + local`` (shard = row//old_arena,
     local = row % old_arena)."""
     return [(r // old_arena) * new_arena + (r % old_arena)
             for r in rows]
+
+
+def exchange_mode(touched: int, total: int, n_shards: int) -> str:
+    """Which collective carries a window's cross-shard exchange:
+    ``"psum"`` (one all-reduce of the packed effect tensor — the PR-8
+    shape) or ``"ppermute"`` (a ring of n-1 point-to-point permutes
+    accumulating the same integer sums — cheaper on real ICI when the
+    touched cross-shard set is small relative to the table).  Integer
+    adds/maxes are associative and commutative, so BOTH modes produce
+    bit-identical tensors at every mesh width (pinned by the
+    equivalence tests); the choice is performance-only and
+    deterministic: CORETH_EXCHANGE=psum|ppermute forces it (the A/B
+    override), otherwise density = touched/total under
+    CORETH_EXCHANGE_DENSITY (default 0.25) selects ppermute for the
+    sparse common case."""
+    if n_shards <= 1:
+        return "psum"
+    forced = os.environ.get("CORETH_EXCHANGE", "")
+    if forced in ("psum", "ppermute"):
+        return forced
+    thresh = float(  # noqa: DET002 — selects between BIT-IDENTICAL collectives (performance only); no consensus value flows through it
+        os.environ.get("CORETH_EXCHANGE_DENSITY", "0.25"))
+    return "ppermute" if touched <= thresh * max(1, total) else "psum"

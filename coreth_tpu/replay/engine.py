@@ -128,6 +128,10 @@ class ReplayStats:
     # the replay loop) vs the native host batch
     sigs_device: int = 0
     sigs_host: int = 0
+    # max/mean per-shard lane occupancy of the sharded OCC windows
+    # (1.0 = flat; n_shards = the one-hot-contract collapse key-range
+    # placement removes).  0.0 until a sharded machine window ran.
+    load_imbalance: float = 0.0
 
     def row(self) -> dict:
         return dict(self.__dict__)
@@ -694,7 +698,6 @@ class ReplayEngine:
         self._n_shards = 1
         if mesh is not None and mesh.devices.size > 1:
             from coreth_tpu.parallel import sharded_recover
-            from coreth_tpu.replay.shard import sharded_transfer_window
             cap = capacity
             scap = slot_capacity or capacity
             n_dev = mesh.devices.size
@@ -708,7 +711,8 @@ class ReplayEngine:
                         "the initial value")
             self.mesh = mesh
             self._n_shards = n_dev
-            self._mesh_window = sharded_transfer_window(mesh)
+            # the transfer-window kernel itself is fetched per window
+            # (_issue_window_mesh picks the exchange mode by density)
             self._mesh_recover = sharded_recover(mesh)
         from coreth_tpu.mpt import native_trie
         # commit-path backend: CORETH_TRIE=native|py (default: native
@@ -1351,15 +1355,26 @@ class ReplayEngine:
         single-device layout, so _complete_window is shared — and the
         old per-block dispatch + per-block blocking sync that inverted
         the scaling curve is gone."""
-        from coreth_tpu.replay.shard import interleave_txs
+        from coreth_tpu.parallel import exchange_mode
+        from coreth_tpu.replay.shard import (
+            interleave_txs, sharded_transfer_window)
         t0 = time.monotonic()
         (txds, t_idxs, s_idxs, acct_rows, slot_rows, touched_lists,
          slot_lists, flushed) = self._prepare_window(items)
         prev = (self.state.balances, self.state.nonces,
                 self.state.slot_vals)
         perm = interleave_txs(txds.shape[1], self._n_shards)
+        # per-window collective selection: the packed effect exchange
+        # rides psum, or the bit-identical ppermute ring when the
+        # window's touched set is sparse against the state tables
+        # (CORETH_EXCHANGE forces one mode for the A/B)
+        mode = exchange_mode(
+            acct_rows.shape[0] + slot_rows.shape[0],
+            self.state.capacity + self.state.slot_capacity,
+            self._n_shards)
+        win = sharded_transfer_window(self.mesh, mode)
         with obs.jax_span("coreth/transfer_window"):
-            new_bal, new_non, new_sv, fetches = self._mesh_window(
+            new_bal, new_non, new_sv, fetches = win(
                 prev[0], prev[1], prev[2], jnp.asarray(acct_rows),
                 jnp.asarray(slot_rows), jnp.asarray(txds[:, perm]),
                 jnp.asarray(t_idxs), jnp.asarray(s_idxs))

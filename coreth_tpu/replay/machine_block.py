@@ -111,7 +111,8 @@ class MachineBlockExecutor:
             premap_predicted=0, premap_hits=0, premap_nested=0,
             premap_array=0, discovery_dispatches=0, kernel_retraces=0,
             lanes_specialized=0, specialize_escapes=0,
-            programs_traced=0)
+            programs_traced=0, kr_lanes=0, load_imb_sum=0,
+            load_imb_windows=0, exchange_psum=0, exchange_ppermute=0)
 
     def machine_counters(self) -> dict:
         """Predicted-premap + kernel-retrace counters over every
@@ -860,6 +861,17 @@ class MachineBlockExecutor:
             inflight = None
             self.windows += 1
             self.window_attempts += wres.attempts
+            imb_w = (self._runner_totals["load_imb_windows"]
+                     + runner.load_imb_windows)
+            if imb_w:
+                # max/mean per-shard lane occupancy (permille counts),
+                # averaged over EVERY sharded window this executor has
+                # run — including runners a fault rebuild discarded
+                # (ReplayStats -> metrics registry -> bench
+                # multichip/hot_contract sections)
+                e.stats.load_imbalance = round(
+                    (self._runner_totals["load_imb_sum"]
+                     + runner.load_imb_sum) / imb_w / 1000, 3)
             if early is not None and not all(wres.clean):
                 # cannot happen (a clean exchange implies clean packed
                 # results); distrust the device table if it ever does

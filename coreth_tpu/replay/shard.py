@@ -48,12 +48,13 @@ from coreth_tpu.ops import u256
 from coreth_tpu.parallel import _shard_map
 
 
-# jitted window kernels memoized per mesh: rebuilding per engine would
-# retrace (and on the scaling harness recompile) every rep
+# jitted window kernels memoized per (mesh, exchange mode): rebuilding
+# per engine would retrace (and on the scaling harness recompile)
+# every rep; the psum/ppermute variants coexist (at most two compiles)
 _WINDOWS: Dict[Tuple, object] = {}
 
 
-def sharded_transfer_window(mesh):
+def sharded_transfer_window(mesh, mode: str = "psum"):
     """Build (memoized) the windowed sharded transfer kernel.
 
     Signature matches engine._transfer_window plus the row indirection:
@@ -66,16 +67,23 @@ def sharded_transfer_window(mesh):
     txds carry LOCAL indices (the _prepare_window working set); the
     caller interleaves txs round-robin over the tx axis so every device
     gets P/n real lanes, not the zero-padded tail.
+
+    ``mode`` selects the per-block effect exchange's collective: one
+    psum, or the equivalent ppermute ring (parallel.collective_reduce)
+    — integer sums, so fetch tensors and roots are bit-identical
+    either way (the engine picks per window by touched-set density;
+    CORETH_EXCHANGE overrides).
     """
-    key = (tuple(mesh.devices.flat), mesh.axis_names)
+    key = (tuple(mesh.devices.flat), mesh.axis_names, mode)
     fn = _WINDOWS.get(key)
     if fn is None:
-        fn = _build_window(mesh)
+        fn = _build_window(mesh, mode)
         _WINDOWS[key] = fn
     return fn
 
 
-def _build_window(mesh):
+def _build_window(mesh, mode: str = "psum"):
+    from coreth_tpu.parallel import collective_reduce
     from coreth_tpu.replay.engine import _gather_fetch, txd_cols
     n_dev = mesh.devices.size
 
@@ -130,13 +138,16 @@ def _build_window(mesh):
             expected = cb_non[senders] + offsets
             nonce_ok = jnp.all(
                 jnp.where(mask, tx_nonce == expected, True))
-            # THE cross-shard exchange: one psum of the packed effect
-            # tensors (payload O(touched set), not O(table))
+            # THE cross-shard exchange: one reduce of the packed effect
+            # tensors (payload O(touched set), not O(table)) — a psum,
+            # or the bit-identical ppermute ring when the engine judged
+            # the touched set sparse
             pack_a = jnp.concatenate(
                 [debit_p, req_p, credit_p, counts_p[:, None]], axis=1)
             pack_s = jnp.concatenate([sdeb_p, scred_p], axis=1)
-            pack_a, pack_s, nonce_n = jax.lax.psum(
-                (pack_a, pack_s, nonce_ok.astype(jnp.int32)), "dp")
+            pack_a, pack_s, nonce_n = collective_reduce(
+                (pack_a, pack_s, nonce_ok.astype(jnp.int32)), "dp",
+                n_dev, mode, op="add")
             debit_t = u256.normalize(pack_a[:, 0:16])
             req_t = u256.normalize(pack_a[:, 16:32])
             credit_t = u256.normalize(pack_a[:, 32:48])

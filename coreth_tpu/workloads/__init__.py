@@ -3,3 +3,7 @@ from coreth_tpu.workloads.erc20 import (  # noqa: F401
     balance_slot, transfer_calldata, parse_transfer_calldata,
     token_genesis_account, measure_transfer_exec_gas, intrinsic_gas,
 )
+from coreth_tpu.workloads.hot_contract import (  # noqa: F401
+    HOT_CONTRACT, HOT_RUNTIME, build_hot_chain, hot_genesis_alloc,
+    hot_tx_gen, zipf_sampler,
+)

@@ -124,6 +124,8 @@ def test_declared_points_all_covered():
             "test_faults::test_persistent_device_fault_demotes",
         "device/shard_exchange":
             "test_faults::test_shard_exchange_fault_demotes",
+        "device/key_exchange":
+            "test_faults::test_key_exchange_fault_demotes",
         "native/error_rc": "test_faults::test_native_error_rc",
         "native/session_loss": "test_faults::test_native_session_loss",
         "native/oracle_divergence":
@@ -316,6 +318,44 @@ def test_shard_exchange_fault_demotes(monkeypatch):
     assert root == blocks[-1].header.root
     assert fired >= 1
     assert eng.supervisor.strikes >= 1
+
+
+def test_key_exchange_fault_demotes(monkeypatch):
+    """The INTRA-contract key-range exchange seam (ISSUE 14): a
+    persistent fault at the replica-sync collective on a 2-device mesh
+    with a hot contract — contained, struck toward device demotion,
+    and the chain still completes with the exact root on the host
+    ladder."""
+    import jax
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    from coreth_tpu.parallel import make_mesh
+    from coreth_tpu.params import TEST_CHAIN_CONFIG as CFG
+    from coreth_tpu.replay import ReplayEngine
+    from coreth_tpu.state import Database
+    from coreth_tpu.workloads.hot_contract import build_hot_chain
+    _fast_supervisor_env(monkeypatch)
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+    monkeypatch.setenv("CORETH_KEYRANGE_THRESHOLD", "3")
+    genesis, blocks = build_hot_chain(CFG, 4, 6, n_keys=8)
+    db = Database()
+    gblock = genesis.to_block(db)
+    eng = ReplayEngine(genesis.config, db, gblock.root,
+                       parent_header=gblock.header, capacity=256,
+                       batch_pad=64, window=4,
+                       mesh=make_mesh(devs[:2]))
+    with faults.armed(FaultPlan({"device/key_exchange":
+                                 FaultSpec()})) as plan:
+        root = eng.replay(list(blocks))
+        fired = plan.fired().get("device/key_exchange", 0)
+    assert root == blocks[-1].header.root
+    assert fired >= 1
+    assert eng.supervisor.strikes >= 1
+    assert eng.supervisor.demotions >= 1
+    assert eng.stats.blocks_fallback > 0  # host ladder finished it
 
 
 def test_recover_fault_degrades(monkeypatch):

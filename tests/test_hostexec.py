@@ -60,9 +60,11 @@ def test_workload_contracts_native_coverage():
     workload silently outgrows the native (or device) engine."""
     from coreth_tpu.evm.device.tables import scan_code
     from coreth_tpu.workloads.erc20 import TOKEN_RUNTIME
+    from coreth_tpu.workloads.hot_contract import HOT_RUNTIME
     from coreth_tpu.workloads.swap import POOL_RUNTIME
     for name, code in (("erc20", TOKEN_RUNTIME),
-                       ("swap", POOL_RUNTIME)):
+                       ("swap", POOL_RUNTIME),
+                       ("hot_contract", HOT_RUNTIME)):
         ok, reason = native_eligible(code, "durango")
         assert ok, f"{name} outgrew the native opcode set: {reason}"
         info = scan_code(code, "durango")

@@ -412,3 +412,216 @@ def test_two_device_scaling_smoke():
     tps2 = max(run(mesh2), run(mesh2))
     assert tps2 * 2 >= tps1, (
         f"2-device replay collapsed: {tps2:.0f} vs {tps1:.0f} txs/s")
+
+
+# ===================================================== key-range (ISSUE 14)
+# One hot ERC-20-shaped contract taking 100% of lanes: contract-bucket
+# placement serialized this shape onto one shard; key-range placement
+# (slot_bucket + conflict-component co-location + the per-block replica
+# sync exchange) must keep roots bit-identical at every width and both
+# exchange modes, and keep the 2-device curve flat.
+
+def _hot_chain(n_blocks=6, txs=6, n_keys=8, seed=20260804):
+    from coreth_tpu.workloads.hot_contract import build_hot_chain
+    return build_hot_chain(CFG, n_blocks, txs, n_keys=n_keys,
+                           seed=seed)
+
+
+def _force_machine(monkeypatch, threshold="3"):
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+    monkeypatch.setenv("CORETH_MACHINE_WINDOW", "2")
+    monkeypatch.setenv("CORETH_KEYRANGE_THRESHOLD", threshold)
+
+
+def _replay_hot(genesis, blocks, mesh, window=4):
+    db = Database()
+    g = genesis.to_block(db)
+    eng = ReplayEngine(CFG, db, g.root, parent_header=g.header,
+                       window=window, capacity=256, batch_pad=64,
+                       mesh=mesh)
+    root = eng.replay(list(blocks))
+    return root, eng
+
+
+@pytest.mark.parametrize("trie", _trie_backends)
+def test_keyrange_exchange_mode_equivalence(monkeypatch, trie):
+    """THE ISSUE-14 equivalence matrix: the single-hot-contract chain
+    replays to bit-identical roots across CORETH_EXCHANGE=psum|ppermute
+    x 1/2/4 devices x both trie backends, with key-range placement
+    active (kr_lanes > 0) and the selected collective actually used."""
+    monkeypatch.setenv("CORETH_TRIE", trie)
+    _force_machine(monkeypatch)
+    genesis, blocks = _hot_chain()
+    want = blocks[-1].root
+    root1, _e1 = _replay_hot(genesis, blocks, None)
+    assert root1 == want
+    for mode in ("psum", "ppermute"):
+        monkeypatch.setenv("CORETH_EXCHANGE", mode)
+        for nd in (2, 4):
+            mesh = make_mesh(jax.devices("cpu")[:nd])
+            root, eng = _replay_hot(genesis, blocks, mesh)
+            assert root == want, (mode, nd)
+            assert eng.stats.blocks_fallback == 0
+            mc = eng._machine.machine_counters()
+            assert mc["kr_lanes"] > 0
+            used = mc["exchange_psum" if mode == "psum"
+                      else "exchange_ppermute"]
+            other = mc["exchange_ppermute" if mode == "psum"
+                       else "exchange_psum"]
+            assert used > 0 and other == 0, (mode, nd, mc)
+            assert eng.stats.load_imbalance > 0
+
+
+@pytest.mark.parametrize(
+    "gen,machine", [(_gen_transfer, False), (_gen_erc20, True)],
+    ids=["transfer", "erc20"])
+def test_exchange_mode_equivalence_classic_paths(monkeypatch, gen,
+                                                 machine):
+    """CORETH_EXCHANGE on the pre-existing exchanges: the transfer
+    window's packed effect reduce and the contract-bucket machine
+    path's flags exchange produce identical roots in both modes."""
+    if machine:
+        # high threshold: the token stays contract-bucketed, so this
+        # pins the FLAGS exchange, not the key-range sync
+        _force_machine(monkeypatch, threshold="64")
+    blocks = _build_chain(3, gen)
+    want = blocks[-1].root
+    root1, _ = _replay(blocks, None)
+    assert root1 == want
+    mesh = make_mesh(jax.devices("cpu")[:2])
+    for mode in ("psum", "ppermute"):
+        monkeypatch.setenv("CORETH_EXCHANGE", mode)
+        root, eng = _replay(blocks, mesh)
+        assert root == want, mode
+        assert eng.stats.blocks_fallback == 0
+
+
+def test_keyrange_empty_sync_is_ppermute_degenerate(monkeypatch):
+    """A hot-contract run whose lanes never share keys: the exchange
+    kernel is active (key-range placement on) but the cross-range set
+    stays EMPTY every window — the ppermute degenerate case — and
+    roots stay exact."""
+    from coreth_tpu.chain import Genesis, generate_chain
+    from coreth_tpu.workloads.hot_contract import (
+        HOT_CONTRACT, hot_genesis_alloc)
+    from coreth_tpu.workloads.erc20 import transfer_calldata
+    _force_machine(monkeypatch)
+    monkeypatch.setenv("CORETH_EXCHANGE", "ppermute")
+    genesis = Genesis(config=CFG, gas_limit=8_000_000,
+                      alloc=hot_genesis_alloc(ADDRS))
+    db = Database()
+    g = genesis.to_block(db)
+    nonces = [0] * len(KEYS)
+
+    def gen(i, bg):
+        # every lane: distinct sender -> a UNIQUE fresh recipient, so
+        # no two lanes (in any block) ever share a storage key
+        for k in range(6):
+            to = bytes([0x51 + i]) + bytes([k]) * 15 + b"\x51" * 4
+            bg.add_tx(_tx(k, nonces, HOT_CONTRACT,
+                          transfer_calldata(to, 3 + k)))
+
+    blocks, _ = generate_chain(CFG, g, db, 4, gen, gap=2)
+    mesh = make_mesh(jax.devices("cpu")[:2])
+    root, eng = _replay_hot(genesis, blocks, mesh)
+    assert root == blocks[-1].root
+    assert eng.stats.blocks_fallback == 0
+    runner = eng._machine._runner
+    assert runner._xchg_hw > 0          # exchange kernel compiled in
+    assert runner._sync_last == 0       # ... with an empty sync set
+    assert eng._machine.machine_counters()["exchange_ppermute"] > 0
+
+
+def test_keyrange_dense_forces_psum_fallback(monkeypatch):
+    """Auto mode with the density threshold at 0: any nonempty sync
+    set reads as dense, so the selector must fall back to the full
+    psum — and roots stay exact."""
+    _force_machine(monkeypatch)
+    monkeypatch.delenv("CORETH_EXCHANGE", raising=False)
+    monkeypatch.setenv("CORETH_EXCHANGE_DENSITY", "0.0")
+    genesis, blocks = _hot_chain()
+    mesh = make_mesh(jax.devices("cpu")[:2])
+    root, eng = _replay_hot(genesis, blocks, mesh)
+    assert root == blocks[-1].root
+    runner = eng._machine._runner
+    mc = eng._machine.machine_counters()
+    if runner._sync_last or runner._xchg_locked:
+        assert runner._xchg_mode == "psum"
+        assert mc["exchange_psum"] > 0
+
+
+def test_keyrange_specialize_retrace_gate(monkeypatch):
+    """ISSUE-14 acceptance: kernel_retraces == 0 holds with key-range
+    sharding AND per-contract specialization both on, load_imbalance
+    reaches ReplayStats + the metrics registry, and the placement
+    instant lands on the tracer ring (the Perfetto surface)."""
+    from coreth_tpu.metrics import Registry
+    from coreth_tpu.obs.trace import SpanTracer, install, uninstall
+    _force_machine(monkeypatch)
+    monkeypatch.setenv("CORETH_SPECIALIZE", "1")
+    genesis, blocks = _hot_chain()
+    mesh = make_mesh(jax.devices("cpu")[:2])
+    tr = SpanTracer()
+    install(tr)
+    try:
+        root, eng = _replay_hot(genesis, blocks, mesh)
+    finally:
+        uninstall()
+    assert root == blocks[-1].root
+    mc = eng._machine.machine_counters()
+    assert mc["kernel_retraces"] == 0, mc
+    assert mc["kr_lanes"] > 0
+    assert mc["lanes_specialized"] > 0  # spec programs per key-range shard
+    assert eng.stats.load_imbalance > 0
+    reg = Registry()
+    eng.publish_metrics(reg)
+    g = reg.get("replay/load_imbalance")
+    assert g is not None and g.value > 0
+    assert any(e.get("name") == "shard/load_imbalance"
+               for e in list(tr._ring)), "placement instant not traced"
+
+
+def test_two_device_hot_contract_smoke(monkeypatch):
+    """Tier-1 ISSUE-14 scaling gate: on the single-hot-contract shape
+    (machine path, DEFAULT key-range env) a 2-device mesh must sustain
+    >= 0.8x of 1-device txs/s — a return of the one-shard
+    serialization collapse fails CI, not just the bench curve."""
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    monkeypatch.setenv("CORETH_SERIAL_SHORTCIRCUIT", "0")
+    # realistic-pool shape: Zipf over a sender population comparable
+    # to the block size, so the conflict graph keeps a parallel tail
+    # instead of percolating into one giant component.  96-tx blocks
+    # amortize the per-window collective/dispatch overhead enough for
+    # a stable margin (measured ratio 0.91-0.95 vs 0.86 at 48 txs,
+    # which dipped under the gate under full-suite load)
+    n_blocks, txs = 6, 96
+    genesis, blocks = _hot_chain(n_blocks=n_blocks, txs=txs,
+                                 n_keys=128)
+
+    def run(mesh):
+        db = Database()
+        gb = genesis.to_block(db)
+        eng = ReplayEngine(CFG, db, gb.root, parent_header=gb.header,
+                           capacity=1024, batch_pad=64, window=4,
+                           mesh=mesh)
+        t0 = time.monotonic()
+        root = eng.replay(list(blocks))
+        dt = time.monotonic() - t0
+        assert root == blocks[-1].header.root
+        assert eng.stats.blocks_fallback == 0
+        return n_blocks * txs / dt
+
+    mesh2 = make_mesh(jax.devices("cpu")[:2])
+    run(None)          # compile + recipe warm-up, both widths
+    run(mesh2)
+    # best-of-3 per width, INTERLEAVED: the 1-core box drifts under
+    # suite load, and alternating widths decorrelates that drift from
+    # the ratio this test actually gates
+    tps1, tps2 = 0.0, 0.0
+    for _ in range(3):
+        tps1 = max(tps1, run(None))
+        tps2 = max(tps2, run(mesh2))
+    assert tps2 >= 0.8 * tps1, (
+        f"hot-contract 2-device curve collapsed: {tps2:.0f} vs "
+        f"{tps1:.0f} txs/s")

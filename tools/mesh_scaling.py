@@ -47,9 +47,30 @@ GWEI = 10**9
 TXS = int(os.environ.get("SCALE_TXS", "512"))
 N_BLOCKS = int(os.environ.get("SCALE_BLOCKS", "16"))
 REPS = int(os.environ.get("SCALE_REPS", "3"))
+# transfer (default) or hot_contract: ONE ERC-20-shaped contract
+# taking 100% of txs with Zipf sender/recipient skew (the ISSUE-14
+# key-range acceptance shape — forced through the machine path)
+WORKLOAD = os.environ.get("SCALE_WORKLOAD", "transfer")
+# which mesh widths to measure, e.g. SCALE_POINTS=1,2
+POINTS = tuple(int(x) for x in os.environ.get(
+    "SCALE_POINTS", "1,2,4,8").split(","))
 
 
 def build_chain():
+    if WORKLOAD == "hot_contract":
+        from coreth_tpu.workloads.hot_contract import build_hot_chain
+        # the hot path must exercise the general machine-OCC path (the
+        # token fast path already shards work by tx and would mask the
+        # placement ceiling this harness measures)
+        os.environ["CORETH_NO_TOKEN_FASTPATH"] = "1"
+        # population sizes matter: Zipf over a tiny sender pool makes
+        # the head cartoonishly heavy and the per-block conflict graph
+        # percolates into one giant (irreducibly serial) component —
+        # realistic millions-of-users traffic has heavy heads over
+        # LARGE populations, so scale the pools with the block size
+        genesis, blocks = build_hot_chain(
+            CFG, N_BLOCKS, TXS, n_keys=min(512, max(32, 2 * TXS)))
+        return genesis, [b.encode() for b in blocks]
     keys = [0xD00D + i for i in range(64)]
     addrs = [priv_to_address(k) for k in keys]
     genesis = Genesis(config=CFG, gas_limit=30_000_000,
@@ -87,7 +108,7 @@ def run_once(genesis, wire, mesh):
     dt = time.monotonic() - t0
     assert root == blocks[-1].header.root
     assert eng.stats.blocks_fallback == 0
-    return N_BLOCKS * TXS / dt, dt
+    return N_BLOCKS * TXS / dt, dt, eng.stats.load_imbalance
 
 
 def _emit_partial(result, out):
@@ -114,19 +135,20 @@ def main():
                   "measures partitioning overhead and correctness, NOT "
                   "ICI scaling; real multi-chip speedup requires real "
                   "chips",
-        "workload": f"{N_BLOCKS} blocks x {TXS} transfer txs, "
+        "workload": f"{N_BLOCKS} blocks x {TXS} {WORKLOAD} txs, "
                     f"full ReplayEngine incl. sender recovery + trie",
         "reps": REPS,
         "points": [],
     }
     out = os.environ.get(
         "SCALE_OUT", os.path.join(_DIR, "MULTICHIP_SCALING.json"))
-    for n in (1, 2, 4, 8):
+    for n in POINTS:
         mesh = make_mesh(devices[:n]) if n > 1 else None
         runs = []
         cold_s = 0.0
+        imb = 0.0
         for r in range(REPS + 1):
-            tps, dt = run_once(genesis, wire, mesh)
+            tps, dt, imb = run_once(genesis, wire, mesh)
             if r > 0:          # rep 0 = compile warm-up, excluded
                 runs.append(tps)
             else:
@@ -140,6 +162,9 @@ def main():
             "txs_s_median": round(median, 1),
             "txs_s_spread": [round(runs[0], 1), round(runs[-1], 1)],
             "compile_ms": round(max(0.0, cold_s - warm_s) * 1000, 1),
+            # max/mean per-shard lane occupancy (sharded machine
+            # windows only; 0.0 on the transfer path / single device)
+            "load_imbalance": imb,
         })
         print(f"n={n}: {runs}", file=sys.stderr)
         _emit_partial(result, out)
