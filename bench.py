@@ -1009,6 +1009,98 @@ def run_tracing():
     return out
 
 
+def run_forensics():
+    """Forensics section (obs/recorder): the divergence flight
+    recorder armed vs unarmed on the SAME backlog streaming shape,
+    interleaved reps so box drift hits both sides equally.  The
+    ``recorder_overhead`` RATIO is the regression signal (bench-drift
+    rule) and must stay >= 0.95 — the witness ring must never become
+    the new bottleneck.  Plus one INJECTED trip: a poison block
+    quarantines, freezes a bundle, and the section records the
+    bundle's on-disk size and drain-thread write latency."""
+    import shutil
+    import tempfile
+    from coreth_tpu.obs import recorder as _rec
+    from coreth_tpu.serve import ChainFeed, StreamingPipeline
+    from coreth_tpu.serve.pipeline import _corrupt_block
+    from coreth_tpu.types import Block
+    genesis, blocks = build_or_load_chain("transfer")
+    n = min(len(blocks),
+            int(os.environ.get("BENCH_FORENSICS_BLOCKS", "96")))
+    wire = [b.encode() for b in blocks[:n]]
+    out = {"blocks": n}
+    tmp = tempfile.mkdtemp(prefix="bench_forensics_")
+
+    def one_run(armed, feed_wire=wire, expect_root=True):
+        fresh = [Block.decode(w) for w in feed_wire]
+        # a CORETH_FORENSICS=1 env must not silently arm the
+        # "unarmed" side through arm_from_env (the tracing-A/B rule)
+        prev_env = os.environ.pop("CORETH_FORENSICS", None)
+        try:
+            if armed:
+                rec = _rec.install(out_dir=tmp)
+            else:
+                rec = None
+                _rec.uninstall()
+            engine = _fresh_engine(genesis, TXS_PER_BLOCK)
+            pipe = StreamingPipeline(engine, ChainFeed(fresh),
+                                     window_wait=0.005)
+            rep = pipe.run()
+        finally:
+            _rec.uninstall()
+            if prev_env is not None:
+                os.environ["CORETH_FORENSICS"] = prev_env
+        if expect_root:
+            assert engine.root == fresh[-1].header.root
+        return rep, rec
+
+    try:
+        one_run(False)  # warm-up: XLA compiles must not skew the A/B
+        plain, armed = [], []
+        for r in range(4):
+            # alternate which side goes first: on this 1-core box the
+            # second run of a pair measures systematically slower
+            # (scheduler/GC debt from the first), which read as a fake
+            # ~5% recorder overhead when armed always went second
+            order = (False, True) if r % 2 == 0 else (True, False)
+            for is_armed in order:
+                rep_x, _rec0 = one_run(is_armed)
+                (armed if is_armed else plain).append(
+                    rep_x.sustained_txs_s)
+            if _deadline_tight():
+                break
+        out["unarmed_txs_s"] = round(max(plain), 1)
+        out["armed_txs_s"] = round(max(armed), 1)
+        ratio = round(max(armed) / max(max(plain), 1e-9), 3)
+        # the acceptance gate: recorder-armed throughput >= 0.95x
+        out["recorder_overhead"] = ratio
+        out["overhead_ok"] = ratio >= 0.95
+        # ---- one injected trip -> bundle size / write latency
+        if not _deadline_tight():
+            trip_wire = list(wire[:8])
+            bad = _corrupt_block(Block.decode(trip_wire[-1]))
+            trip_wire[-1] = bad.encode()
+            rep_t, rec = one_run(True, feed_wire=trip_wire,
+                                 expect_root=False)
+            snap = rep_t.forensics
+            out["trip"] = {
+                "quarantined": len(rep_t.quarantined),
+                "bundle_writes": snap.get("bundle_writes", 0),
+                "bundle_failures": snap.get("bundle_failures", 0),
+                "write_ms": snap.get("write_ms", 0.0),
+            }
+            paths = [b["path"] for b in snap.get("bundles", [])]
+            if paths:
+                size = sum(
+                    os.path.getsize(os.path.join(dp, f))
+                    for dp, _dn, fns in os.walk(paths[-1])
+                    for f in fns)
+                out["trip"]["bundle_bytes"] = size
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def run_flat_state():
     """Flat-state section (state/flat): the cold-read microbench —
     the SAME key population resolved through the flat store vs the
@@ -1542,7 +1634,16 @@ def main():
         else:
             skipped.append("tracing")
 
-        _begin_section(0.96)
+        _begin_section(0.955)
+        if _remaining() > 30:
+            # divergence forensics: recorder-armed vs unarmed A/B
+            # (>= 0.95 gated) + an injected trip's bundle size/write
+            result["forensics"] = run_forensics()
+            _section_done("forensics")
+        else:
+            skipped.append("forensics")
+
+        _begin_section(0.965)
         if _remaining() > 30:
             # flat-state layer: cold-read speedup ratio + checkpoint
             # stamp-vs-export attribution (state/flat)

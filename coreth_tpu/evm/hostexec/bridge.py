@@ -30,6 +30,7 @@ from coreth_tpu import faults
 # the local name `obs` is taken by the fault OBSERVER below; bind the
 # tracing API under an explicit alias
 from coreth_tpu.obs import span as _trace_span
+from coreth_tpu.obs import recorder as _forensics
 from coreth_tpu.evm import vmerrs
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device.tables import fork_key
@@ -206,6 +207,16 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
             _differential_check(evm, caller, addr, input_, gas, value,
                                 res)
         except (faults.FaultInjected, AssertionError) as exc:
+            # flight recorder first (works in both supervised and
+            # unsupervised mode): the exact tx index, the callee, and
+            # the first native write key pin the divergence for the
+            # offline bisection — the block's full witness attaches
+            # when the host path finishes the block
+            _forensics.note_trigger(
+                _forensics.TR_HOSTEXEC, repr(exc),
+                number=ctx.number, tx_index=statedb._tx_index,
+                contract=addr,
+                key=(sorted(res.writes)[0][1] if res.writes else None))
             if obs is None:
                 raise  # unsupervised oracle mode: fail loudly (tests)
             # a backend that DISAGREES with the interpreter is wrong,

@@ -12,6 +12,11 @@ timing evidence through it, so it imports nothing of the tree above
   trace-event / Perfetto JSON export (CORETH_TRACE_OUT).
 - ``obs.server`` — the zero-dependency live telemetry endpoint
   (CORETH_TELEMETRY_PORT): /metrics, /trace, /report.
+- ``obs.recorder`` — the divergence flight recorder
+  (CORETH_FORENSICS=1): a per-block witness ring that freezes into
+  content-addressed, offline-replayable bundles when an oracle trips,
+  a block quarantines, or a backend hard-demotes
+  (tools/replay_bundle.py is the matching bisection CLI).
 """
 
 from coreth_tpu.obs.trace import (
@@ -19,10 +24,11 @@ from coreth_tpu.obs.trace import (
     StageAccumulator, arm_from_env, block_begin, enabled, install,
     instant, jax_span, span, tracer, uninstall, write_out,
 )
+from coreth_tpu.obs import recorder  # noqa: F401 — re-export the forensics module (and its obs/bundle_fail declaration) under the obs namespace
 
 __all__ = [
     "PT_EXPORT_FAIL", "BlockTrace", "EventRing", "SpanTracer",
     "StageAccumulator", "arm_from_env", "block_begin", "enabled",
-    "install", "instant", "jax_span", "span", "tracer", "uninstall",
-    "write_out",
+    "install", "instant", "jax_span", "span", "recorder", "tracer",
+    "uninstall", "write_out",
 ]

@@ -1,0 +1,628 @@
+"""Divergence forensics: the flight recorder + replayable bundles.
+
+The correctness story of this stack is differential — bit-identical
+roots across backends, armed oracles at every seam (hostexec check,
+trie check, flat check, spec-vs-generic) — but when one of them fired
+mid-stream the evidence used to evaporate with the process: a counter
+bumped, a scope hard-demoted, a block parked in quarantine, and nothing
+left to debug offline.  This module is the black box that survives:
+
+1. **Witness ring.**  When armed (``CORETH_FORENSICS=1``; one
+   module-global ``is None`` check per site otherwise — the
+   metrics/faults/trace pattern) every dispatched block lands a ring
+   entry: the block object (wire bytes serialized lazily on the drain
+   thread), its parent header, which backend took it, and a light
+   touched-set sketch.  Blocks that run the exact host path
+   additionally attach a **full witness**: the touched pre-state slice
+   (account tuples + storage pre-values harvested from the StateDB's
+   committed-read cache + contract code), per-tx receipts, the
+   computed root, and any recorded mismatch reasons — everything
+   ``tools/replay_bundle.py`` needs to re-execute the block with no
+   chain and no DB.
+2. **Triggers.**  Divergence/quarantine/demotion seams call
+   :func:`note_trigger` with a declared trigger id
+   (:func:`declare_trigger` — the faults-registry pattern, so the
+   completeness gate in tests/test_forensics.py can assert every
+   declared seam is actually routed through the recorder).  A trigger
+   freezes the ring into a **bundle** the moment a full witness for
+   its block exists (triggers noted mid-block wait for the witness the
+   host path is about to record); leftovers freeze as context-only
+   bundles at :func:`flush_pending`.
+3. **Bundles.**  Frozen snapshots serialize on a background drain
+   thread — never on the hot path — into a content-addressed directory
+   (``bundle-<sha256[:16]>`` under ``CORETH_FORENSICS_DIR``): a JSON
+   manifest plus raw blobs (block wire bytes, parent header RLP,
+   contract code), written into a temp dir and atomically renamed, so
+   a crash or an injected failure (``obs/bundle_fail``) can never
+   leave a half-written bundle behind.  Writes/failures/ring occupancy
+   mirror into the metrics registry (``forensics/*``) and each bundle
+   lands a ``forensics/bundle`` instant in the span tracer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional
+
+from coreth_tpu import faults
+from coreth_tpu.obs import trace as _trace
+
+# the bundle write fails mid-drain: the stream must finish on the
+# right root, the failure is COUNTED (bundle_failures), and no
+# half-written directory survives (atomic rename)
+PT_BUNDLE_FAIL = faults.declare(
+    "obs/bundle_fail",
+    "bundle write fails mid-drain (counted, no half-written dir)")
+
+# ------------------------------------------------------------- triggers
+#
+# Every divergence/quarantine/demotion seam that routes evidence into
+# the recorder declares itself here; tests/test_forensics.py gates
+# declared == covered-and-wired, so a new oracle cannot land without
+# forensics coverage.
+
+_TRIGGERS: Dict[str, str] = {}
+
+
+def declare_trigger(name: str, doc: str) -> str:
+    _TRIGGERS[name] = doc
+    return name
+
+
+def declared_triggers() -> Dict[str, str]:
+    return dict(_TRIGGERS)
+
+
+TR_HOSTEXEC = declare_trigger(
+    "hostexec/oracle_divergence",
+    "armed CORETH_HOST_EXEC_CHECK oracle: native engine disagrees with "
+    "the interpreter (evm/hostexec/bridge.py)")
+TR_FLAT = declare_trigger(
+    "flat/oracle_divergence",
+    "armed CORETH_FLAT_CHECK oracle: flat store disagrees with the "
+    "trie (replay/engine.py + state/statedb.py)")
+TR_TRIE = declare_trigger(
+    "trie/oracle_divergence",
+    "armed CORETH_TRIE_CHECK oracle: native trie disagrees with the "
+    "python twin at a window fold (replay/commit.py)")
+TR_ROOT = declare_trigger(
+    "commit/root_mismatch",
+    "window fold landed on a root different from the last staged "
+    "header's (replay/commit.py; covers the sharded window path too — "
+    "per-block device validation failures re-run on the host and "
+    "surface through engine/fallback_mismatch)")
+TR_FALLBACK = declare_trigger(
+    "engine/fallback_mismatch",
+    "strict host-path replay mismatch: gas/receipt-root/state-root "
+    "disagree with the header (replay/engine.py _fallback)")
+TR_QUARANTINE = declare_trigger(
+    "serve/quarantine",
+    "poison block failed every backend and was tolerantly applied "
+    "(replay/engine.py quarantine_block)")
+TR_DEMOTE = declare_trigger(
+    "supervisor/hard_demote",
+    "a backend was hard-demoted for being WRONG, not slow "
+    "(replay/supervisor.py strike(hard=True))")
+
+
+# THE module global every instrumentation site checks (None = off)
+RECORDER: Optional["FlightRecorder"] = None
+
+
+class _Entry:
+    """One ring slot: a dispatched block + whatever evidence exists."""
+
+    __slots__ = ("number", "block", "parent", "backend", "touched",
+                 "witness", "results")
+
+    def __init__(self, number, block, parent, backend, touched):
+        self.number = number
+        self.block = block          # Block object; encoded on drain
+        self.parent = parent        # parent Header object or None
+        self.backend = backend
+        self.touched = touched      # light dispatch-time sketch
+        self.witness = None         # full pre-state slice (host path)
+        self.results = None         # receipts/root/reasons (host path)
+
+
+class FlightRecorder:
+    """Bounded per-block witness ring + trigger-frozen bundle writer."""
+
+    def __init__(self, out_dir: Optional[str] = None,
+                 ring: int = 32, max_bundles: int = 8):
+        self.dir = out_dir or os.environ.get(
+            "CORETH_FORENSICS_DIR", ".coreth_forensics")
+        self.ring_size = ring
+        self.max_bundles = max_bundles
+        self._lock = threading.Lock()
+        self._ring: List[_Entry] = []
+        self._pending: List[dict] = []   # triggers awaiting a witness
+        # engine-supplied replay context (chain config scalars) +
+        # backend/env fingerprint, both merged in by the engines
+        self.config: Dict[str, object] = {}
+        self.fingerprint: Dict[str, object] = _env_fingerprint()
+        # counters (mirrored to metrics via publish())
+        self.bundle_writes = 0
+        self.bundle_failures = 0
+        self.bundle_dedup = 0   # identical evidence already on disk
+        self.triggers = 0
+        self.write_ms = 0.0
+        self.bundles: List[dict] = []   # {"path","number","kind"}
+        self._q: "queue.Queue" = queue.Queue()
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- capture
+    def _entry_for(self, number: int) -> Optional[_Entry]:
+        for e in reversed(self._ring):
+            if e.number == number:
+                return e
+        return None
+
+    def record_dispatch(self, block, parent, backend: str,
+                        touched: Optional[dict] = None) -> None:
+        """A block entered an execution backend: land (or refresh) its
+        ring entry.  Cheap — object references only; serialization is
+        the drain thread's job."""
+        with self._lock:
+            e = self._entry_for(block.number)
+            if e is None:
+                e = _Entry(block.number, block, parent, backend, touched)
+                self._ring.append(e)
+                if len(self._ring) > self.ring_size:
+                    self._ring.pop(0)
+            else:
+                e.block = block
+                e.backend = backend
+                if parent is not None:
+                    e.parent = parent
+                if touched is not None:
+                    e.touched = touched
+
+    def record_witness(self, block, parent, prestate: dict,
+                       results: dict) -> None:
+        """The host path finished (or died on) a block: attach the full
+        witness — the replayable pre-state slice + results — and freeze
+        any trigger that was waiting for it."""
+        with self._lock:
+            e = self._entry_for(block.number)
+            if e is None:
+                e = _Entry(block.number, block, parent, "host", None)
+                self._ring.append(e)
+                if len(self._ring) > self.ring_size:
+                    self._ring.pop(0)
+            e.block = block
+            if parent is not None:
+                e.parent = parent
+            e.witness = prestate
+            e.results = results
+            due = [t for t in self._pending
+                   if t.get("number") in (None, block.number)]
+            if not due:
+                return
+            self._pending = [t for t in self._pending if t not in due]
+            self._patch_witness(e, due)
+        self._freeze(due)
+
+    def note_trigger(self, kind: str, reason: str,
+                     number: Optional[int] = None,
+                     tx_index: Optional[int] = None,
+                     contract: Optional[bytes] = None,
+                     key: Optional[bytes] = None,
+                     got=None, want=None,
+                     pre_value: Optional[bytes] = None) -> None:
+        """A divergence/quarantine/demotion seam fired.  Freeze a
+        bundle now if the trigger block's full witness already exists;
+        otherwise hold it pending — the host path that surfaces every
+        per-block trigger records the witness moments later (leftovers
+        freeze context-only at flush_pending()).
+
+        ``pre_value`` is the authoritative (trie-side) 32-byte
+        pre-value of ``(contract, key)`` when the seam knows it: an
+        oracle trip aborts the read BEFORE it lands in the StateDB's
+        committed-read cache, so without this the one key the trigger
+        is ABOUT would be missing from the harvested witness."""
+        trig = {"kind": kind, "reason": reason, "number": number,
+                "tx_index": tx_index,
+                "contract": contract.hex() if contract else None,
+                "key": key.hex() if key else None,
+                "got": repr(got) if got is not None else None,
+                "want": repr(want) if want is not None else None,
+                # raw-bytes fields (stripped at serialization) feed
+                # the witness patch in _patch_witness
+                "_contract_raw": contract, "_key_raw": key,
+                "_pre_raw": pre_value}
+        self.triggers += 1
+        with self._lock:
+            e = self._entry_for(number) if number is not None else None
+            have = e is not None and e.witness is not None
+            if have:
+                self._patch_witness(e, [trig])
+            if not have:
+                self._pending.append(trig)
+                return
+        self._freeze([trig])
+
+    @staticmethod
+    def _patch_witness(e: _Entry, triggers: List[dict]) -> None:
+        """Backfill each trigger's authoritative pre-value into the
+        witness slice if the harvest missed the key (caller holds the
+        lock; the witness dict is entry-owned)."""
+        w = e.witness
+        if not w:
+            return
+        storage = w.get("storage")
+        if storage is None:
+            return
+        for t in triggers:
+            c, k, pv = (t.get("_contract_raw"), t.get("_key_raw"),
+                        t.get("_pre_raw"))
+            if c is not None and k is not None and pv is not None:
+                storage.setdefault((c, k), pv)
+
+    def flush_pending(self) -> None:
+        """Freeze any triggers still waiting for a witness (the crash/
+        propagate paths where no host retry ever ran) as context-only
+        bundles, so the evidence outlives the process anyway."""
+        with self._lock:
+            due, self._pending = self._pending, []
+        if due:
+            self._freeze(due)
+
+    # ------------------------------------------------------------ freeze
+    @staticmethod
+    def _copy_entry(e: _Entry) -> _Entry:
+        """A frozen copy of one ring slot: the blocks/headers are
+        immutable, but witness/results/touched are REPLACED by a later
+        record_witness (the quarantine re-run of a strict failure) and
+        PATCHED in place by a later trigger — the bundle must pin the
+        state at trigger time, not whatever the retry leaves behind."""
+        c = _Entry(e.number, e.block, e.parent, e.backend,
+                   dict(e.touched) if e.touched is not None else None)
+        if e.witness is not None:
+            w = dict(e.witness)
+            for fld in ("accounts", "storage", "code"):
+                if isinstance(w.get(fld), dict):
+                    w[fld] = dict(w[fld])
+            c.witness = w
+        if e.results is not None:
+            c.results = dict(e.results)
+        return c
+
+    def _freeze(self, triggers: List[dict]) -> None:
+        """Snapshot the ring + triggers and hand the bundle to the
+        drain thread (per-entry field copies; the blob/JSON
+        serialization itself happens on the drain thread)."""
+        if self.bundle_writes + self.bundle_failures \
+                + self._q.qsize() >= self.max_bundles:
+            return
+        with self._lock:
+            snap = {
+                "triggers": list(triggers),
+                "entries": [self._copy_entry(e) for e in self._ring],
+                "config": dict(self.config),
+                "fingerprint": dict(self.fingerprint),
+            }
+        self._ensure_thread()
+        self._q.put(snap)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._drain_loop, name="forensics-drain",
+                daemon=True)
+            self._thread.start()
+
+    def _drain_loop(self) -> None:
+        while True:
+            snap = self._q.get()
+            if snap is None:
+                self._q.task_done()
+                return
+            try:
+                self._write_bundle(snap)
+            finally:
+                self._q.task_done()
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Block until every queued bundle is written (or the timeout
+        lapses) — called at pipeline publish / uninstall, never from
+        the execute path."""
+        deadline = time.monotonic() + timeout_s
+        while not self._q.empty() or self._q.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                return
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        """Drain, then stop the drain thread (the None sentinel) —
+        a long-lived process installing recorders repeatedly must not
+        accumulate parked daemon threads pinning old rings."""
+        self.drain()
+        t = self._thread
+        if t is not None and t.is_alive():
+            self._q.put(None)
+            t.join(timeout=5)
+        self._thread = None
+
+    # ------------------------------------------------------------- write
+    def _write_bundle(self, snap: dict) -> Optional[str]:
+        t0 = time.monotonic()
+        tmp = None
+        try:
+            faults.fire(PT_BUNDLE_FAIL)
+            manifest, blobs = _serialize(snap)
+            body = json.dumps(manifest, sort_keys=True, indent=1)
+            digest = hashlib.sha256(body.encode()).hexdigest()[:16]
+            final = os.path.join(self.dir, f"bundle-{digest}")
+            trig = snap["triggers"][0]
+            if os.path.isdir(final):
+                # identical evidence already on disk: no second write,
+                # but the trigger still SURFACES (a second run hitting
+                # the same poison block must report its bundle path,
+                # not "no evidence")
+                self.bundle_dedup += 1
+                self.bundles.append({"path": final,
+                                     "number": trig.get("number"),
+                                     "kind": trig["kind"]})
+                return final
+            self._seq += 1
+            tmp = os.path.join(self.dir,
+                               f".tmp-{os.getpid()}-{self._seq}")
+            os.makedirs(os.path.join(tmp, "blobs"))
+            for name, data in blobs.items():
+                with open(os.path.join(tmp, "blobs", name), "wb") as f:
+                    f.write(data)
+            with open(os.path.join(tmp, "manifest.json"), "w",
+                      encoding="utf-8") as f:
+                f.write(body)
+            os.replace(tmp, final)   # the atomic publish
+            tmp = None
+            self.bundle_writes += 1
+            self.write_ms += (time.monotonic() - t0) * 1000.0
+            self.bundles.append({"path": final,
+                                 "number": trig.get("number"),
+                                 "kind": trig["kind"]})
+            _trace.instant("forensics/bundle", path=final,
+                           kind=trig["kind"])
+            return final
+        except (faults.FaultInjected, OSError, TypeError,
+                ValueError) as exc:
+            # counted, never raised: forensics must not take down the
+            # stream it is documenting; the atomic-rename protocol
+            # means a failure here leaves no partial directory
+            if tmp is not None:
+                shutil.rmtree(tmp, ignore_errors=True)
+            self.bundle_failures += 1
+            self.last_error = repr(exc)
+            return None
+
+    last_error: Optional[str] = None
+
+    # --------------------------------------------------------- reporting
+    def bundles_for(self, number: int,
+                    kind: Optional[str] = None) -> List[str]:
+        return [b["path"] for b in self.bundles
+                if b["number"] == number
+                and (kind is None or b["kind"] == kind)]
+
+    def snapshot(self) -> dict:
+        return {
+            "dir": self.dir,
+            "ring_blocks": len(self._ring),
+            "triggers": self.triggers,
+            "bundle_writes": self.bundle_writes,
+            "bundle_failures": self.bundle_failures,
+            "bundle_dedup": self.bundle_dedup,
+            "write_ms": round(self.write_ms, 3),
+            "bundles": [dict(b) for b in self.bundles],
+        }
+
+    def publish(self, registry=None) -> None:
+        from coreth_tpu.metrics import Gauge, get_or_register
+        for name in ("bundle_writes", "bundle_failures", "triggers"):
+            get_or_register(f"forensics/{name}", Gauge,
+                            registry).update(getattr(self, name))
+        get_or_register("forensics/ring_blocks", Gauge,
+                        registry).update(len(self._ring))
+
+
+# --------------------------------------------------------- serialization
+
+_ENV_KNOBS = (
+    "CORETH_TRIE", "CORETH_TRIE_CHECK", "CORETH_FLAT",
+    "CORETH_FLAT_CHECK", "CORETH_HOST_EXEC", "CORETH_HOST_EXEC_CHECK",
+    "CORETH_MACHINE", "CORETH_DEVICE_OCC", "CORETH_SPECIALIZE",
+    "CORETH_EXCHANGE", "CORETH_KEYRANGE", "CORETH_KEYRANGE_THRESHOLD",
+    "CORETH_PREMAP_PREDICT", "CORETH_PREMAP_NEST", "CORETH_PREMAP_ARR",
+    "CORETH_SERIAL_SHORTCIRCUIT", "CORETH_NO_TOKEN_FASTPATH",
+    "CORETH_MACHINE_WINDOW",
+)
+
+
+def _env_fingerprint() -> Dict[str, object]:
+    """Backend/env fingerprint: every knob that selects an execution
+    or commitment backend, plus the resolved trie backend — what the
+    offline replayer needs to reconstruct the live run's routing."""
+    # the RESOLVED backends (trie backend, shard count, flat/check
+    # arming) merge in from the engine via merge_fingerprint — this
+    # level-0 module records only env + process identity itself
+    return {
+        "env": {k: os.environ[k] for k in _ENV_KNOBS
+                if k in os.environ},
+        "pid": os.getpid(),
+    }
+
+
+def _hx(b: bytes) -> str:
+    return b.hex()
+
+
+def _serialize(snap: dict):
+    """Snapshot (object refs) -> (manifest dict, blob name -> bytes).
+    Runs on the drain thread only."""
+    blobs: Dict[str, bytes] = {}
+    blocks = []
+    for e in snap["entries"]:
+        wire = e.block.encode()
+        bname = f"block-{e.number}.bin"
+        blobs[bname] = wire
+        row = {
+            "number": e.number,
+            "hash": _hx(e.block.hash()),
+            "backend": e.backend,
+            "block_blob": bname,
+            "block_sha256": hashlib.sha256(wire).hexdigest(),
+        }
+        if e.parent is not None:
+            pname = f"parent-{e.number}.bin"
+            blobs[pname] = e.parent.encode()
+            row["parent_header_blob"] = pname
+        if e.touched is not None:
+            row["touched"] = e.touched
+        if e.witness is not None:
+            w = e.witness
+            accounts = {}
+            for addr, acct in w.get("accounts", {}).items():
+                accounts[_hx(addr)] = None if acct is None else {
+                    "balance": acct[0], "nonce": acct[1],
+                    "root": _hx(acct[2]), "code_hash": _hx(acct[3]),
+                    "multicoin": bool(acct[4])}
+            storage: Dict[str, Dict[str, str]] = {}
+            for (c, k), v in w.get("storage", {}).items():
+                storage.setdefault(_hx(c), {})[_hx(k)] = \
+                    _hx(v) if isinstance(v, bytes) \
+                    else _hx(int(v).to_bytes(32, "big"))
+            code_list = []
+            for ch, code in w.get("code", {}).items():
+                cname = f"code-{_hx(ch)[:16]}.bin"
+                blobs[cname] = code
+                code_list.append({"code_hash": _hx(ch), "blob": cname})
+            row["witness"] = {
+                "accounts": accounts, "storage": storage,
+                "code": code_list,
+                "complete": bool(w.get("complete", True)),
+                "failed_tx_index": w.get("failed_tx_index"),
+            }
+        if e.results is not None:
+            r = dict(e.results)
+            for fld in ("computed_root", "header_root"):
+                if isinstance(r.get(fld), bytes):
+                    r[fld] = _hx(r[fld])
+            row["results"] = r
+        blocks.append(row)
+    manifest = {
+        "version": 1,
+        "triggers": [{k: v for k, v in t.items()
+                      if not k.startswith("_")}
+                     for t in snap["triggers"]],
+        "fingerprint": snap["fingerprint"],
+        "config": snap["config"],
+        "blocks": blocks,
+    }
+    return manifest, blobs
+
+
+# ------------------------------------------------------------ module API
+
+def enabled() -> bool:
+    return RECORDER is not None
+
+
+def recorder() -> Optional[FlightRecorder]:
+    return RECORDER
+
+
+def install(out_dir: Optional[str] = None, ring: Optional[int] = None,
+            max_bundles: Optional[int] = None) -> FlightRecorder:
+    global RECORDER
+    if RECORDER is not None:
+        # replacing an active recorder must not strand its parked
+        # drain thread (and the ring it pins) — same teardown as
+        # uninstall(), evidence flushed first
+        uninstall()
+    rec = FlightRecorder(
+        out_dir=out_dir,
+        ring=ring or int(os.environ.get("CORETH_FORENSICS_RING",
+                                        "32") or "32"),
+        max_bundles=max_bundles or int(os.environ.get(
+            "CORETH_FORENSICS_MAX", "8") or "8"))
+    os.makedirs(rec.dir, exist_ok=True)
+    RECORDER = rec
+    return rec
+
+
+def uninstall() -> Optional[FlightRecorder]:
+    """Remove the global recorder; pending triggers freeze and queued
+    bundles drain first, so no evidence is dropped at teardown."""
+    global RECORDER
+    rec = RECORDER
+    if rec is not None:
+        rec.flush_pending()
+        rec.close()
+    RECORDER = None
+    return rec
+
+
+def arm_from_env() -> Optional[FlightRecorder]:
+    """Install a recorder if CORETH_FORENSICS=1 and none is active yet
+    (idempotent — engine and pipeline constructors both call this,
+    mirroring faults/obs.arm_from_env)."""
+    if RECORDER is not None:
+        return RECORDER
+    if not bool(int(os.environ.get("CORETH_FORENSICS", "0") or "0")):
+        return None
+    return install()
+
+
+def note_config(config) -> None:
+    """Engine hand-off of the chain config's JSON-able scalars (fork
+    blocks/times + chain id) — what the offline replayer rebuilds its
+    ChainConfig from."""
+    rec = RECORDER
+    if rec is None:
+        return
+    rec.config = {k: v for k, v in vars(config).items()
+                  if isinstance(v, (int, bool)) or v is None}
+
+
+def merge_fingerprint(extra: dict) -> None:
+    rec = RECORDER
+    if rec is None:
+        return
+    rec.fingerprint.update(extra)
+
+
+def record_dispatch(block, parent, backend: str,
+                    touched: Optional[dict] = None) -> None:
+    rec = RECORDER
+    if rec is None:
+        return
+    rec.record_dispatch(block, parent, backend, touched)
+
+
+def record_witness(block, parent, prestate: dict, results: dict) -> None:
+    rec = RECORDER
+    if rec is None:
+        return
+    rec.record_witness(block, parent, prestate, results)
+
+
+def note_trigger(kind: str, reason: str, **ctx) -> None:
+    rec = RECORDER
+    if rec is None:
+        return
+    rec.note_trigger(kind, reason, **ctx)
+
+
+def flush_pending() -> None:
+    rec = RECORDER
+    if rec is None:
+        return
+    rec.flush_pending()

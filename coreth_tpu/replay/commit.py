@@ -37,6 +37,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from coreth_tpu import faults, obs, rlp
+from coreth_tpu.obs import recorder as forensics
 from coreth_tpu.crypto import keccak256
 from coreth_tpu.mpt.rehash import device_rehash
 from coreth_tpu.state.flat import DELETED as FLAT_DELETED
@@ -194,8 +195,19 @@ class CommitPipeline:
             faults.fire(PT_FLUSH)
         prev_root = e.root
         t0 = time.monotonic()
-        self._fold_storage()
-        root = self._fold_accounts()
+        try:
+            self._fold_storage()
+            root = self._fold_accounts()
+        except AssertionError as exc:
+            # the CORETH_TRIE_CHECK python-twin oracle tripped inside
+            # the fold (mpt.native_trie.TrieOracleError): route the
+            # evidence through the flight recorder before the raise
+            # unwinds the window (a witness for the staged tip may
+            # never come — flush_pending writes the context bundle)
+            forensics.note_trigger(
+                forensics.TR_TRIE, repr(exc),
+                number=self.expected_number)
+            raise
         dt = time.monotonic() - t0
         self.fold_s += dt
         e.stats.t_trie += dt
@@ -214,6 +226,11 @@ class CommitPipeline:
         self.expected_number = None
         self.expected_header = None
         if root != expected:
+            forensics.note_trigger(
+                forensics.TR_ROOT,
+                f"window fold root mismatch at block {number} "
+                f"({n_blocks} staged)", number=number,
+                got=root.hex(), want=expected.hex())
             raise ReplayError(
                 f"state root mismatch at block {number} "
                 f"(commit window of {n_blocks}): {root.hex()} != "

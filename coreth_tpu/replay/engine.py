@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from coreth_tpu import faults, obs
+from coreth_tpu.obs import recorder as forensics
 from coreth_tpu.consensus.engine import DummyEngine
 from coreth_tpu.ops import u256
 from coreth_tpu.params import ChainConfig
@@ -68,6 +69,22 @@ def _block_error(msg: str, block) -> ReplayError:
     err = ReplayError(msg)
     err.block = block
     return err
+
+
+def _receipt_rows(receipts) -> list:
+    """Per-tx receipt observations for a forensics witness: enough for
+    tools/replay_bundle.py to bisect a recorded-vs-replayed divergence
+    to one tx (status, gas, log shape) without storing full logs."""
+    from coreth_tpu.crypto import keccak256
+    rows = []
+    for r in receipts:
+        lh = keccak256(b"".join(
+            bytes(lg.address) + b"".join(bytes(t) for t in lg.topics)
+            + bytes(lg.data) for lg in r.logs)).hex() if r.logs else None
+        rows.append({"status": r.status, "gas_used": r.gas_used,
+                     "cumulative": r.cumulative_gas_used,
+                     "logs": len(r.logs), "logs_hash": lh})
+    return rows
 
 
 # Injection points on the replay engine's failure seams (armed only by
@@ -786,6 +803,18 @@ class ReplayEngine:
         # (CORETH_TRACE=1 likewise installs the span tracer)
         faults.arm_from_env()
         obs.arm_from_env()
+        # divergence flight recorder (obs/recorder.py): armed by
+        # CORETH_FORENSICS=1; the engine hands it the chain config
+        # scalars + backend fingerprint every bundle embeds
+        forensics.arm_from_env()
+        forensics.note_config(config)
+        forensics.merge_fingerprint({
+            "trie_backend": "native" if self._native else "py",
+            "n_shards": self._n_shards,
+            "flat": self.flat is not None,
+            "flat_check": self._flat_check,
+            "trie_check": self._trie_check,
+        })
         from coreth_tpu.replay.supervisor import BackendSupervisor
         self.supervisor = BackendSupervisor(self)
         # the hostexec bridge consults the newest engine's supervisor
@@ -807,7 +836,16 @@ class ReplayEngine:
         return self._flat_view_memo
 
     def _flat_oracle_fail(self, what: str, addr: bytes, got,
-                          want) -> None:
+                          want, key: Optional[bytes] = None) -> None:
+        # the flight recorder learns the exact key and both sides
+        # before the evidence unwinds with the raise
+        forensics.note_trigger(
+            forensics.TR_FLAT,
+            f"flat oracle divergence ({what}) at {addr.hex()}",
+            contract=addr, key=key, got=got, want=want,
+            pre_value=(want.to_bytes(32, "big")
+                       if key is not None and isinstance(want, int)
+                       else None))
         raise ReplayError(
             f"flat oracle divergence ({what}) at {addr.hex()}: "
             f"flat={got!r} trie={want!r}")
@@ -884,7 +922,7 @@ class ReplayEngine:
                     if raw else 0
                 if want != value:
                     self._flat_oracle_fail("slot", contract, value,
-                                           want)
+                                           want, key=key)
         if value is None:
             from coreth_tpu import rlp
             raw = self._storage_trie(contract).get(key)
@@ -1398,9 +1436,32 @@ class ReplayEngine:
         backoff, persistent ones strike toward device demotion and
         surface as BackendFault (replay()/_drive route the run through
         the exact host path).  The injected seam is PT_DISPATCH."""
+        if forensics.enabled():
+            self._record_window_dispatch(items)
         with obs.span("replay/issue_window", blocks=len(items)):
             return self.supervisor.run("device", PT_DISPATCH,
                                        self._issue_window_run, items)
+
+    def _record_window_dispatch(self, items) -> None:
+        """Flight-recorder ring entries for a transfer/token window:
+        the block objects plus a light touched-set sketch (slot keys
+        with their last-validated host-mirror pre-values — the premap
+        evidence the classifier already computed).  Armed-only; the
+        unarmed path is one module-global None check in the caller."""
+        st = self.state
+        parent = self.parent_header
+        for block, batch in items:
+            touched = None
+            slots = sorted((set(batch["from_slots"])
+                            | set(batch["to_slots"])) - {0})
+            if slots:
+                touched = {"slots": {
+                    st.slot_keys[s][0].hex() + ":"
+                    + st.slot_keys[s][1].hex():
+                        st.slot_host[s] for s in slots[:256]}}
+            forensics.record_dispatch(block, parent, "device/transfer",
+                                      touched)
+            parent = block.header
 
     def _issue_window_run(self, items: List[Tuple[Block, dict]]) -> dict:
         """One device call for a whole run of transfer blocks: upload the
@@ -1878,6 +1939,12 @@ class ReplayEngine:
         batch replay stays strict."""
         reasons: List[str] = []
         self._fallback(block, strict=False, reasons=reasons)
+        # the tolerant fallback above just recorded this block's full
+        # witness; the trigger freezes it into a replayable bundle
+        forensics.note_trigger(
+            forensics.TR_QUARANTINE,
+            "; ".join(reasons) or "quarantined",
+            number=block.number)
         self.supervisor.note_quarantined()
         self.stats.blocks_quarantined += 1
         return reasons
@@ -1965,6 +2032,39 @@ class ReplayEngine:
         self.stats.blocks_rolled_back += 1
         return prev_root
 
+    def _harvest_prestate(self, statedb, complete: bool = True,
+                          failed_tx_index: Optional[int] = None) -> dict:
+        """The touched pre-state slice for a forensics witness: for
+        every account the StateDB touched, its PRE-block tuple read
+        from the engine trie (still at the pre-block root here), every
+        touched storage slot's pre-value from the StateDB's
+        committed-read cache (``origin_storage`` — populated by every
+        SLOAD/SSTORE before ``intermediate_root`` rewrites it), and
+        the contract code those accounts resolve to.  Plain-python
+        dicts; hex/JSON encoding happens on the recorder's drain
+        thread."""
+        accounts: Dict[bytes, Optional[tuple]] = {}
+        storage: Dict[Tuple[bytes, bytes], bytes] = {}
+        code: Dict[bytes, bytes] = {}
+        for addr, obj in list(statedb._objects.items()):
+            raw = self.trie.get(addr)
+            if raw is None:
+                accounts[addr] = None
+            else:
+                a = StateAccount.from_rlp(raw)
+                accounts[addr] = (a.balance, a.nonce, a.root,
+                                  a.code_hash, a.is_multi_coin)
+                if a.code_hash != EMPTY_CODE_HASH \
+                        and a.code_hash not in code:
+                    c = self.db.contract_code(a.code_hash)
+                    if c:
+                        code[a.code_hash] = c
+            for key, val in obj.origin_storage.items():
+                storage[(addr, key)] = val
+        return {"accounts": accounts, "storage": storage, "code": code,
+                "complete": complete,
+                "failed_tx_index": failed_tx_index}
+
     def _fallback(self, block: Block, strict: bool = True,
                   reasons: Optional[List[str]] = None) -> bytes:
         """Bit-exact host path for non-transfer blocks; device state for
@@ -2001,24 +2101,62 @@ class ReplayEngine:
                 "ReplayEngine needs parent_header for AP4+ blocks; "
                 "construct it with parent_header=...")
         parent = self.parent_header or _HeaderShim(block)
-        receipts, logs, used_gas = self.processor.process(
-            block, parent, statedb)
+        rec = forensics.enabled()
+        try:
+            receipts, logs, used_gas = self.processor.process(
+                block, parent, statedb)
+        except BaseException as exc:  # noqa: BLE001 — re-raised unconditionally below: the recorder must witness the dying block's touched state before the evidence unwinds
+            if rec:
+                # the block DIED mid-execution (a flat-oracle trip, a
+                # broken tx): freeze what the StateDB touched so far —
+                # the witness stays replayable up to the failing tx
+                forensics.record_witness(
+                    block, prev_header,
+                    self._harvest_prestate(
+                        statedb, complete=False,
+                        failed_tx_index=statedb._tx_index),
+                    {"error": repr(exc),
+                     "header_root": block.header.root,
+                     "reasons": ["execution failed"]})
+            raise
+        # the pre-state slice must harvest BEFORE intermediate_root:
+        # folding pending storage into the StateDB trie rewrites the
+        # committed-read cache with POST values
+        wit = self._harvest_prestate(statedb) if rec else None
+
+        def _emit(rs: List[str], computed_root=None) -> None:
+            forensics.record_witness(
+                block, prev_header, wit,
+                {"receipts": _receipt_rows(receipts),
+                 "used_gas": used_gas,
+                 "header_root": block.header.root,
+                 "computed_root": computed_root,
+                 "reasons": list(rs)})
+
+        def _strict_fail(msg: str, computed_root=None) -> ReplayError:
+            if rec:
+                _emit([msg], computed_root)
+                forensics.note_trigger(
+                    forensics.TR_FALLBACK, f"{msg} at block "
+                    f"{block.number}", number=block.number)
+            return _block_error(f"{msg} (fallback)", block)
+
         if used_gas != block.header.gas_used:
             if strict:
-                raise _block_error("gas used mismatch (fallback)", block)
+                raise _strict_fail("gas used mismatch")
             reasons.append("gas used mismatch")
         if derive_sha(receipts, derive_hasher()) \
                 != block.header.receipt_hash:
             if strict:
-                raise _block_error(
-                    "receipt root mismatch (fallback)", block)
+                raise _strict_fail("receipt root mismatch")
             reasons.append("receipt root mismatch")
         root = statedb.intermediate_root(True)
         if root != block.header.root:
             if strict:
-                raise _block_error(
-                    "state root mismatch (fallback)", block)
+                raise _strict_fail("state root mismatch", root)
             reasons.append("state root mismatch")
+        if rec:
+            _emit(reasons or [], root)
         statedb.commit(delete_empty_objects=True)
         # refresh engine trie + device copies of touched accounts (one
         # batched scatter via the staging buffer)
@@ -2034,6 +2172,10 @@ class ReplayEngine:
                 else:
                     self.trie.update(addr, obj.account.rlp())
             if self.trie.hash() != root:
+                forensics.note_trigger(
+                    forensics.TR_ROOT,
+                    "native trie diverged after host fallback",
+                    number=block.number)
                 raise ReplayError(
                     "native trie diverged after host fallback")
         else:

@@ -33,6 +33,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from coreth_tpu import obs
+from coreth_tpu.obs import recorder as forensics
 from coreth_tpu.evm.device import machine as M
 from coreth_tpu.evm.device import tables as DT
 from coreth_tpu.evm.device.adapter import (
@@ -771,6 +772,26 @@ class MachineBlockExecutor:
         legacy per-block path; the run then stops so the engine can
         re-classify against the repaired state.
         """
+        if forensics.enabled():
+            # flight-recorder ring entries for the machine run: block
+            # + parent refs and the backend tag (serial-eligible runs
+            # retag below); the premapped pre-state the kernel reads
+            # is already host-visible via the engine's slot mirror and
+            # lands in any later host-path witness
+            parent = self.e.parent_header
+            backend = "native/serial" \
+                if self._serial_eligible(items[0][1]) else "device/occ"
+            runner = self._runner
+            forensics.merge_fingerprint(
+                {"spec_set": len(getattr(runner, "_spec_progs", None)
+                                 or {}),
+                 "premap_recipes": sum(
+                    len(v or {}) for v in (getattr(runner, "recipes",
+                                                   None) or {}
+                                           ).values())})
+            for block, _plans in items:
+                forensics.record_dispatch(block, parent, backend)
+                parent = block.header
         with obs.span("machine/execute_run", blocks=len(items)):
             return self._execute_run(items)
 

@@ -160,6 +160,16 @@ class BackendSupervisor:
                 self._transition("probe_failed", scope)
             return
         st["strikes"] += 1
+        if hard:
+            # a hard demotion means a backend was WRONG (an armed
+            # oracle disagreed), not slow — bundle the evidence; the
+            # seam that struck usually noted a richer trigger moments
+            # earlier, and the pending triggers freeze together when
+            # the block's host-path witness lands
+            from coreth_tpu.obs import recorder as _forensics
+            _forensics.note_trigger(
+                _forensics.TR_DEMOTE,
+                f"hard demote of scope {scope!r}: {exc!r}")
         if hard or st["strikes"] >= self.strikes_to_demote:
             st["demoted"] = True
             st["until"] = now + (st["cooldown"] or self.cooldown)

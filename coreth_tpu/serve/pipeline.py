@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from coreth_tpu import faults, obs
+from coreth_tpu.obs import recorder as forensics
 from coreth_tpu.metrics import Counter, Gauge, Histogram, Meter, \
     get_or_register
 from coreth_tpu.serve.feed import BlockFeed, FeedExhausted
@@ -113,6 +114,11 @@ class StreamReport:
     # committed block (obs tracer; {} when CORETH_TRACE=0): queue_feed
     # / prefetch / queue_exec / execute / commit sum to ~1.0
     stage_breakdown: dict = field(default_factory=dict)
+    # divergence-forensics surface (obs/recorder, CORETH_FORENSICS=1):
+    # bundle write/failure counts, ring occupancy, and the written
+    # bundle paths; quarantined entries above also gain a "bundle"
+    # path.  {} when the recorder is off.
+    forensics: dict = field(default_factory=dict)
 
     def row(self) -> dict:
         return dict(self.__dict__)
@@ -141,6 +147,7 @@ class StreamingPipeline:
                  checkpoint_every: Optional[int] = None):
         faults.arm_from_env()  # CORETH_FAULT_PLAN (idempotent)
         obs.arm_from_env()     # CORETH_TRACE=1 (idempotent)
+        forensics.arm_from_env()  # CORETH_FORENSICS=1 (idempotent)
         self.engine = engine
         self.feed = feed
         self.depth = depth or 2 * engine.window
@@ -605,6 +612,10 @@ class StreamingPipeline:
             # CORETH_TRACE_OUT: flush the ring to a Perfetto-loadable
             # file (failures counted, never raised — obs/export_fail)
             obs.write_out()
+            # forensics: a trigger still waiting for a witness at
+            # shutdown (a crash-path oracle trip) freezes as a
+            # context-only bundle instead of evaporating
+            forensics.flush_pending()
         wall = time.monotonic() - t_start
         self._publish(wall)
         return self.stats
@@ -623,6 +634,16 @@ class StreamingPipeline:
         }
         if self._stages is not None:
             row["stage_breakdown"] = self._stages.breakdown()
+        rec = forensics.recorder()
+        if rec is not None:
+            # quarantine forensics, live: counters + bundle paths for
+            # already-drained bundles (entries parked mid-run show
+            # their replay handle without waiting for the final report)
+            row["forensics"] = rec.snapshot()
+            for entry in row["quarantined"]:
+                paths = rec.bundles_for(entry["number"])
+                if paths:
+                    entry["bundle"] = paths[-1]
         row["committed_blocks"] = self._committed_blocks
         row["enqueued_blocks"] = self._enqueued
         return row
@@ -706,6 +727,19 @@ class StreamingPipeline:
             # across queue_feed/prefetch/queue_exec/execute/commit) —
             # THIS run's sink, not the process-global tracer's
             s.stage_breakdown = self._stages.breakdown()
+        rec = forensics.recorder()
+        if rec is not None:
+            # wait for queued bundle writes, then surface them: the
+            # report carries the forensics counters and every
+            # quarantined entry gains its bundle path (the offline
+            # replay handle for exactly that block)
+            rec.drain()
+            s.forensics = rec.snapshot()
+            rec.publish(self._registry)
+            for entry in s.quarantined:
+                paths = rec.bundles_for(entry["number"])
+                if paths:
+                    entry["bundle"] = paths[-1]
         s.faults = faults.fired()
         # SLO surface in the metrics registry (scrapeable next to the
         # engine's replay/* gauges)

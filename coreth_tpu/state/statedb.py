@@ -178,6 +178,12 @@ class StateDB:
                     if (want is None) != (account is None) or (
                             want is not None
                             and want.rlp() != account.rlp()):
+                        from coreth_tpu.obs import recorder as _fr
+                        _fr.note_trigger(
+                            _fr.TR_FLAT,
+                            "flat oracle divergence (statedb account)",
+                            tx_index=self._tx_index, contract=addr,
+                            got=account, want=want)
                         raise ValueError(
                             f"flat oracle divergence (statedb "
                             f"account) at {addr.hex()}: "
@@ -385,6 +391,14 @@ class StateDB:
                 want = rlp.decode(raw).rjust(32, b"\x00") \
                     if raw is not None else HASH_ZERO
                 if want != value:
+                    from coreth_tpu.obs import recorder as _fr
+                    _fr.note_trigger(
+                        _fr.TR_FLAT,
+                        "flat oracle divergence (statedb slot)",
+                        tx_index=self._tx_index,
+                        contract=obj.address, key=key,
+                        got=value.hex(), want=want.hex(),
+                        pre_value=want)
                     raise ValueError(
                         f"flat oracle divergence (statedb slot) at "
                         f"{obj.address.hex()}/{key.hex()}: "

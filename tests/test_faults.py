@@ -113,6 +113,7 @@ def test_declared_points_all_covered():
     import coreth_tpu.evm.device.shard  # noqa: F401
     import coreth_tpu.evm.hostexec.backend  # noqa: F401
     import coreth_tpu.evm.hostexec.bridge  # noqa: F401
+    import coreth_tpu.obs.recorder  # noqa: F401
     import coreth_tpu.obs.trace  # noqa: F401
     import coreth_tpu.replay.checkpoint  # noqa: F401
     import coreth_tpu.replay.commit  # noqa: F401
@@ -149,6 +150,10 @@ def test_declared_points_all_covered():
             "test_flat_state::test_stale_generation_handout_skipped",
         "obs/export_fail":
             "test_obs::test_export_fail_fault_counted_pipeline_unharmed",
+        "obs/bundle_fail":
+            "test_forensics::test_bundle_fail_fault_counted_atomic "
+            "(+ the serialization shape in "
+            "test_bundle_fail_partial_write_cleaned)",
     }
     declared = set(faults.declared())
     covered = set(COVERAGE)

@@ -1,0 +1,592 @@
+"""Divergence forensics: the flight recorder + replay/bisection CLI.
+
+Four layers under test:
+
+1. the recorder itself (coreth_tpu/obs/recorder.py): disabled-mode
+   no-op (zero events, no ring, no directory), ring entries at window
+   dispatch, full witnesses on the host path, and the TRIGGER
+   COMPLETENESS GATE — every declared divergence/quarantine/demotion
+   seam must be wired through ``note_trigger`` somewhere in the tree
+   AND covered by a scenario below, so a new oracle cannot land
+   without forensics coverage;
+2. bundle mechanics: content-addressed directories, atomic rename
+   (the ``obs/bundle_fail`` injection leaves NO half-written dir and
+   the stream finishes on the right root), bundle paths surfaced in
+   ``StreamReport.quarantined`` and the ``/report`` endpoint;
+3. offline replay (tools/replay_bundle.py): a bundle re-executes with
+   no chain and no DB, bit-identically across ``CORETH_TRIE=native|py``
+   root derivations and across the backend pairs;
+4. bisection: injected divergences (flat oracle, hostexec oracle) each
+   produce a bundle whose bisection lands on the known tx and key, and
+   a tampered pre-state slice bisects to the first tx that touches it
+   with a key-level pre/post diff.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu import faults
+from coreth_tpu.faults import FaultPlan, FaultSpec
+from coreth_tpu.metrics import default_registry
+from coreth_tpu.obs import recorder
+from coreth_tpu.serve import ChainFeed, StreamingPipeline
+from coreth_tpu.state.statedb import normalize_state_key
+from coreth_tpu.workloads.erc20 import balance_slot
+
+from tests.test_serve import (  # noqa: E501 — deterministic chain builders shared with the serve suite
+    ADDRS, TOKEN, build_swap_chain, build_token_chain,
+    build_transfer_chain, _fresh_engine,
+)
+from tests.test_flat_state import _corrupt_drop_tx
+
+from tools.replay_bundle import (
+    bisect, default_pair, load_bundle, replay_entry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_forensics_state():
+    """No recorder/fault/observer state may leak between tests (the
+    test_faults fixture contract, extended with the recorder)."""
+    yield
+    recorder.uninstall()
+    faults.disarm()
+    from coreth_tpu.evm.hostexec import bridge
+    bridge.set_fault_observer(None)
+
+
+def _recorder(tmp_path):
+    return recorder.install(out_dir=str(tmp_path / "forensics"))
+
+
+# -------------------------------------------------------------- recorder
+
+def test_recorder_off_noop(tmp_path):
+    """CORETH_FORENSICS unset: every site is one module-global None
+    check — no ring, no triggers, no directory, empty report field."""
+    assert recorder.recorder() is None and not recorder.enabled()
+    genesis, blocks = build_transfer_chain()
+    eng, _ = _fresh_engine(genesis)
+    pipe = StreamingPipeline(eng, ChainFeed(list(blocks)))
+    rep = pipe.run()
+    assert eng.root == blocks[-1].header.root
+    assert rep.forensics == {}
+    # the module-level sites are inert no-ops, not errors
+    recorder.record_dispatch(blocks[0], None, "device/transfer")
+    recorder.note_trigger(recorder.TR_QUARANTINE, "nope", number=1)
+    recorder.flush_pending()
+    assert recorder.recorder() is None
+
+
+def test_arm_from_env_idempotent(tmp_path, monkeypatch):
+    monkeypatch.setenv("CORETH_FORENSICS", "1")
+    monkeypatch.setenv("CORETH_FORENSICS_DIR",
+                       str(tmp_path / "armed"))
+    rec = recorder.arm_from_env()
+    assert rec is not None and recorder.arm_from_env() is rec
+    assert os.path.isdir(rec.dir)
+
+
+def test_trigger_completeness_gate():
+    """Declared triggers == covered triggers, AND every trigger
+    constant is actually referenced at a call site outside the
+    recorder module — a declared seam that nothing routes through is
+    as much a gap as an unrouted one."""
+    COVERAGE = {
+        "hostexec/oracle_divergence":
+            "test_forensics::test_hostexec_divergence_bundle_bisects",
+        "flat/oracle_divergence":
+            "test_forensics::test_flat_divergence_bundle_bisects",
+        "trie/oracle_divergence":
+            "test_forensics::test_trie_oracle_trigger_routed",
+        "commit/root_mismatch":
+            "test_forensics::test_commit_root_mismatch_trigger",
+        "engine/fallback_mismatch":
+            "test_forensics::test_quarantine_bundle_roundtrip",
+        "serve/quarantine":
+            "test_forensics::test_quarantine_bundle_roundtrip",
+        "supervisor/hard_demote":
+            "test_forensics::test_hostexec_divergence_bundle_bisects",
+    }
+    declared = set(recorder.declared_triggers())
+    covered = set(COVERAGE)
+    assert declared == covered, (
+        f"uncovered triggers: {sorted(declared - covered)}; "
+        f"stale coverage entries: {sorted(covered - declared)}")
+    # source scan: each TR_* constant must be consumed somewhere in
+    # the package outside obs/ (the seam wiring itself)
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "coreth_tpu")
+    sources = []
+    for dirpath, _dirs, files in os.walk(root):
+        if "obs" in dirpath.split(os.sep):
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                with open(os.path.join(dirpath, f), "r",
+                          encoding="utf-8") as fh:
+                    sources.append(fh.read())
+    blob = "\n".join(sources)
+    consts = {"hostexec/oracle_divergence": "TR_HOSTEXEC",
+              "flat/oracle_divergence": "TR_FLAT",
+              "trie/oracle_divergence": "TR_TRIE",
+              "commit/root_mismatch": "TR_ROOT",
+              "engine/fallback_mismatch": "TR_FALLBACK",
+              "serve/quarantine": "TR_QUARANTINE",
+              "supervisor/hard_demote": "TR_DEMOTE"}
+    unrouted = [name for name, const in consts.items()
+                if const not in blob]
+    assert not unrouted, f"declared but unrouted triggers: {unrouted}"
+
+
+def test_dispatch_ring_entries_and_metrics(tmp_path):
+    """Armed recorder on a clean device-path stream: ring entries land
+    at window dispatch (backend-tagged), no bundles are written, and
+    publish() mirrors the counters into the metrics registry."""
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_transfer_chain()
+    eng, _ = _fresh_engine(genesis)
+    pipe = StreamingPipeline(eng, ChainFeed(list(blocks)))
+    rep = pipe.run()
+    assert eng.root == blocks[-1].header.root
+    assert rep.forensics["ring_blocks"] > 0
+    assert rep.forensics["bundle_writes"] == 0
+    assert any(e.backend == "device/transfer" for e in rec._ring)
+    g = default_registry.get("forensics/bundle_writes")
+    assert g is not None and g.value == 0
+    assert default_registry.get("forensics/ring_blocks").value > 0
+
+
+# ------------------------------------------------- quarantine -> bundle
+
+def _quarantined_token_stream(tmp_path, monkeypatch,
+                              corrupt_idx=None):
+    """A token-chain stream whose LAST block genuinely diverges from
+    its header (dropped last tx — corrupting an earlier block would
+    cascade root mismatches into its successors) — routed via the
+    host path so full witnesses exist — plus the recorder."""
+    monkeypatch.setenv("CORETH_MACHINE", "0")
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_token_chain()
+    eng, _ = _fresh_engine(genesis)
+    feed = list(blocks)
+    if corrupt_idx is None:
+        corrupt_idx = len(feed) - 1
+    feed[corrupt_idx] = _corrupt_drop_tx(feed[corrupt_idx])
+    pipe = StreamingPipeline(eng, ChainFeed(feed))
+    rep = pipe.run()
+    assert len(rep.quarantined) == 1
+    return rec, rep, blocks, feed
+
+
+def test_quarantine_bundle_roundtrip(tmp_path, monkeypatch):
+    """The acceptance spine: a quarantined block becomes a bundle that
+    (a) is surfaced in StreamReport.quarantined with its path, (b) is
+    content-addressed and schema-complete, and (c) replays OFFLINE —
+    fresh process state, no chain, no DB — to bit-identical roots
+    across the flat pair AND across CORETH_TRIE=native|py root
+    derivations, matching the live run's recorded per-tx receipts."""
+    rec, rep, blocks, feed = _quarantined_token_stream(
+        tmp_path, monkeypatch)
+    entry = rep.quarantined[0]
+    assert "bundle" in entry, "quarantined entry must carry the path"
+    path = entry["bundle"]
+    assert os.path.basename(path).startswith("bundle-")
+    assert rep.forensics["bundle_writes"] >= 1
+    bundle = load_bundle(path)
+    # schema: trigger + fingerprint + witnessed trigger block + blob
+    # integrity (content hashes recorded in the manifest)
+    kinds = [t["kind"] for t in bundle.triggers]
+    assert "serve/quarantine" in kinds
+    assert bundle.fingerprint.get("trie_backend") in ("native", "py")
+    row = bundle.entry()
+    assert row["number"] == entry["number"]
+    assert row["witness"]["complete"]
+    assert row["results"]["reasons"]  # the live mismatches, recorded
+    import hashlib
+    wire = bundle.blob(row["block_blob"])
+    assert hashlib.sha256(wire).hexdigest() == row["block_sha256"]
+    # offline replay: flat pair — roots bit-identical, receipts match
+    # the record (the corruption lied about the header, not the txs)
+    report = bisect(bundle, row, "flat")
+    assert report["roots"]["match"]
+    assert report["diverging_tx"] is None
+    assert report["recorded"]["reasons"]
+    # witness round-trip across trie backends: the SAME post-state
+    # folds to one root through the python trie and the native C++
+    # fold (skip the native leg without the library)
+    from coreth_tpu.mpt import native_trie
+    run_py = replay_entry(bundle, row, trie="py")
+    assert run_py["error"] is None
+    if native_trie.available():
+        run_nat = replay_entry(bundle, row, trie="native")
+        assert run_nat["root"] == run_py["root"]
+
+
+def test_tampered_prestate_bisects_to_tx_and_key(tmp_path,
+                                                 monkeypatch):
+    """REAL key-level bisection: tamper one storage pre-value in the
+    loaded bundle (a sender's token balance drops below its transfer
+    amount) and the replay must diverge from the live run's recorded
+    receipts at EXACTLY the first tx that touches that key, with the
+    key in the pre/post diff."""
+    rec, rep, blocks, feed = _quarantined_token_stream(
+        tmp_path, monkeypatch)
+    bundle = load_bundle(rep.quarantined[0]["bundle"])
+    row = bundle.entry()
+    # block `n` txs: sender k = (i*6+j) % 8; pick tx 3's sender and
+    # starve its token balance (pre-tamper value is 10**18 >> amount)
+    i = row["number"] - 1
+    j = 3
+    sender = ADDRS[(i * 6 + j) % 8]
+    key = normalize_state_key(balance_slot(sender))
+    slot_map = row["witness"]["storage"][TOKEN.hex()]
+    assert key.hex() in slot_map, "witness must hold the sender slot"
+    slot_map[key.hex()] = (1).to_bytes(32, "big").hex()
+    report = bisect(bundle, row, "flat")
+    assert report["diverging_tx"] == j
+    assert report["source"] == "recorded"
+    assert f"slot:{TOKEN.hex()}:{key.hex()}" in report["diff"] or any(
+        key.hex() in k for k in report["diff"])
+    # the recorded receipt succeeded; the starved replay did not
+    assert report["recorded_receipt"]["status"] == 1
+    assert report["replayed_receipt"]["status"] == 0
+
+
+def test_report_endpoint_quarantine_forensics(tmp_path, monkeypatch):
+    """Satellite: /report carries quarantine forensics — numbers,
+    recorded mismatch reasons, and bundle paths."""
+    from coreth_tpu.obs.server import TelemetryServer
+    rec, rep, blocks, feed = _quarantined_token_stream(
+        tmp_path, monkeypatch)
+    # re-serve the live report the pipeline exposes on /report
+    genesisless_pipe_report = rep  # final report == live superset
+    srv = TelemetryServer(port=0, report=lambda: {
+        "quarantined": genesisless_pipe_report.quarantined,
+        "forensics": rec.snapshot()})
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/report", timeout=5) as resp:
+            doc = json.loads(resp.read())
+    finally:
+        srv.stop()
+    q = doc["quarantined"][0]
+    assert q["number"] == rep.quarantined[0]["number"]
+    assert q["reasons"]
+    assert q["bundle"].startswith(str(tmp_path))
+    assert doc["forensics"]["bundle_writes"] >= 1
+    assert any(b["kind"] == "serve/quarantine"
+               for b in doc["forensics"]["bundles"])
+
+
+def test_live_report_includes_forensics(tmp_path, monkeypatch):
+    """The pipeline's own /report payload (not a synthetic server)
+    carries the forensics snapshot and per-entry bundle paths."""
+    monkeypatch.setenv("CORETH_MACHINE", "0")
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    _recorder(tmp_path)
+    genesis, blocks = build_token_chain()
+    eng, _ = _fresh_engine(genesis)
+    feed = list(blocks)
+    feed[-1] = _corrupt_drop_tx(feed[-1])
+    pipe = StreamingPipeline(eng, ChainFeed(feed))
+    pipe.run()
+    row = pipe._live_report()
+    assert row["forensics"]["bundle_writes"] >= 1
+    assert "bundle" in row["quarantined"][0]
+
+
+# -------------------------------------------------------- fault point
+
+def test_bundle_fail_fault_counted_atomic(tmp_path, monkeypatch):
+    """obs/bundle_fail: every bundle write fails mid-drain — the
+    stream still finishes on the right root, failures are counted,
+    and NO half-written directory survives (atomic-rename pinned)."""
+    monkeypatch.setenv("CORETH_MACHINE", "0")
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_token_chain()
+    eng, _ = _fresh_engine(genesis)
+    feed = list(blocks)
+    feed[-1] = _corrupt_drop_tx(feed[-1])
+    with faults.armed(FaultPlan({"obs/bundle_fail": FaultSpec()})):
+        pipe = StreamingPipeline(eng, ChainFeed(feed))
+        rep = pipe.run()
+    # the stream finished: clean prefix committed on the exact roots,
+    # the poison block quarantined, nothing halted or crashed
+    assert rep.blocks == len(feed)
+    assert rep.halted is None
+    assert len(rep.quarantined) == 1
+    assert rep.forensics["bundle_failures"] >= 1
+    assert rep.forensics["bundle_writes"] == 0
+    assert rep.quarantined and "bundle" not in rep.quarantined[0]
+    # no half-written directory: the forensics dir is empty (no
+    # bundle-*, no .tmp-* remnants)
+    assert os.listdir(rec.dir) == []
+    assert default_registry.get("forensics/bundle_failures").value >= 1
+
+
+def test_bundle_fail_partial_write_cleaned(tmp_path):
+    """The atomic protocol at the unit level: a spec that fires AFTER
+    the first write begins (injected via a write-time OSError) leaves
+    no temp dir behind."""
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_transfer_chain(n_blocks=2)
+    rec.record_dispatch(blocks[0], None, "device/transfer")
+    rec.record_witness(
+        blocks[0], None,
+        {"accounts": {}, "storage": {}, "code": {}, "complete": True,
+         "failed_tx_index": None},
+        {"receipts": [], "header_root": blocks[0].header.root,
+         "computed_root": None, "reasons": []})
+    # poison the manifest content so json.dumps raises mid-write
+    rec._ring[-1].results["receipts"] = [object()]
+    rec.note_trigger(recorder.TR_QUARANTINE, "boom",
+                     number=blocks[0].number)
+    rec.drain()
+    assert rec.bundle_failures == 1 and rec.bundle_writes == 0
+    assert os.listdir(rec.dir) == []
+
+
+def test_identical_trigger_dedups_but_still_surfaces(tmp_path):
+    """A repeated identical trigger (same evidence, e.g. two runs over
+    the same poison block) writes ONE content-addressed dir but BOTH
+    occurrences surface a bundle record — the second run's report must
+    not claim 'no evidence'.  And close() actually stops the drain
+    thread."""
+    import threading
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_transfer_chain(n_blocks=2)
+    rec.record_witness(
+        blocks[0], None,
+        {"accounts": {}, "storage": {}, "code": {}, "complete": True,
+         "failed_tx_index": None},
+        {"receipts": [], "header_root": blocks[0].header.root,
+         "computed_root": None, "reasons": ["x"]})
+    for _ in range(2):
+        rec.note_trigger(recorder.TR_QUARANTINE, "same evidence",
+                         number=blocks[0].number)
+    rec.drain()
+    assert rec.bundle_writes == 1 and rec.bundle_dedup == 1
+    assert len(rec.bundles) == 2
+    assert rec.bundles[0]["path"] == rec.bundles[1]["path"]
+    assert rec.bundles_for(blocks[0].number)
+    recorder.uninstall()   # close(): the drain thread must exit
+    assert not any(t.name == "forensics-drain" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# -------------------------------------------- injected oracle bisection
+
+def test_flat_divergence_bundle_bisects(tmp_path, monkeypatch):
+    """A poisoned flat entry (the injected-divergence shape of
+    test_flat_state) trips the armed statedb oracle mid-tx; the bundle
+    records the exact tx/key, carries the trie-truth pre-value the
+    aborted read never cached, and offline bisection lands on the
+    known tx with the key in the recorded-vs-replayed diff."""
+    monkeypatch.setenv("CORETH_FLAT", "1")
+    monkeypatch.setenv("CORETH_FLAT_CHECK", "1")
+    monkeypatch.setenv("CORETH_MACHINE", "0")
+    monkeypatch.setenv("CORETH_NO_TOKEN_FASTPATH", "1")
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_token_chain()
+    eng, _ = _fresh_engine(genesis)
+    # block 1 tx 3's sender is ADDRS[3]; its balance slot first reads
+    # at that tx — poison the flat copy against the trie's 10**18
+    key = normalize_state_key(balance_slot(ADDRS[3]))
+    eng.flat.fill_storage(TOKEN, key, 424242)
+    pipe = StreamingPipeline(eng, ChainFeed(list(blocks)))
+    try:
+        pipe.run()
+    except ValueError:
+        pass  # the oracle eventually surfaces raw; evidence is kept
+    recorder.uninstall()
+    paths = [b["path"] for b in rec.bundles
+             if b["kind"] == "flat/oracle_divergence"]
+    assert paths, f"no flat bundle in {rec.bundles}"
+    bundle = load_bundle(paths[0])
+    assert default_pair(bundle) == "flat"
+    trig = bundle.triggers[0]
+    assert trig["kind"] == "flat/oracle_divergence"
+    assert trig["tx_index"] == 3
+    assert trig["key"] == key.hex()
+    assert trig["contract"] == TOKEN.hex()
+    row = bundle.entry(number=1)
+    # the trigger key's TRIE-side pre-value was patched into the
+    # witness even though the aborted read never cached it
+    assert row["witness"]["storage"][TOKEN.hex()][key.hex()] \
+        == (10**18).to_bytes(32, "big").hex()
+    report = bisect(bundle, row, "flat")
+    assert report["diverging_tx"] == 3
+    assert report["source"] == "recorded"   # live tx died, replay ran
+    assert report["recorded_receipt"]["status"] == 0
+    assert report["replayed_receipt"]["status"] == 1
+    assert report["roots"]["match"]         # flat pair bit-identical
+
+
+def _hostexec_available():
+    from coreth_tpu.evm.hostexec.backend import load_hostexec
+    return load_hostexec() is not None
+
+
+def test_hostexec_divergence_bundle_bisects(tmp_path, monkeypatch):
+    """The armed hostexec oracle trips (injected at the existing
+    native/oracle_divergence point) on a known bridge call: the bundle
+    records the tx index + first native write key, the hard-demote
+    trigger rides the same bundle, and offline bisection under the
+    exec pair lands on the recorded tx with bit-identical roots (the
+    divergence was injected, so the honest offline verdict is 'did
+    not reproduce; live locus was tx N')."""
+    if not _hostexec_available():
+        pytest.skip("hostexec native ABI unavailable")
+    monkeypatch.setenv("CORETH_MACHINE", "0")
+    monkeypatch.setenv("CORETH_HOST_EXEC_CHECK", "1")
+    monkeypatch.setenv("CORETH_SUPERVISOR_STRIKES", "99")
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_swap_chain()
+    eng, _ = _fresh_engine(genesis)
+    plan = FaultPlan({"native/oracle_divergence":
+                      FaultSpec(after=2, times=1)})
+    with faults.armed(plan):
+        pipe = StreamingPipeline(eng, ChainFeed(list(blocks)))
+        rep = pipe.run()
+    assert eng.root == blocks[-1].header.root
+    paths = [b["path"] for b in rec.bundles
+             if b["kind"] == "hostexec/oracle_divergence"]
+    assert paths, f"no hostexec bundle in {rec.bundles}"
+    bundle = load_bundle(paths[0])
+    kinds = {t["kind"] for t in bundle.triggers}
+    assert "hostexec/oracle_divergence" in kinds
+    assert "supervisor/hard_demote" in kinds  # rode the same bundle
+    trig = bundle.triggers[0]
+    # the 3rd bridge call (after=2) = block 1, tx index 2
+    assert trig["number"] == 1 and trig["tx_index"] == 2
+    assert trig["key"] is not None
+    row = bundle.entry()
+    assert row["number"] == 1 and row["witness"]["complete"]
+    # the trigger key is a real witnessed storage key of the callee
+    assert trig["key"] in row["witness"]["storage"][trig["contract"]]
+    report = bisect(bundle, row, "exec")
+    assert report["roots"]["match"]
+    assert report["diverging_tx"] == 2
+    assert report["source"] == "trigger"
+    assert report["diff"]  # key-level pre/post table at the tx
+    assert rep.forensics["bundle_writes"] >= 1
+
+
+def test_one_sided_replay_failure_is_a_divergence(monkeypatch):
+    """A divergence that surfaces as an EXCEPTION on one backend (the
+    other applies the tx) must bisect to the first tx past the common
+    prefix — not report 'backends agree'."""
+    from tools import replay_bundle as rb
+    tx0 = {"status": 1, "gas_used": 21000, "cumulative": 21000,
+           "logs": 0, "logs_hash": None, "state": {"k": "1"}}
+    tx1 = dict(tx0, cumulative=42000, state={"k": "2"})
+    runs = {
+        True: {"txs": [tx0], "error": "tx 1: boom", "failed_tx": 1,
+               "root": "aa", "pre": {"k": "0"}, "touched_at": {}},
+        False: {"txs": [tx0, tx1], "error": None, "root": "bb",
+                "pre": {"k": "0"}, "touched_at": {}},
+    }
+    monkeypatch.setattr(
+        rb, "replay_entry",
+        lambda b, r, env=None, flat=False, trie="py": dict(runs[flat]))
+    bundle = rb.Bundle("/nowhere", {"triggers": [], "blocks": []})
+    row = {"number": 1, "witness": {"complete": True}, "results": {}}
+    report = rb.bisect(bundle, row, "flat")
+    assert report["diverging_tx"] == 1
+    assert report["source"] == "pair"
+    assert report["errors"]["a"] == "tx 1: boom"
+    assert report["diff"]  # the surviving side's post vs pre
+
+
+def test_trie_pair_single_replay(tmp_path, monkeypatch):
+    """--pair trie runs ONE replay; the pair is the two root
+    derivations (python fold vs native C++ fold) of the same
+    post-state."""
+    from coreth_tpu.mpt import native_trie
+    if not native_trie.available():
+        pytest.skip("native trie unavailable")
+    from tools import replay_bundle as rb
+    rec, rep, blocks, feed = _quarantined_token_stream(
+        tmp_path, monkeypatch)
+    bundle = load_bundle(rep.quarantined[0]["bundle"])
+    row = bundle.entry()
+    calls = []
+    orig = rb.replay_entry
+
+    def counted(*a, **kw):
+        calls.append(kw)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(rb, "replay_entry", counted)
+    report = rb.bisect(bundle, row, "trie")
+    assert len(calls) == 1 and calls[0].get("trie") == "both"
+    assert report["roots"]["match"]
+    assert report["roots"]["a"] and report["roots"]["b"]
+
+
+# -------------------------------------- trigger routing (window paths)
+
+def test_commit_root_mismatch_trigger(tmp_path):
+    """The window-fold root check routes through the recorder: a
+    corrupted expected root freezes a commit/root_mismatch bundle
+    (context-only — the crash path has no host retry)."""
+    from coreth_tpu.replay.engine import ReplayError
+    from coreth_tpu.types import Block
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_transfer_chain(n_blocks=2)
+    eng, _ = _fresh_engine(genesis)
+    eng.replay_block(blocks[0])
+    # a header whose ROOT lies (gas/receipts true): the device window
+    # executes and validates fine, the window fold cannot land on the
+    # claimed root — the TR_ROOT seam, no host retry on this path
+    bad = Block.decode(blocks[1].encode())
+    bad.header.root = b"\x13" * 32
+    batch = eng._classify(bad)
+    assert batch is not None
+    win = eng._issue_window([(bad, batch)])
+    with pytest.raises(ReplayError, match="state root mismatch"):
+        eng._complete_window(win, [bad], 0)
+    recorder.uninstall()   # flush_pending freezes the context bundle
+    assert any(b["kind"] == "commit/root_mismatch"
+               for b in rec.bundles), rec.bundles
+    bundle = load_bundle([b["path"] for b in rec.bundles
+                          if b["kind"] == "commit/root_mismatch"][0])
+    assert bundle.triggers[0]["number"] == bad.number
+    # ring context (the dispatch entries) made it into the bundle
+    assert any(r["number"] == bad.number for r in bundle.entries())
+
+
+def test_trie_oracle_trigger_routed(tmp_path, monkeypatch):
+    """The CORETH_TRIE_CHECK twin-oracle seam routes through the
+    recorder: a divergence injected into the python twin behind the
+    wrapper's back (the test_native_trie shape) bundles as
+    trie/oracle_divergence."""
+    from coreth_tpu.mpt import native_trie
+    if not native_trie.available():
+        pytest.skip("native trie unavailable")
+    monkeypatch.setenv("CORETH_TRIE", "native")
+    monkeypatch.setenv("CORETH_TRIE_CHECK", "1")
+    rec = _recorder(tmp_path)
+    genesis, blocks = build_transfer_chain(n_blocks=3)
+    eng, _ = _fresh_engine(genesis)
+    eng.replay_block(blocks[0])
+    eng.commit_pipe.flush()
+    # sneak a key into the python twin only: the next fold diverges
+    from coreth_tpu.crypto import keccak256
+    from coreth_tpu.mpt.trie import Trie
+    Trie.update(eng.trie.py, keccak256(b"\x66" * 20), b"sneak")
+    with pytest.raises(native_trie.TrieOracleError):
+        eng.replay(list(blocks[1:]))
+    recorder.uninstall()
+    assert any(b["kind"] == "trie/oracle_divergence"
+               for b in rec.bundles), rec.bundles

@@ -1,0 +1,603 @@
+"""Offline replay + divergence bisection for forensics bundles.
+
+A bundle (written by ``coreth_tpu/obs/recorder.py`` when an armed
+oracle trips, a block quarantines, or a backend hard-demotes) is
+self-contained: block wire bytes, parent header, the touched pre-state
+slice (account tuples, storage pre-values, contract code), per-tx
+receipt observations from the live run, and the trigger context.  This
+tool re-executes the trigger block from that slice — **no chain, no
+DB** — under a selectable backend pair, bisects to the first diverging
+transaction, and prints a key-level pre/post state diff for both sides.
+
+Backend pairs (``--pair``):
+
+- ``exec``  — native C++ host engine vs the Python interpreter
+  (``CORETH_HOST_EXEC=native|py``; the hostexec-oracle pair);
+- ``flat``  — StateDB reads through a flat store seeded from the
+  witness vs trie-walk-only reads (the flat-oracle pair);
+- ``trie``  — one replay, with the post-state root derived by BOTH the
+  Python trie and the native C++ fold (the trie-oracle pair; per-tx
+  streams are shared, the roots are the differential).
+
+Bisection compares, in priority order: the two replays' per-tx
+observation streams (receipt fields + the witness slice's values after
+every tx); the replay against the live run's RECORDED per-tx receipts;
+and finally the trigger's own recorded locus (tx index / key) when
+both backends agree offline — i.e. the live trip did not reproduce
+from the witnessed pre-state.  When the trigger names a key, the first
+transaction that touches it is reported alongside.
+
+Usage::
+
+    python tools/replay_bundle.py <bundle-dir> [--block N]
+        [--pair exec|flat|trie] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------- loading
+
+class Bundle:
+    """One loaded bundle: the manifest plus lazy blob access."""
+
+    def __init__(self, path: str, manifest: dict):
+        self.path = path
+        self.manifest = manifest
+
+    @property
+    def triggers(self) -> List[dict]:
+        return self.manifest.get("triggers", [])
+
+    @property
+    def config(self) -> dict:
+        return self.manifest.get("config", {})
+
+    @property
+    def fingerprint(self) -> dict:
+        return self.manifest.get("fingerprint", {})
+
+    def blob(self, name: str) -> bytes:
+        with open(os.path.join(self.path, "blobs", name), "rb") as f:
+            return f.read()
+
+    def entries(self) -> List[dict]:
+        return self.manifest.get("blocks", [])
+
+    def entry(self, number: Optional[int] = None) -> dict:
+        """The replay target: ``number`` if given, else the first
+        trigger's block, else the newest entry carrying a witness."""
+        rows = self.entries()
+        if number is None:
+            for t in self.triggers:
+                if t.get("number") is not None:
+                    number = t["number"]
+                    break
+        if number is not None:
+            for row in rows:
+                if row["number"] == number:
+                    return row
+            raise SystemExit(f"block {number} not in bundle "
+                             f"(has {[r['number'] for r in rows]})")
+        witnessed = [r for r in rows if r.get("witness")]
+        if not witnessed:
+            raise SystemExit(
+                "context-only bundle: no entry carries a full witness "
+                "(the trigger fired on a path with no host retry)")
+        return witnessed[-1]
+
+    def block_of(self, row: dict):
+        from coreth_tpu.types import Block
+        return Block.decode(self.blob(row["block_blob"]))
+
+    def parent_of(self, row: dict):
+        from coreth_tpu.types.block import Header
+        name = row.get("parent_header_blob")
+        return Header.decode(self.blob(name)) if name else None
+
+    def chain_config(self):
+        from coreth_tpu.params import ChainConfig
+        allowed = {f.name for f in dataclasses.fields(ChainConfig)}
+        kw = {k: v for k, v in self.config.items() if k in allowed}
+        return ChainConfig(**kw)
+
+
+def load_bundle(path: str) -> Bundle:
+    with open(os.path.join(path, "manifest.json"), "r",
+              encoding="utf-8") as f:
+        return Bundle(path, json.load(f))
+
+
+def _witness_slices(row: dict):
+    """(accounts, storage, code) of a witness row, bytes-keyed."""
+    w = row.get("witness")
+    if not w:
+        raise SystemExit(
+            f"block {row['number']} has no witness (backend "
+            f"{row['backend']}: only host-path blocks carry the "
+            "replayable pre-state slice)")
+    accounts = {bytes.fromhex(a): acct
+                for a, acct in w["accounts"].items()}
+    storage = {(bytes.fromhex(c), bytes.fromhex(k)):
+               bytes.fromhex(v)
+               for c, sub in w["storage"].items()
+               for k, v in sub.items()}
+    return accounts, storage, w.get("code", [])
+
+
+# ------------------------------------------------------------ rebuild
+
+def build_state(bundle: Bundle, row: dict, flat: bool = False):
+    """Rebuild the pre-state slice into a fresh in-memory Database:
+    returns (statedb, db, root).  The root covers ONLY the slice —
+    comparisons are pairwise (replay vs replay vs recorded), never
+    against the live chain's full root."""
+    from coreth_tpu.mpt import EMPTY_ROOT
+    from coreth_tpu.state import Database, StateDB
+    accounts, storage, code_refs = _witness_slices(row)
+    code_by_hash = {bytes.fromhex(c["code_hash"]):
+                    bundle.blob(c["blob"]) for c in code_refs}
+    db = Database()
+    sdb = StateDB(EMPTY_ROOT, db)
+    for addr, acct in accounts.items():
+        if acct is None:
+            continue
+        if acct["balance"]:
+            sdb.add_balance(addr, acct["balance"])
+        if acct["nonce"]:
+            sdb.set_nonce(addr, acct["nonce"])
+        code = code_by_hash.get(bytes.fromhex(acct["code_hash"]))
+        if code:
+            sdb.set_code(addr, code)
+    for (contract, key), val in storage.items():
+        sdb.set_state(contract, key, val)
+    root = sdb.commit(delete_empty_objects=False)
+    flat_view = None
+    if flat:
+        from coreth_tpu.state.flat import (
+            DELETED, FlatStateView, FlatStore)
+        store = FlatStore()
+        for addr, acct in accounts.items():
+            if acct is None:
+                store.fill_account(addr, DELETED)
+            else:
+                # the rebuilt account's storage root/code hash may
+                # differ from the live chain's (partial slice): read
+                # the REBUILT tuple so flat and trie agree by
+                # construction — the pair A/B exercises the read PATH
+                raw = sdb._trie.get(addr)
+                if raw is not None:
+                    from coreth_tpu.types import StateAccount
+                    a = StateAccount.from_rlp(raw)
+                    store.fill_account(addr, (a.balance, a.nonce,
+                                              a.root, a.code_hash,
+                                              a.is_multi_coin))
+        for (contract, key), val in storage.items():
+            store.fill_storage(contract, key,
+                               int.from_bytes(val, "big"))
+        flat_view = FlatStateView(store, check=False)
+    return StateDB(root, db, flat=flat_view), db, root
+
+
+# -------------------------------------------------------------- replay
+
+class _EnvPatch:
+    def __init__(self, env: Dict[str, Optional[str]]):
+        self.env = env
+        self._saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        for k, v in self.env.items():
+            self._saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False
+
+
+def _pre_map(accounts, storage) -> dict:
+    """The witness slice as a flat observation map (the per-tx
+    snapshots overlay the StateDB's current objects on this)."""
+    out = {}
+    for addr, acct in accounts.items():
+        out[f"account:{addr.hex()}"] = None if acct is None else (
+            acct["balance"], acct["nonce"], acct["code_hash"])
+    for (contract, key), val in storage.items():
+        out[f"slot:{contract.hex()}:{key.hex()}"] = val.hex()
+    return out
+
+
+def _slice_snapshot(sdb, pre: dict) -> dict:
+    """Current values of every witnessed (or execution-touched) key.
+    Purely OBSERVATIONAL — reads the StateDB's object dicts directly
+    instead of going through get_state/get_balance, which would
+    populate the committed-read cache and destroy the first-touch
+    attribution ``_touched_keys`` relies on."""
+    out = dict(pre)
+    for addr, obj in sdb._objects.items():
+        k = f"account:{addr.hex()}"
+        if obj.deleted:
+            out[k] = None
+        else:
+            a = obj.account
+            out[k] = (a.balance, a.nonce, a.code_hash.hex())
+        cur = {}
+        cur.update(obj.origin_storage)
+        cur.update(obj.pending_storage)
+        cur.update(obj.dirty_storage)
+        for sk, sv in cur.items():
+            out[f"slot:{addr.hex()}:{sk.hex()}"] = sv.hex()
+    return out
+
+
+def _touched_keys(sdb) -> set:
+    """Keys the StateDB has resolved so far (object existence = the
+    account was touched; committed-read cache = the slot was read)."""
+    touched = set()
+    for addr, obj in sdb._objects.items():
+        touched.add(f"account:{addr.hex()}")
+        for key in obj.origin_storage:
+            touched.add(f"slot:{addr.hex()}:{key.hex()}")
+        for key in obj.dirty_storage:
+            touched.add(f"slot:{addr.hex()}:{key.hex()}")
+        for key in obj.pending_storage:
+            touched.add(f"slot:{addr.hex()}:{key.hex()}")
+    return touched
+
+
+def replay_entry(bundle: Bundle, row: dict,
+                 env: Optional[Dict[str, Optional[str]]] = None,
+                 flat: bool = False, trie: str = "py") -> dict:
+    """Re-execute one witnessed block tx-by-tx from its pre-state
+    slice.  Returns {"txs": [per-tx observations], "root": hex,
+    "pre": slice snapshot, "touched_at": key -> first tx index,
+    "error": str | None, "pre_root": hex}."""
+    from coreth_tpu.evm import EVM, TxContext
+    from coreth_tpu.evm.hostexec import bridge as hx_bridge
+    from coreth_tpu.processor.message import tx_to_message
+    from coreth_tpu.processor.state_processor import (
+        apply_transaction, apply_upgrades, new_block_context)
+    from coreth_tpu.processor.state_transition import GasPool
+    # the ONE builder of the per-tx observation row, shared with the
+    # live recorder's witness (engine._receipt_rows): bisection's
+    # recorded-vs-replayed comparison is only sound if both sides
+    # derive {status, gas, cumulative, logs, logs_hash} identically
+    from coreth_tpu.replay.engine import _receipt_rows
+    from coreth_tpu.types import LatestSigner
+
+    accounts, storage, _code = _witness_slices(row)
+    block = bundle.block_of(row)
+    parent = bundle.parent_of(row)
+    config = bundle.chain_config()
+    env = dict(env or {})
+    # the offline replay must be hermetic: no live-process supervisor
+    # deciding routing, no armed oracle raising mid-bisection
+    env.setdefault("CORETH_HOST_EXEC_CHECK", None)
+    env.setdefault("CORETH_FLAT_CHECK", None)
+    observer = hx_bridge._OBSERVER
+    hx_bridge.set_fault_observer(None)
+    out: dict = {"txs": [], "root": None, "error": None}
+    try:
+        with _EnvPatch(env):
+            sdb, db, pre_root = build_state(bundle, row, flat=flat)
+            out["pre_root"] = pre_root.hex()
+            pre = _pre_map(accounts, storage)
+            out["pre"] = pre
+            apply_upgrades(config, parent.time if parent else None,
+                           block, sdb)
+            ctx = new_block_context(block.header)
+            evm = EVM(ctx, TxContext(), sdb, config, None)
+            signer = LatestSigner(config.chain_id)
+            gp = GasPool(block.gas_limit)
+            used = [0]
+            touched_at: Dict[str, int] = {}
+            seen = _touched_keys(sdb)
+            for i, tx in enumerate(block.transactions):
+                try:
+                    msg = tx_to_message(tx, signer, block.header.base_fee)
+                    sdb.set_tx_context(tx.hash(), i)
+                    receipt = apply_transaction(
+                        msg, gp, sdb, block.header.number,
+                        block.hash(), tx, used, evm)
+                except Exception as exc:  # noqa: BLE001 — a dead tx IS a finding: record it and stop the stream there
+                    out["error"] = f"tx {i}: {exc!r}"
+                    out["failed_tx"] = i
+                    break
+                now = _touched_keys(sdb)
+                for k in now - seen:
+                    touched_at.setdefault(k, i)
+                seen = now
+                obs_row = _receipt_rows([receipt])[0]
+                obs_row["state"] = _slice_snapshot(sdb, pre)
+                out["txs"].append(obs_row)
+            out["touched_at"] = touched_at
+            root = sdb.commit(delete_empty_objects=True)
+            out["root"] = root.hex()
+            if trie in ("native", "both"):
+                # derive the SAME post-state's root through the native
+                # C++ fold — the trie-oracle differential
+                from coreth_tpu.mpt.native_trie import NativeSecureTrie
+                nroot = NativeSecureTrie.from_python_trie(
+                    sdb._trie).hash()
+                if trie == "native":
+                    out["root"] = nroot.hex()
+                else:
+                    out["root_native"] = nroot.hex()
+            out["hostexec"] = hx_bridge.counters()
+    finally:
+        hx_bridge.set_fault_observer(observer)
+    return out
+
+
+# --------------------------------------------------------------- bisect
+
+_PAIRS = {
+    "exec": ({"CORETH_HOST_EXEC": "native"},
+             {"CORETH_HOST_EXEC": "py"}),
+    "flat": (None, None),   # flat=True vs flat=False (same env)
+    "trie": (None, None),   # same run; py-vs-native root derivation
+}
+
+_RECEIPT_FIELDS = ("status", "gas_used", "cumulative", "logs",
+                   "logs_hash")
+
+
+def default_pair(bundle: Bundle) -> str:
+    kinds = [t["kind"] for t in bundle.triggers]
+    if any(k.startswith("flat/") for k in kinds):
+        return "flat"
+    if any(k.startswith(("trie/", "commit/")) for k in kinds):
+        return "trie"
+    return "exec"
+
+
+def _tx_diff(pre: dict, a: Optional[dict],
+             b: Optional[dict]) -> dict:
+    """Key-level pre/post diff at one tx.  With two sides: every
+    slice key whose post value differs between them.  One-sided (the
+    recorded-vs-replayed case, or two agreeing sides): every key the
+    tx changed vs its pre-state."""
+    sa = (a or {}).get("state", {})
+    sb = b.get("state", {}) if b is not None else None
+    keys = set()
+    if sb is not None:
+        keys = {k for k in set(sa) | set(sb)
+                if sa.get(k) != sb.get(k)}
+    if not keys:
+        keys = {k for k in set(sa) | set(pre)
+                if sa.get(k) != pre.get(k)}
+        sb = None   # sides agree: show the tx's own write set
+    out = {}
+    for k in sorted(keys):
+        row = {"pre": pre.get(k), "a": sa.get(k)}
+        if sb is not None:
+            row["b"] = sb.get(k)
+        out[k] = row
+    return out
+
+
+def bisect(bundle: Bundle, row: dict, pair: str) -> dict:
+    """Replay under the backend pair and locate the first diverging
+    transaction (see module docstring for the comparison priority)."""
+    if pair == "exec":
+        env_a, env_b = _PAIRS["exec"]
+        run_a = replay_entry(bundle, row, env=env_a)
+        run_b = replay_entry(bundle, row, env=env_b)
+    elif pair == "flat":
+        run_a = replay_entry(bundle, row, flat=True)
+        run_b = replay_entry(bundle, row, flat=False)
+    elif pair == "trie":
+        # ONE replay; the pair is the two root DERIVATIONS of the same
+        # post-state (python fold vs native C++ fold) — re-executing
+        # twice would only compare a deterministic run against itself
+        run_a = replay_entry(bundle, row, trie="both")
+        run_b = dict(run_a)
+        run_b["root"] = run_a.get("root_native")
+    else:
+        raise SystemExit(f"unknown pair {pair!r}")
+    trigger = next((t for t in bundle.triggers
+                    if t.get("number") in (None, row["number"])),
+                   bundle.triggers[0] if bundle.triggers else {})
+    report = {
+        "bundle": bundle.path,
+        "block": row["number"],
+        "pair": pair,
+        "trigger": trigger,
+        "roots": {"a": run_a["root"], "b": run_b["root"],
+                  "match": run_a["root"] == run_b["root"]},
+        "recorded": {
+            "header_root": (row.get("results") or {}).get(
+                "header_root"),
+            "computed_root": (row.get("results") or {}).get(
+                "computed_root"),
+            "reasons": (row.get("results") or {}).get("reasons"),
+        },
+        "errors": {"a": run_a["error"], "b": run_b["error"]},
+        "diverging_tx": None, "source": None, "diff": {},
+    }
+    # witness completeness bounds how far comparisons are meaningful
+    w = row.get("witness") or {}
+    limit = min(len(run_a["txs"]), len(run_b["txs"]))
+    if not w.get("complete", True) \
+            and w.get("failed_tx_index") is not None:
+        limit = min(limit, w["failed_tx_index"] + 1)
+        report["witness_complete"] = False
+    # 1) the pair's own streams
+    for i in range(limit):
+        if any(run_a["txs"][i][f] != run_b["txs"][i][f]
+               for f in _RECEIPT_FIELDS) \
+                or run_a["txs"][i]["state"] != run_b["txs"][i]["state"]:
+            pre = run_a["txs"][i - 1]["state"] if i else run_a["pre"]
+            report.update(
+                diverging_tx=i, source="pair",
+                diff=_tx_diff(pre, run_a["txs"][i], run_b["txs"][i]))
+            return report
+    # 1b) a ONE-SIDED stop is a divergence too: one backend died at a
+    # tx the other applied (a state divergence surfacing as an
+    # exception).  The first tx past the common prefix is the locus —
+    # without this the report would claim the backends "agree" while
+    # the roots line shows one side missing.
+    if run_a is not run_b and run_b["txs"] is not run_a["txs"] \
+            and (len(run_a["txs"]) != len(run_b["txs"])
+                 or (run_a["error"] is None) != (run_b["error"] is None)):
+        i = min(len(run_a["txs"]), len(run_b["txs"]))
+        a_tx = run_a["txs"][i] if i < len(run_a["txs"]) else None
+        b_tx = run_b["txs"][i] if i < len(run_b["txs"]) else None
+        pre = run_a["txs"][i - 1]["state"] if i else run_a["pre"]
+        report.update(
+            diverging_tx=i, source="pair",
+            diff=_tx_diff(pre, a_tx if a_tx is not None else b_tx,
+                          None if (a_tx is None or b_tx is None)
+                          else b_tx))
+        return report
+    # 2) replay vs the live run's recorded receipts
+    recorded = (row.get("results") or {}).get("receipts") or []
+    for i in range(min(limit, len(recorded))):
+        if any(run_a["txs"][i][f] != recorded[i].get(f)
+               for f in _RECEIPT_FIELDS):
+            pre = run_a["txs"][i - 1]["state"] if i else run_a["pre"]
+            diff = _tx_diff(pre, run_a["txs"][i], None)
+            # a reverted tx writes nothing — surface the keys it READ
+            # (first-touched here) too, so the starved/poisoned slot
+            # shows up in the key-level table with its pre value
+            state_i = run_a["txs"][i]["state"]
+            for k, ti in run_a.get("touched_at", {}).items():
+                if ti == i:
+                    diff.setdefault(k, {"pre": pre.get(k),
+                                        "a": state_i.get(k)})
+            report.update(diverging_tx=i, source="recorded", diff=diff)
+            report["recorded_receipt"] = recorded[i]
+            report["replayed_receipt"] = {
+                f: run_a["txs"][i][f] for f in _RECEIPT_FIELDS}
+            return report
+    # 3) both backends agree and match the record: the live trip did
+    # not reproduce from the witnessed pre-state — report the
+    # trigger's own locus (and, when it names a key, the first tx
+    # that touches that key in the replay)
+    if trigger:
+        key = trigger.get("key")
+        contract = trigger.get("contract")
+        tx_i = trigger.get("tx_index")
+        first_touch = None
+        if key is not None:
+            needle = f"slot:{contract}:{key}" if contract \
+                else f":{key}"
+            for k, i in run_a.get("touched_at", {}).items():
+                if k.endswith(needle) or k == needle:
+                    first_touch = i if first_touch is None \
+                        else min(first_touch, i)
+        elif contract is not None:
+            first_touch = run_a.get("touched_at", {}).get(
+                f"account:{contract}")
+        report["first_tx_touching_trigger_key"] = first_touch
+        if tx_i is not None or first_touch is not None:
+            i = tx_i if tx_i is not None else first_touch
+            report["diverging_tx"] = i
+            report["source"] = "trigger"
+            if i is not None and i < limit:
+                pre = run_a["txs"][i - 1]["state"] if i \
+                    else run_a["pre"]
+                report["diff"] = _tx_diff(pre, run_a["txs"][i],
+                                          run_b["txs"][i])
+    return report
+
+
+# ------------------------------------------------------------------ CLI
+
+def _print_report(report: dict) -> None:
+    t = report["trigger"]
+    print(f"bundle   {report['bundle']}")
+    print(f"block    {report['block']}  (pair: {report['pair']})")
+    if t:
+        print(f"trigger  {t.get('kind')}: {t.get('reason')}")
+        if t.get("tx_index") is not None or t.get("key"):
+            print(f"         recorded locus: tx={t.get('tx_index')} "
+                  f"contract={t.get('contract')} key={t.get('key')}")
+    r = report["roots"]
+    print(f"roots    a={r['a']}  b={r['b']}  "
+          f"{'MATCH' if r['match'] else 'DIVERGE'}")
+    rec = report["recorded"]
+    if rec.get("reasons"):
+        print(f"recorded mismatches in live run: {rec['reasons']}")
+    if report["errors"]["a"] or report["errors"]["b"]:
+        print(f"errors   a={report['errors']['a']}  "
+              f"b={report['errors']['b']}")
+    if report["diverging_tx"] is None:
+        print("bisect   no divergence located (backends agree and "
+              "match the recorded receipts)")
+        return
+    print(f"bisect   first diverging tx = {report['diverging_tx']} "
+          f"(source: {report['source']})")
+    if report.get("first_tx_touching_trigger_key") is not None:
+        print(f"         first tx touching trigger key = "
+              f"{report['first_tx_touching_trigger_key']}")
+    if report.get("recorded_receipt"):
+        print(f"         recorded receipt: {report['recorded_receipt']}")
+        print(f"         replayed receipt: {report['replayed_receipt']}")
+    for key, d in report["diff"].items():
+        print(f"  {key}")
+        print(f"    pre : {d['pre']}")
+        print(f"    a   : {d['a']}")
+        if "b" in d:
+            print(f"    b   : {d['b']}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay a forensics bundle offline and bisect to "
+                    "the first diverging tx")
+    ap.add_argument("bundle", help="bundle directory "
+                                   "(bundle-<hash>/ with manifest.json)")
+    ap.add_argument("--block", type=int, default=None,
+                    help="block number to replay (default: the "
+                         "trigger block)")
+    ap.add_argument("--pair", choices=sorted(_PAIRS), default=None,
+                    help="backend pair (default: picked from the "
+                         "trigger kind)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report")
+    args = ap.parse_args(argv)
+    bundle = load_bundle(args.bundle)
+    row = bundle.entry(args.block)
+    pair = args.pair or default_pair(bundle)
+    if pair == "exec":
+        from coreth_tpu.evm.hostexec.backend import load_hostexec
+        if load_hostexec() is None:
+            print("hostexec native library unavailable; "
+                  "falling back to --pair flat", file=sys.stderr)
+            pair = "flat"
+    elif pair == "trie":
+        from coreth_tpu.mpt import native_trie
+        if not native_trie.available():
+            print("native trie unavailable; "
+                  "falling back to --pair flat", file=sys.stderr)
+            pair = "flat"
+    report = bisect(bundle, row, pair)
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        _print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
