@@ -101,10 +101,10 @@ class BlockChain:
         # drain_acceptor_queue()/close().  acceptor_tip is the last
         # block whose accept-side effects have fully landed
         # (LastAcceptedBlock vs LastConsensusAcceptedBlock).
-        self.acceptor_tip: Block = g
+        self.acceptor_tip: Block = g  # corethlint: shared single-reference publish by the acceptor thread; readers synchronize via _acceptor_queue.join() in drain_acceptor_queue()
         self._acceptor_queue: _queue.Queue = _queue.Queue()
         self._acceptor_thread: Optional[_threading.Thread] = None
-        self._acceptor_error: Optional[BaseException] = None
+        self._acceptor_error: Optional[BaseException] = None  # corethlint: shared single-reference publish by the acceptor thread; raised on the caller side only after the queue join
         self._head_subs: List[Callable[[Block], None]] = []
         self._accepted_subs: List[Callable[[Block, list], None]] = []
         self.timers = PhaseTimers()

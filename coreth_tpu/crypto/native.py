@@ -12,6 +12,12 @@ but every heap overflow / use-after-free / UB at the boundary aborts
 the process.  The ASan runtime must be preloaded for that to work —
 drive it through a subprocess with ``nativebuild.asan_env()`` (see
 tests/test_sanitize.py); the tier-1 sanitizer suite does exactly this.
+
+``CORETH_NATIVE_TSAN=1`` likewise loads the ThreadSanitizer build
+(``libcoreth_native_tsan.so``, ``make sanitize-thread``): data races
+where GIL-releasing native calls overlap across threads are reported
+instead of silently corrupting.  Drive it through a subprocess with
+``nativebuild.tsan_env()`` (see tests/test_tsan.py).
 """
 
 from __future__ import annotations
@@ -32,13 +38,16 @@ def load():
     If the rebuild fails (no C++ toolchain), the existing prebuilt .so
     still loads — callers probe per-symbol (hasattr) for ABI surfaces
     newer than the prebuilt, so features degrade one by one instead of
-    all-or-nothing.  The ``CORETH_NATIVE_SANITIZE`` selection is read
-    once, at first load (the handle is cached for the process)."""
+    all-or-nothing.  The ``CORETH_NATIVE_SANITIZE`` /
+    ``CORETH_NATIVE_TSAN`` selection is read once, at first load (the
+    handle is cached for the process)."""
     global _lib
     if _lib is not None:
         return _lib
     sanitize = os.environ.get("CORETH_NATIVE_SANITIZE", "") == "1"
-    path = nativebuild.ensure_built(sanitize=sanitize)
+    tsan = not sanitize \
+        and os.environ.get("CORETH_NATIVE_TSAN", "") == "1"
+    path = nativebuild.ensure_built(sanitize=sanitize, tsan=tsan)
     if path is None:
         return None
     lib = ctypes.CDLL(path)
@@ -91,6 +100,11 @@ def load():
     if hasattr(lib, "coreth_sanitize_smoke"):
         lib.coreth_sanitize_smoke.argtypes = [ctypes.c_int64]
         lib.coreth_sanitize_smoke.restype = ctypes.c_int
+    # test-only symbol compiled ONLY into the tsan build (`make
+    # sanitize-thread`) — proves the TSan trap actually fires
+    if hasattr(lib, "coreth_tsan_smoke"):
+        lib.coreth_tsan_smoke.argtypes = [ctypes.c_int]
+        lib.coreth_tsan_smoke.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -260,6 +274,23 @@ def sanitize_smoke(idx: int) -> int:
     overflow the sanitized build must trap (abort), which is exactly
     what tests/test_sanitize.py proves in a subprocess."""
     return _require().coreth_sanitize_smoke(idx)
+
+
+def tsan_smoke_available() -> bool:
+    """True when the loaded library carries the test-only race smoke
+    helper (i.e. it is the ``make sanitize-thread`` build)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "coreth_tsan_smoke")
+
+
+def tsan_smoke(racy: int) -> int:
+    """Drive the deliberately-racy test-only helper: two threads
+    hammer one counter, unsynchronized when ``racy`` is truthy (the
+    TSan build must report a data race — with ``halt_on_error=1``
+    the process dies with TSAN_OPTIONS' exitcode) and mutex-guarded
+    otherwise (must stay silent).  tests/test_tsan.py proves both
+    halves in subprocesses."""
+    return _require().coreth_tsan_smoke(1 if racy else 0)
 
 
 def recover_finish(rows: bytes, n: int, ok_in: bytes):

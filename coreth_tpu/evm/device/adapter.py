@@ -14,6 +14,7 @@ against the pre-states it is handed.
 from __future__ import annotations
 
 import os
+import threading as _threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -66,13 +67,17 @@ SPEC_SET_CAP = 8
 # Device dispatches issued through this module (single-shot machine
 # runs AND fused OCC windows).  The bench prints dispatches-per-block
 # from it and the OCC-equivalence tests assert the O(txs) -> O(1)
-# reduction against it.
+# reduction against it.  Mutated under _DISPATCH_MU: dispatch can move
+# off the main thread (warm-compile pool, future scale-out workers)
+# and a bare += loses increments exactly when the count matters most.
 DISPATCH_COUNT = 0
+_DISPATCH_MU = _threading.Lock()
 
 
 def _count_dispatch() -> None:
     global DISPATCH_COUNT
-    DISPATCH_COUNT += 1
+    with _DISPATCH_MU:
+        DISPATCH_COUNT += 1
     obs.instant("device/dispatch")
 
 

@@ -33,6 +33,7 @@ machine replaces for device-resident transactions.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, FrozenSet, Tuple
 
@@ -1215,12 +1216,16 @@ _OCC_MACHINES: Dict[Tuple, object] = {}
 # (MachineParams, OccParams) bucket = one jax trace + XLA compile).
 # The recompile-regression test pins this across a forced table-cap
 # growth: the pre-bucketed growth path must add ZERO builds mid-run.
+# Builds land from the main thread AND the adapter's warm-compile
+# pool, so the counter mutates under a lock.
 OCC_BUILD_COUNT = 0
+_OCC_BUILD_MU = threading.Lock()
 
 
 def count_occ_build() -> None:
     global OCC_BUILD_COUNT
-    OCC_BUILD_COUNT += 1
+    with _OCC_BUILD_MU:
+        OCC_BUILD_COUNT += 1
 
 
 def occ_compiled(params: MachineParams, occ: OccParams,

@@ -231,6 +231,7 @@ class _Tracer:
         self.SUP = ot.supported
         from coreth_tpu.evm.interpreter import analyze_jumpdests
         self.jumpdests = set(analyze_jumpdests(code))
+        # corethlint: shared _Tracer instances are trace-local — each trace() call builds its own and runs it on a single thread (main or the warm-compile worker, never both)
         self.total_steps = 0
         self.leaves: List[Tuple[object, dict]] = []
         # host-evaluated keccak requests, discovered in the SAME order

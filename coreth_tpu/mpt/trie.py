@@ -104,6 +104,7 @@ class Trie:
                  db: Optional[Dict[bytes, bytes]] = None):
         self.db = db if db is not None else {}
         if root_hash == EMPTY_ROOT:
+            # corethlint: shared Trie instances are thread-confined — concurrent users (exporter shadow tries, trie-prefetch, snapshot workers) each build their own Trie over a shared read-only node db
             self.root = None
         else:
             self.root = [HASHREF, root_hash]

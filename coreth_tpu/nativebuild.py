@@ -8,7 +8,7 @@ That split is what lets the corethlint ``[determinism]`` scope cover
 ``crypto``: build orchestration is inherently wall-clock/filesystem
 flavored and never belongs in a consensus-scoped package.
 
-Two build flavors of the same sources:
+Three build flavors of the same sources:
 
 - ``libcoreth_native.so`` — the production library (``make``).  The
   .so itself is a build artifact (gitignored, NOT in the repo); the
@@ -25,6 +25,13 @@ Two build flavors of the same sources:
   artifact and needs the matching libasan runtime preloaded —
   ``asan_env()`` below); selected by ``CORETH_NATIVE_SANITIZE=1`` in
   ``crypto.native.load()``.
+- ``libcoreth_native_tsan.so`` — the ThreadSanitizer library (``make
+  sanitize-thread``): ``-fsanitize=thread`` so data races where
+  GIL-releasing native calls overlap across threads (prefetch-thread
+  batch ECDSA against execute-thread trie folds against the flat
+  exporter's shadow tries) are *reported* instead of silently
+  corrupting.  Same preload contract via ``tsan_env()``; selected by
+  ``CORETH_NATIVE_TSAN=1`` in ``crypto.native.load()``.
 """
 
 from __future__ import annotations
@@ -37,40 +44,61 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE_DIR = os.path.join(REPO_ROOT, "native")
 LIB_NAME = "libcoreth_native.so"
 SANITIZE_LIB_NAME = "libcoreth_native_asan.so"
+TSAN_LIB_NAME = "libcoreth_native_tsan.so"
+
+# flavor -> (library file, make target, test-only sources the OTHER
+# flavors must not see as staleness triggers)
+_FLAVORS = {
+    "prod": (LIB_NAME, None),
+    "asan": (SANITIZE_LIB_NAME, "sanitize"),
+    "tsan": (TSAN_LIB_NAME, "sanitize-thread"),
+}
+
+# test-only sources compiled ONLY into their sanitizer's library; they
+# must not mark the other flavors stale (make would no-op on them)
+_FLAVOR_ONLY_SRCS = {
+    "sanitize_smoke.cc": "asan",
+    "tsan_smoke.cc": "tsan",
+}
 
 
-def lib_path(sanitize: bool = False) -> str:
+def _flavor(sanitize: bool, tsan: bool) -> str:
+    if sanitize and tsan:
+        raise ValueError("ASan and TSan builds are mutually exclusive")
+    return "asan" if sanitize else "tsan" if tsan else "prod"
+
+
+def lib_path(sanitize: bool = False, tsan: bool = False) -> str:
     return os.path.join(NATIVE_DIR,
-                        SANITIZE_LIB_NAME if sanitize else LIB_NAME)
+                        _FLAVORS[_flavor(sanitize, tsan)][0])
 
 
-def build(sanitize: bool = False, timeout: int = 180) -> bool:
+def build(sanitize: bool = False, tsan: bool = False,
+          timeout: int = 180) -> bool:
     """Run the make target; True iff the library exists afterwards."""
     cmd = ["make", "-C", NATIVE_DIR]
-    if sanitize:
-        cmd.append("sanitize")
+    target = _FLAVORS[_flavor(sanitize, tsan)][1]
+    if target:
+        cmd.append(target)
     try:
         subprocess.run(cmd, check=True, capture_output=True,
                        timeout=timeout)
     except Exception:  # noqa: BLE001 — any build failure leaves the caller's fallback path active
         return False
-    return os.path.exists(lib_path(sanitize))
+    return os.path.exists(lib_path(sanitize, tsan))
 
 
-# test-only sources compiled ONLY into the sanitized library; they
-# must not mark the production .so stale (make would no-op on them)
-_SANITIZE_ONLY_SRCS = frozenset({"sanitize_smoke.cc"})
-
-
-def stale(path: str, sanitize: bool = False) -> bool:
+def stale(path: str, sanitize: bool = False, tsan: bool = False) -> bool:
     """True when any C++ source or the Makefile is newer than the
     built library at ``path``."""
+    flavor = _flavor(sanitize, tsan)
     try:
         lib_mtime = os.path.getmtime(path)
         for fn in os.listdir(NATIVE_DIR):
             if not (fn.endswith(".cc") or fn == "Makefile"):
                 continue
-            if not sanitize and fn in _SANITIZE_ONLY_SRCS:
+            owner = _FLAVOR_ONLY_SRCS.get(fn)
+            if owner is not None and owner != flavor:
                 continue
             if os.path.getmtime(
                     os.path.join(NATIVE_DIR, fn)) > lib_mtime:
@@ -80,7 +108,8 @@ def stale(path: str, sanitize: bool = False) -> bool:
     return False
 
 
-def ensure_built(sanitize: bool = False) -> Optional[str]:
+def ensure_built(sanitize: bool = False,
+                 tsan: bool = False) -> Optional[str]:
     """The library path to load, building or rebuilding as needed.
 
     Missing library: build it (None when the build fails — no
@@ -89,11 +118,12 @@ def ensure_built(sanitize: bool = False) -> Optional[str]:
     that is the per-symbol degradation contract: a prebuilt .so keeps
     old features alive while callers probe (hasattr) for newer ABI
     surfaces."""
-    path = lib_path(sanitize)
+    path = lib_path(sanitize, tsan)
     if not os.path.exists(path):
-        return path if build(sanitize) else None
-    if stale(path, sanitize):
-        build(sanitize)  # best effort: fall back to the prebuilt on failure
+        return path if build(sanitize, tsan) else None
+    if stale(path, sanitize, tsan):
+        # best effort: fall back to the prebuilt on failure
+        build(sanitize, tsan)
     return path
 
 
@@ -114,29 +144,75 @@ def asan_runtime() -> Optional[str]:
     return _compiler_lib("libasan.so")
 
 
-def asan_env(base: Optional[dict] = None) -> Optional[dict]:
-    """Environment for a SUBPROCESS that loads the sanitized library:
-    libasan must be first in the link order (LD_PRELOAD — a plain
-    python binary is not ASan-linked), leak checking off (the Python
-    interpreter itself never frees everything at exit), and
-    ``CORETH_NATIVE_SANITIZE=1`` so the loader picks the asan build.
-    libstdc++ rides along in LD_PRELOAD: python links no C++ runtime,
-    so without it ASan's ``__cxa_throw`` interceptor never resolves
-    the real symbol and the first C++ exception thrown from ANY
-    extension module (jaxlib's MLIR iterators throw StopIteration
-    this way) hard-kills the process with an interceptor CHECK.
-    None when there is no toolchain."""
-    rt = asan_runtime()
-    if rt is None:
-        return None
-    preload = [rt]
+def tsan_runtime() -> Optional[str]:
+    """Path to the compiler's libtsan.so (to LD_PRELOAD), or None."""
+    return _compiler_lib("libtsan.so")
+
+
+def _preload_env(runtime: str, base: Optional[dict]) -> dict:
+    """LD_PRELOAD the sanitizer runtime + libstdc++ ahead of anything
+    the caller already preloads.  libstdc++ rides along because python
+    links no C++ runtime: without it the sanitizer's ``__cxa_throw``
+    interceptor never resolves the real symbol and the first C++
+    exception thrown from ANY extension module (jaxlib's MLIR
+    iterators throw StopIteration this way) hard-kills the process
+    with an interceptor CHECK."""
+    preload = [runtime]
     stdcpp = _compiler_lib("libstdc++.so")
     if stdcpp:
         preload.append(stdcpp)
     env = dict(os.environ if base is None else base)
     env["LD_PRELOAD"] = " ".join(
         preload + ([env["LD_PRELOAD"]] if env.get("LD_PRELOAD") else []))
+    return env
+
+
+def asan_env(base: Optional[dict] = None) -> Optional[dict]:
+    """Environment for a SUBPROCESS that loads the ASan library:
+    libasan must be first in the link order (LD_PRELOAD — a plain
+    python binary is not ASan-linked), leak checking off (the Python
+    interpreter itself never frees everything at exit), and
+    ``CORETH_NATIVE_SANITIZE=1`` so the loader picks the asan build.
+    None when there is no toolchain."""
+    rt = asan_runtime()
+    if rt is None:
+        return None
+    env = _preload_env(rt, base)
     env["ASAN_OPTIONS"] = ("detect_leaks=0:abort_on_error=0:"
                            + env.get("ASAN_OPTIONS", ""))
     env["CORETH_NATIVE_SANITIZE"] = "1"
+    return env
+
+
+def tsan_env(base: Optional[dict] = None) -> Optional[dict]:
+    """Environment for a SUBPROCESS that loads the TSan library:
+    libtsan LD_PRELOADed (same reasoning as ``asan_env``),
+    ``halt_on_error=1:exitcode=66`` so the first detected race kills
+    the process with an unmistakable exit status (66 cannot be
+    confused with a python exception's 1 or a signal death),
+    ``die_after_fork=0`` so jax/xla process pools that fork without
+    exec keep running, and ``CORETH_NATIVE_TSAN=1`` so the loader
+    picks the tsan build.  ``native/tsan.supp`` rides along as the
+    suppressions file: jaxlib's ``xla_extension.so`` is not
+    instrumented, so its JIT thread pool's cross-thread allocations
+    look like races to the interceptors (``called_from_lib`` drops
+    exactly those — our instrumented library still reports for real).
+    None when there is no toolchain."""
+    rt = tsan_runtime()
+    if rt is None:
+        return None
+    env = _preload_env(rt, base)
+    supp = os.path.join(NATIVE_DIR, "tsan.supp")
+    # report_mutex_bugs=0 / detect_deadlocks=0: mutex-misuse checking
+    # and lock-order prediction (NOT race detection) trip on mutexes
+    # that live inside uninstrumented runtime code — Eigen's
+    # thread-pool condvars look destroyed-while-waited and libgcc's
+    # unwinder frame registration inverts against XLA internals from
+    # the interceptors' limited view; data-race reports are unaffected
+    env["TSAN_OPTIONS"] = (f"halt_on_error=1:exitcode=66:"
+                           f"die_after_fork=0:report_mutex_bugs=0:"
+                           f"detect_deadlocks=0:"
+                           f"suppressions={supp}:"
+                           + env.get("TSAN_OPTIONS", ""))
+    env["CORETH_NATIVE_TSAN"] = "1"
     return env

@@ -367,14 +367,17 @@ class FlightRecorder:
                 # but the trigger still SURFACES (a second run hitting
                 # the same poison block must report its bundle path,
                 # not "no evidence")
-                self.bundle_dedup += 1
-                self.bundles.append({"path": final,
-                                     "number": trig.get("number"),
-                                     "kind": trig["kind"]})
+                with self._lock:
+                    self.bundle_dedup += 1
+                    self.bundles.append({"path": final,
+                                         "number": trig.get("number"),
+                                         "kind": trig["kind"]})
                 return final
-            self._seq += 1
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
             tmp = os.path.join(self.dir,
-                               f".tmp-{os.getpid()}-{self._seq}")
+                               f".tmp-{os.getpid()}-{seq}")
             os.makedirs(os.path.join(tmp, "blobs"))
             for name, data in blobs.items():
                 with open(os.path.join(tmp, "blobs", name), "wb") as f:
@@ -384,11 +387,12 @@ class FlightRecorder:
                 f.write(body)
             os.replace(tmp, final)   # the atomic publish
             tmp = None
-            self.bundle_writes += 1
-            self.write_ms += (time.monotonic() - t0) * 1000.0
-            self.bundles.append({"path": final,
-                                 "number": trig.get("number"),
-                                 "kind": trig["kind"]})
+            with self._lock:
+                self.bundle_writes += 1
+                self.write_ms += (time.monotonic() - t0) * 1000.0
+                self.bundles.append({"path": final,
+                                     "number": trig.get("number"),
+                                     "kind": trig["kind"]})
             _trace.instant("forensics/bundle", path=final,
                            kind=trig["kind"])
             return final
@@ -399,8 +403,9 @@ class FlightRecorder:
             # means a failure here leaves no partial directory
             if tmp is not None:
                 shutil.rmtree(tmp, ignore_errors=True)
-            self.bundle_failures += 1
-            self.last_error = repr(exc)
+            with self._lock:
+                self.bundle_failures += 1
+                self.last_error = repr(exc)
             return None
 
     last_error: Optional[str] = None

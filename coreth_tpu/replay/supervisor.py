@@ -31,6 +31,7 @@ Counters mirror into the metrics registry under ``supervisor/*``.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -88,7 +89,12 @@ class BackendSupervisor:
                 "cooldown": None, "seq": 0}
             for s in self.SCOPES
         }
-        # counters (plain ints; publish() mirrors to the registry)
+        # counters (plain ints; publish() mirrors to the registry).
+        # strike/ok/quarantine accounting holds _mu: today's callers
+        # strike from the execute path, but the telemetry handler reads
+        # snapshot() mid-run and the scale-out direction adds striking
+        # workers — bare += here loses demotions exactly under load
+        self._mu = threading.Lock()
         self.retries = 0
         self.demotions = 0
         self.promotions = 0
@@ -130,57 +136,62 @@ class BackendSupervisor:
         """A supervised call in ``scope`` succeeded: reset strikes; a
         success after the cooldown lapsed is a successful probe and
         re-promotes the scope (cooldown resets too)."""
-        st = self._state[scope]
-        st["strikes"] = 0
-        self._first_strike_t[scope] = None
-        if st["demoted"] and self._clock() >= st["until"]:
-            st["demoted"] = False
-            st["cooldown"] = None
-            self.promotions += 1
-            self._transition("promote", scope)
+        with self._mu:
+            st = self._state[scope]
+            st["strikes"] = 0
+            self._first_strike_t[scope] = None
+            if st["demoted"] and self._clock() >= st["until"]:
+                st["demoted"] = False
+                st["cooldown"] = None
+                self.promotions += 1
+                self._transition("promote", scope)
 
     def strike(self, scope: str, exc: BaseException,
                hard: bool = False) -> None:
         """A supervised call failed past retries.  ``hard`` demotes
         immediately (oracle divergence — the backend is *wrong*)."""
         now = self._clock()
-        st = self._state[scope]
-        self.strikes += 1
-        st["seq"] += 1
-        if self._first_strike_t[scope] is None:
-            self._first_strike_t[scope] = now
-        if st["demoted"]:
-            if now >= st["until"]:
-                # failed probe: re-demote, back off harder
-                st["cooldown"] = min(
-                    (st["cooldown"] or self.cooldown) * 2,
-                    self.cooldown * self.COOLDOWN_CAP)
-                st["until"] = now + st["cooldown"]
+        with self._mu:
+            st = self._state[scope]
+            self.strikes += 1
+            st["seq"] += 1
+            if self._first_strike_t[scope] is None:
+                self._first_strike_t[scope] = now
+            if st["demoted"]:
+                if now >= st["until"]:
+                    # failed probe: re-demote, back off harder
+                    st["cooldown"] = min(
+                        (st["cooldown"] or self.cooldown) * 2,
+                        self.cooldown * self.COOLDOWN_CAP)
+                    st["until"] = now + st["cooldown"]
+                    self.demotions += 1
+                    self._transition("probe_failed", scope)
+                return
+            st["strikes"] += 1
+            demote = hard or st["strikes"] >= self.strikes_to_demote
+            if demote:
+                st["demoted"] = True
+                st["until"] = now + (st["cooldown"] or self.cooldown)
                 self.demotions += 1
-                self._transition("probe_failed", scope)
-            return
-        st["strikes"] += 1
+                self._transition("demote", scope)
+                first = self._first_strike_t[scope]
+                if first is not None:
+                    self.demote_latency_s[scope] = round(now - first, 4)
         if hard:
             # a hard demotion means a backend was WRONG (an armed
             # oracle disagreed), not slow — bundle the evidence; the
             # seam that struck usually noted a richer trigger moments
             # earlier, and the pending triggers freeze together when
-            # the block's host-path witness lands
+            # the block's host-path witness lands.  Outside _mu: the
+            # recorder takes its own lock and may write bundles
             from coreth_tpu.obs import recorder as _forensics
             _forensics.note_trigger(
                 _forensics.TR_DEMOTE,
                 f"hard demote of scope {scope!r}: {exc!r}")
-        if hard or st["strikes"] >= self.strikes_to_demote:
-            st["demoted"] = True
-            st["until"] = now + (st["cooldown"] or self.cooldown)
-            self.demotions += 1
-            self._transition("demote", scope)
-            first = self._first_strike_t[scope]
-            if first is not None:
-                self.demote_latency_s[scope] = round(now - first, 4)
 
     def note_quarantined(self) -> None:
-        self.quarantined += 1
+        with self._mu:
+            self.quarantined += 1
 
     # --------------------------------------------------------- supervision
     def run(self, scope: str, point: Optional[str], fn, *args):
@@ -218,7 +229,8 @@ class BackendSupervisor:
             except faults.FaultInjected as exc:
                 if exc.transient and attempt < self.max_retries:
                     attempt += 1
-                    self.retries += 1
+                    with self._mu:
+                        self.retries += 1
                     self._sleep(delay)
                     delay *= 2
                     continue
@@ -227,7 +239,8 @@ class BackendSupervisor:
             except Exception as exc:  # noqa: BLE001 — a real backend failure IS the supervised case: strike + route down the ladder; correctness is re-proven on the fallback path
                 if attempt < self.max_retries:
                     attempt += 1
-                    self.retries += 1
+                    with self._mu:
+                        self.retries += 1
                     self._sleep(delay)
                     delay *= 2
                     continue
@@ -257,7 +270,8 @@ class BackendSupervisor:
             except faults.FaultInjected as exc:
                 if exc.transient and attempt < self.max_retries:
                     attempt += 1
-                    self.retries += 1
+                    with self._mu:
+                        self.retries += 1
                     self._sleep(delay)
                     delay *= 2
                     continue
@@ -266,17 +280,19 @@ class BackendSupervisor:
 
     # ------------------------------------------------------------ reporting
     def snapshot(self) -> dict:
-        return {
-            "retries": self.retries,
-            "strikes": self.strikes,
-            "demotions": self.demotions,
-            "promotions": self.promotions,
-            "quarantined": self.quarantined,
-            "demoted_scopes": sorted(
-                s for s in self.SCOPES if self._state[s]["demoted"]),
-            "demote_latency_s": dict(self.demote_latency_s),
-            "last_transition": self.last_transition,
-        }
+        with self._mu:
+            return {
+                "retries": self.retries,
+                "strikes": self.strikes,
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "quarantined": self.quarantined,
+                "demoted_scopes": sorted(
+                    s for s in self.SCOPES
+                    if self._state[s]["demoted"]),
+                "demote_latency_s": dict(self.demote_latency_s),
+                "last_transition": self.last_transition,
+            }
 
     def publish(self, registry=None) -> None:
         """Mirror the counters into the metrics registry (scrapeable

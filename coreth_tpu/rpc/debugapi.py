@@ -156,6 +156,7 @@ class ContinuousProfiler:
         self.sample_interval = sample_interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # corethlint: shared single-writer counter — only the profiler thread increments it; other threads read it for monitoring and tolerate a stale value
         self.dumps = 0
         os.makedirs(directory, exist_ok=True)
 

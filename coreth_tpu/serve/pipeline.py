@@ -184,6 +184,10 @@ class StreamingPipeline:
         self._latency = Histogram(window=4096)
         self._tx_meter = Meter()
         self._registry = registry
+        # progress-stat lock: feed/prefetch threads and the commit path
+        # all mutate the inflight accounting, and the live telemetry
+        # report reads it mid-run
+        self._mu = threading.Lock()
         self._enqueued = 0
         self._committed_blocks = 0
         self._max_inflight = 0
@@ -252,23 +256,26 @@ class StreamingPipeline:
                 # folding into THIS run's stage sink (one-None-check
                 # no-op when tracing is off)
                 if obs.enabled():
-                    if self._stages is None:
-                        self._stages = obs.StageAccumulator()
+                    with self._mu:
+                        if self._stages is None:
+                            self._stages = obs.StageAccumulator()
                     it.bt = obs.block_begin(b.number, it.t_enqueue,
                                             sink=self._stages)
-                if self._t_first_enqueue is None:
-                    self._t_first_enqueue = it.t_enqueue
+                with self._mu:
+                    if self._t_first_enqueue is None:
+                        self._t_first_enqueue = it.t_enqueue
                 # the bounded put IS the backpressure: when the
                 # pipeline is behind, the feed parks here and the
                 # source (paced chain / mempool builder) stops draining
                 blocked = self._put(self._q_feed, it)
                 if blocked < 0:
                     break
-                self._feed_blocked_s += blocked
-                self._enqueued += 1
-                inflight = self._enqueued - self._committed_blocks
-                if inflight > self._max_inflight:
-                    self._max_inflight = inflight
+                with self._mu:
+                    self._feed_blocked_s += blocked
+                    self._enqueued += 1
+                    inflight = self._enqueued - self._committed_blocks
+                    if inflight > self._max_inflight:
+                        self._max_inflight = inflight
         except BaseException as exc:  # noqa: BLE001 — surfaced by run()
             self._errors.append(exc)
             self._stop.set()
@@ -306,7 +313,8 @@ class StreamingPipeline:
                     blocked = self._put(self._q_exec, c)
                     if blocked < 0:
                         return
-                    self._prefetch_blocked_s += blocked
+                    with self._mu:
+                        self._prefetch_blocked_s += blocked
         except BaseException as exc:  # noqa: BLE001 — surfaced by run()
             self._errors.append(exc)
             self._stop.set()
@@ -353,7 +361,8 @@ class StreamingPipeline:
             # tests; a no-op lookup otherwise)
             faults.fire(PT_CRASH)
         self.stats.blocks += len(items)
-        self._committed_blocks += len(items)
+        with self._mu:
+            self._committed_blocks += len(items)
         if items:
             self._t_last_commit = now
             # any clean commit breaks a quarantine streak — the limit
@@ -620,7 +629,7 @@ class StreamingPipeline:
         self._publish(wall)
         return self.stats
 
-    def _live_report(self) -> dict:
+    def _live_report(self) -> dict:  # corethlint: thread telemetry-report — called by the TelemetryServer handler thread while the stream runs
         """The /report payload while the stream runs: the report row
         with the CURRENT latency histogram and stage attribution
         spliced in (the final _publish numbers are richer; this is the
@@ -663,7 +672,8 @@ class StreamingPipeline:
         entry = self.stats.quarantined.pop()
         self.stats.blocks -= 1
         self.stats.txs -= len(blk.transactions)
-        self._committed_blocks -= 1
+        with self._mu:
+            self._committed_blocks -= 1
         # the replacement block re-enters at the popped number
         self._expect_number = blk.number
         return entry

@@ -31,6 +31,7 @@ streaming bench is "prefetch-hit or overlap counter > 0"):
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import List
 
@@ -43,6 +44,9 @@ class Prefetcher:
 
     def __init__(self, engine):
         self.e = engine
+        # counters land from the prefetch stage thread while the
+        # pipeline report reads them — writes hold _mu
+        self._mu = threading.Lock()
         self.sigs = 0
         self.shard_sigs = 0   # recovered via the mesh-sharded ladder
         self.code_touches = 0
@@ -56,9 +60,12 @@ class Prefetcher:
             if todo:
                 if not self._shard_recover(blocks):
                     self.e.warm_senders(blocks)
-                self.sigs += todo
+                with self._mu:
+                    self.sigs += todo
             self._touch_code(blocks)
-        self.busy_s += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        with self._mu:
+            self.busy_s += dt
 
     def _shard_recover(self, blocks: List[Block]) -> bool:
         """CORETH_SHARD_RECOVER=1 + a dp mesh: recover this chunk's
@@ -89,7 +96,8 @@ class Prefetcher:
             if out is None:
                 return False
             e._apply_recovered(todo, out, ok)
-            self.shard_sigs += len(todo)
+            with self._mu:
+                self.shard_sigs += len(todo)
             return True
         except Exception:  # noqa: BLE001 — advisory: host path recovers
             return False
@@ -114,6 +122,7 @@ class Prefetcher:
                     continue
                 try:
                     e.db.contract_code(state.code_hashes[idx])
-                    self.code_touches += 1
+                    with self._mu:
+                        self.code_touches += 1
                 except Exception:  # noqa: BLE001 — prefetch is advisory
                     pass

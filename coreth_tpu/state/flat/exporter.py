@@ -107,6 +107,9 @@ class FlatExporter:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # ---- counters (bench flat_state: export cost vs stamp cost)
+        # mutated by the export worker, read by snapshot()/drain() on
+        # the caller's thread — every write holds _mu
+        self._mu = threading.Lock()
         self.exports = 0
         self.records = 0
         self.stale_skips = 0
@@ -155,15 +158,19 @@ class FlatExporter:
                 # a stale handout (the flat/stale_generation shape):
                 # double-applying its diffs would corrupt the shadow
                 # tries — detect by flag and skip
-                self.stale_skips += 1
+                with self._mu:
+                    self.stale_skips += 1
                 continue
             t0 = time.monotonic_ns()  # noqa: DET003 — export-cost instrumentation, host-side only
             try:
                 self._export(gen)
             except BaseException as exc:  # noqa: BLE001 — a wedged exporter must not kill the stream; drain()/stamp surfaces the error
-                self.error = exc
+                with self._mu:
+                    self.error = exc
             finally:
-                self.export_ns += time.monotonic_ns() - t0  # noqa: DET003 — export-cost instrumentation, host-side only
+                dt = time.monotonic_ns() - t0  # noqa: DET003 — export-cost instrumentation, host-side only
+                with self._mu:
+                    self.export_ns += dt
 
     # ------------------------------------------------------------- export
     def _open_shadow(self, root: bytes):
@@ -241,8 +248,9 @@ class FlatExporter:
     def _durable(self, gen: FlatGeneration) -> None:
         """The write-ordered durability step (retryable: every write
         is an idempotent put)."""
-        self.entries_written += self.flat.write_gen_entries(
-            self.kv, gen)
+        written = self.flat.write_gen_entries(self.kv, gen)
+        with self._mu:
+            self.entries_written += written
         faults.fire(PT_TORN)
         if gen.checkpoint:
             # nodes first — the record-implies-closure invariant
@@ -259,7 +267,8 @@ class FlatExporter:
                 self.kv, gen.number, gen.block_hash, gen.root,
                 gen.header.encode())
             self.kv.flush()
-            self.records += 1
+            with self._mu:
+                self.records += 1
             if self.on_record is not None:
                 self.on_record(gen)
 
@@ -278,7 +287,8 @@ class FlatExporter:
                         raise
                     continue
             self.flat.mark_exported(gen)
-            self.exports += 1
+            with self._mu:
+                self.exports += 1
 
     # ------------------------------------------------------------ report
     def snapshot(self) -> dict:

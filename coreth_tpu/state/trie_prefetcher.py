@@ -40,6 +40,7 @@ class TriePrefetcher:
             queue.Queue()
         self._seen: set = set()
         self._tries: Dict[bytes, Trie] = {}
+        # corethlint: shared single-writer counter — only the warm worker increments it; drain() joins the queue before the caller reads it
         self.loaded = 0
         self.duped = 0
         # exactly one worker: Trie instances mutate while resolving,

@@ -1,0 +1,39 @@
+// Test-only race helper, compiled ONLY into the TSan build
+// (libcoreth_native_tsan.so).  tests/test_tsan.py calls it to prove
+// the detector is actually armed before trusting a clean suite run:
+// racy=1 hammers a plain int from two threads with no synchronization
+// (a certain data race — TSan must report it), racy=0 does the same
+// work under a mutex (must stay silent).  Returns the final counter
+// so the compiler cannot elide the writes.
+
+#include <mutex>
+#include <thread>
+
+namespace {
+
+int g_counter = 0;           // NOLINT: the race IS the point
+std::mutex g_mu;
+
+void bump_racy(int n) {
+    for (int i = 0; i < n; ++i) g_counter++;
+}
+
+void bump_locked(int n) {
+    for (int i = 0; i < n; ++i) {
+        std::lock_guard<std::mutex> hold(g_mu);
+        g_counter++;
+    }
+}
+
+}  // namespace
+
+extern "C" int coreth_tsan_smoke(int racy) {
+    g_counter = 0;
+    void (*fn)(int) = racy ? bump_racy : bump_locked;
+    std::thread a(fn, 50000);
+    std::thread b(fn, 50000);
+    a.join();
+    b.join();
+    std::lock_guard<std::mutex> hold(g_mu);
+    return g_counter;
+}

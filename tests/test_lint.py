@@ -2,9 +2,11 @@
 
 The gate test keeps the tree permanently clean: layer boundaries,
 determinism in consensus packages, jit purity, rationalized broad
-excepts, and native-ABI conformance (run_all includes the nativeabi
-pass; its own fixtures live in tests/test_nativeabi.py).  Pure static
-analysis — no jax, no device, no network.
+excepts, native-ABI conformance, thread discipline, and the env-knob
+census (run_all includes the nativeabi/threadsafety/envknobs passes;
+their own fixtures live in tests/test_nativeabi.py and
+tests/test_threadsafety.py).  Pure static analysis — no jax, no
+device, no network.
 """
 
 import os

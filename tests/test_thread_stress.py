@@ -1,0 +1,200 @@
+"""Barrier-synchronized concurrency stress for the shared-state seams.
+
+The threadsafety lint pass proves every shared counter sits behind a
+lock (or a justified discipline) *statically*; these tests prove the
+locks actually deliver — N threads released through one
+``threading.Barrier`` hammer each seam and the final counts must be
+exact.  Lost updates under a bare ``+=`` are probabilistic, so every
+hammer uses enough iterations that the pre-fix code failed reliably.
+
+Covered seams (each one a real multi-thread touchpoint in the tree):
+- the metrics registry's ``get_or_register`` + ``Counter.inc`` (every
+  pipeline thread publishes through it),
+- ``SpanTracer.export()`` scraped by the telemetry thread WHILE
+  pipeline threads record (the thread-name map prune races the insert
+  without the lock),
+- ``BackendSupervisor`` strikes from concurrent workers with
+  ``snapshot()`` readers interleaved (the scale-out direction),
+- the device dispatch / OCC-build module counters
+  (``DISPATCH_COUNT`` and ``OCC_BUILD_COUNT`` — the bench and the
+  recompile-regression tests read them as exact values).
+"""
+
+import threading
+
+from coreth_tpu.metrics.registry import Counter, Registry
+from coreth_tpu.obs.trace import SpanTracer
+from coreth_tpu.replay.supervisor import BackendSupervisor
+
+THREADS = 8
+ROUNDS = 2000
+
+
+def _hammer(n_threads, body):
+    """Run ``body(i)`` on n_threads threads released together; re-raise
+    the first worker exception on the caller."""
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def run(i):
+        try:
+            barrier.wait(timeout=30)
+            body(i)
+        except BaseException as exc:  # noqa: BLE001 — workers forward everything to the caller's assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "worker hung"
+    if errors:
+        raise errors[0]
+
+
+# ------------------------------------------------------- metrics registry
+
+def test_counter_inc_is_exact_under_contention():
+    c = Counter()
+    _hammer(THREADS, lambda i: [c.inc() for _ in range(ROUNDS)])
+    assert c.value == THREADS * ROUNDS
+
+
+def test_get_or_register_returns_one_instance():
+    """Concurrent get_or_register on one name must agree on a single
+    instrument — two racing factories would each count half the
+    traffic and both halves would be wrong."""
+    reg = Registry()
+    seen = [None] * THREADS
+
+    def body(i):
+        c = reg.get_or_register("stress/c", Counter)
+        seen[i] = c
+        for _ in range(ROUNDS):
+            c.inc()
+
+    _hammer(THREADS, body)
+    assert len({id(c) for c in seen}) == 1
+    assert reg.get("stress/c").value == THREADS * ROUNDS
+
+
+def test_registry_snapshot_during_registration():
+    """snapshot() while other threads register fresh names: the dict
+    iteration must never see a mid-insert view (RuntimeError) and the
+    final census must be complete."""
+    reg = Registry()
+
+    def body(i):
+        if i == 0:
+            for _ in range(ROUNDS // 4):
+                reg.snapshot()
+            return
+        for k in range(ROUNDS // 4):
+            reg.get_or_register(f"stress/{i}/{k}", Counter).inc()
+
+    _hammer(THREADS, body)
+    snap = reg.snapshot()
+    assert len(snap) == (THREADS - 1) * (ROUNDS // 4)
+    assert all(v["count"] == 1 for v in snap.values())
+
+
+# ----------------------------------------------------- obs ring vs scrape
+
+def test_tracer_export_while_recording():
+    """The /trace scrape path: export() prunes the thread-name map
+    under the lock while recorder threads insert into it — interleaved
+    at full speed the export must always return a well-formed document
+    and the ring must hold only intact events."""
+    tr = SpanTracer(ring=512)
+    docs = []
+
+    def body(i):
+        if i == 0:
+            for _ in range(ROUNDS // 4):
+                docs.append(tr.export())
+            return
+        for k in range(ROUNDS // 4):
+            tr.instant(f"stress/{i}", k=k)
+
+    _hammer(THREADS, body)
+    assert docs and all("traceEvents" in d for d in docs)
+    final = tr.export()["traceEvents"]
+    recorders = THREADS - 1
+    events = [e for e in final if e.get("cat") != "__metadata"]
+    assert len(events) == 512  # ring stayed bounded
+    assert all(e["ph"] == "i" for e in events)
+    # the prune contract: exactly one name row per tid with surviving
+    # events (a fast recorder can evict a slow one's events entirely)
+    names = [e for e in final if e.get("cat") == "__metadata"]
+    assert {n["tid"] for n in names} == {e["tid"] for e in events}
+    assert tr.dropped == recorders * (ROUNDS // 4) - 512
+
+
+# -------------------------------------------------- supervisor scale-out
+
+def test_supervisor_strikes_are_exact_under_contention():
+    """N striking workers + interleaved snapshot() readers: the strike
+    count must be exact (a lost strike is a lost demotion under load)
+    and every snapshot must be internally consistent."""
+    sup = BackendSupervisor(clock=lambda: 0.0)
+    exc = RuntimeError("boom")
+    snaps = []
+
+    def body(i):
+        if i == 0:
+            for _ in range(ROUNDS // 4):
+                snaps.append(sup.snapshot())
+            return
+        for _ in range(ROUNDS // 4):
+            sup.strike("device", exc)
+
+    _hammer(THREADS, body)
+    strikers = THREADS - 1
+    assert sup.strikes == strikers * (ROUNDS // 4)
+    # frozen clock: the cooldown never lapses, so exactly one demotion
+    assert sup.demotions == 1
+    assert sup.snapshot()["demoted_scopes"] == ["device"]
+    assert all(s["strikes"] <= sup.strikes for s in snaps)
+
+
+def test_supervisor_note_ok_races_strikes():
+    """ok/strike from different workers on one scope: totals must add
+    up even though the per-scope strike ladder resets concurrently."""
+    sup = BackendSupervisor(clock=lambda: 0.0)
+    exc = RuntimeError("boom")
+
+    def body(i):
+        for _ in range(ROUNDS // 4):
+            if i % 2:
+                sup.strike("native", exc)
+            else:
+                sup.note_ok("native")
+
+    _hammer(THREADS, body)
+    assert sup.strikes == (THREADS // 2) * (ROUNDS // 4)
+
+
+# ------------------------------------------- device module counters
+
+def test_dispatch_count_is_exact_under_contention():
+    """Satellite regression for the bare ``DISPATCH_COUNT += 1`` this
+    PR put behind ``_DISPATCH_MU``: the OCC-equivalence tests assert
+    exact dispatch counts, so a single lost increment is a failure."""
+    from coreth_tpu.evm.device import adapter
+
+    before = adapter.DISPATCH_COUNT
+    _hammer(THREADS,
+            lambda i: [adapter._count_dispatch() for _ in range(ROUNDS)])
+    assert adapter.DISPATCH_COUNT - before == THREADS * ROUNDS
+
+
+def test_occ_build_count_is_exact_under_contention():
+    """Same regression for the warm-compile pool's build counter."""
+    from coreth_tpu.evm.device import machine
+
+    before = machine.OCC_BUILD_COUNT
+    _hammer(THREADS,
+            lambda i: [machine.count_occ_build() for _ in range(ROUNDS)])
+    assert machine.OCC_BUILD_COUNT - before == THREADS * ROUNDS

@@ -1,0 +1,604 @@
+"""Fixtures for the threadsafety (THR) and envknobs (CFG) lint passes.
+
+The tree gate in tests/test_lint.py proves the real tree is clean;
+these unit fixtures prove each code actually FIRES on the bug shape it
+names and stays silent on every blessed discipline (lock, queue
+handoff, arm-once, thread-confined construction, markers).  Pure
+static analysis — no threads actually run here.
+"""
+
+import textwrap
+
+from tools.lint.core import Source
+from tools.lint.envknobs import (
+    build_table, check_envknobs, collect_reads, parse_table, write_table,
+)
+from tools.lint.threadsafety import check_threadsafety
+
+
+def src(snippet: str, path: str = "coreth_tpu/mpt/x.py") -> Source:
+    return Source(path, textwrap.dedent(snippet))
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ----------------------------------------------------- THR001: globals
+
+def test_thr001_unguarded_global_from_spawned_thread():
+    s = src("""\
+        import threading
+
+        COUNT = 0
+
+        def worker():
+            global COUNT
+            COUNT += 1
+
+        def start():
+            t = threading.Thread(target=worker)
+            t.start()
+            return COUNT
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR001"]
+    assert found[0].line == 7
+    assert found[0].detail == "global:coreth_tpu.mpt.x.COUNT"
+
+
+def test_thr001_silent_without_a_second_context():
+    """No spawn site, no handler, no declared entry — a module counter
+    only main touches is nobody's business."""
+    s = src("""\
+        COUNT = 0
+
+        def bump():
+            global COUNT
+            COUNT += 1
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_thr001_module_lock_is_a_discipline():
+    s = src("""\
+        import threading
+
+        _MU = threading.Lock()
+        COUNT = 0
+
+        def worker():
+            global COUNT
+            with _MU:
+                COUNT += 1
+
+        def start():
+            threading.Thread(target=worker).start()
+            return COUNT
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_thr001_arm_once_if_none_shape_is_blessed():
+    s = src("""\
+        import threading
+
+        _CACHE = None
+
+        def load():
+            global _CACHE
+            if _CACHE is None:
+                _CACHE = object()
+            return _CACHE
+
+        def start():
+            threading.Thread(target=load).start()
+            return _CACHE
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_thr001_arm_once_early_return_shape_is_blessed():
+    s = src("""\
+        import threading
+
+        _CACHE = None
+
+        def load():
+            global _CACHE
+            if _CACHE is not None:
+                return _CACHE
+            _CACHE = object()
+            return _CACHE
+
+        def start():
+            threading.Thread(target=load).start()
+            return _CACHE
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_thr001_handler_class_methods_are_entries():
+    s = src("""\
+        import http.server
+
+        COUNT = 0
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                global COUNT
+                COUNT += 1
+
+        def total():
+            return COUNT
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR001"]
+    assert found[0].line == 8
+
+
+# --------------------------------------------------- THR002: attributes
+
+def test_thr002_unguarded_attr_from_spawned_thread():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.count = 0
+
+            def bump(self):
+                self.count += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+
+            def total(self):
+                return self.count
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR002"]
+    assert found[0].line == 8
+    assert found[0].detail == "attr:coreth_tpu.mpt.x::Box.count"
+
+
+def test_thr002_executor_submit_is_a_spawn():
+    s = src("""\
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Pool:
+            def __init__(self):
+                self.done = 0
+                self.pool = ThreadPoolExecutor(2)
+
+            def work(self):
+                self.done += 1
+
+            def kick(self):
+                self.pool.submit(self.work)
+
+            def stats(self):
+                return self.done
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR002"]
+    assert found[0].line == 9
+
+
+def test_thr002_declared_thread_marker_registers_a_context():
+    """No literal spawn anywhere — the def-line marker alone must make
+    report() a second context (the telemetry-callback escape hatch)."""
+    s = src("""\
+        class Box:
+            def __init__(self):
+                self.n = 0
+
+            def report(self):  # corethlint: thread runs on the server thread
+                self.n += 1
+
+            def total(self):
+                return self.n
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR002"]
+    assert found[0].line == 6
+
+
+def test_thr002_init_writes_are_under_construction():
+    """__init__ publishes last; its plain stores never flag even when
+    other methods run on spawned threads."""
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.count = 0
+                self.name = "box"
+
+            def read(self):
+                return self.count + len(self.name)
+
+            def spawn(self):
+                threading.Thread(target=self.read).start()
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_thr002_thread_confined_construction_is_exempt():
+    """A Box built inside the function is private until published —
+    only the genuinely shared write site flags."""
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+
+        def local_use():
+            b = Box()
+            b.n += 5
+            return b.n
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR002"]
+    assert found[0].line == 8  # bump, not local_use
+
+
+def test_thr002_queue_handoff_is_out_of_scope():
+    """Mutation via method calls (q.put) is the blessed handoff — the
+    queue locks itself."""
+    s = src("""\
+        import queue
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self.q = queue.Queue()
+
+            def feed(self):
+                self.q.put(1)
+
+            def spawn(self):
+                threading.Thread(target=self.feed).start()
+
+            def drain(self):
+                return self.q.get()
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_thr002_instance_lock_is_a_discipline():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._mu:
+                    self.count += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+
+            def total(self):
+                return self.count
+        """)
+    assert check_threadsafety([s]) == []
+
+
+# ------------------------------------------------------------- markers
+
+def test_shared_marker_on_def_site_exempts_the_variable():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.tip = None  # corethlint: shared single-reference publish; readers join the queue first
+
+            def advance(self):
+                self.tip = object()
+
+            def spawn(self):
+                threading.Thread(target=self.advance).start()
+
+            def read(self):
+                return self.tip
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_shared_marker_comment_above_def_site_counts():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                # corethlint: shared instances are thread-confined by construction
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+
+            def read(self):
+                return self.n
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_shared_marker_on_write_site_exempts_that_site_only():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1  # corethlint: shared monotone hint; readers tolerate staleness
+
+            def sloppy(self):
+                self.n += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+                threading.Thread(target=self.sloppy).start()
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR002"]
+    assert found[0].line == 11  # only the unmarked site
+
+
+def test_shared_marker_without_rationale_does_not_count():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.n = 0  # corethlint: shared
+
+            def bump(self):
+                self.n += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+
+            def read(self):
+                return self.n
+        """)
+    assert codes(check_threadsafety([s])) == ["THR002"]
+
+
+# --------------------------------------------- THR003/THR004: lock holes
+
+def test_thr003_bare_site_when_guarded_elsewhere():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._mu:
+                    self.count += 1
+
+            def sloppy(self):
+                self.count += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+                threading.Thread(target=self.sloppy).start()
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR003"]
+    assert found[0].line == 13
+    assert "self._mu" in found[0].message
+
+
+def test_thr004_mixed_locks_on_one_variable():
+    s = src("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._aux_lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._mu:
+                    self.count += 1
+
+            def other(self):
+                with self._aux_lock:
+                    self.count += 1
+
+            def spawn(self):
+                threading.Thread(target=self.bump).start()
+                threading.Thread(target=self.other).start()
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR004"]
+    assert "self._aux_lock" in found[0].message
+    assert "self._mu" in found[0].message
+
+
+# ------------------------------------------------ THR005: opaque spawns
+
+def test_thr005_unresolvable_spawn_target():
+    s = src("""\
+        import threading
+
+        def launch(fn):
+            threading.Thread(target=fn).start()
+        """)
+    found = check_threadsafety([s])
+    assert codes(found) == ["THR005"]
+    assert found[0].line == 4
+    assert "corethlint: thread" in found[0].message
+
+
+def test_thr005_thread_marker_on_spawn_line_suppresses():
+    s = src("""\
+        import threading
+
+        def launch(fn):
+            threading.Thread(target=fn).start()  # corethlint: thread caller-chosen worker body
+        """)
+    assert check_threadsafety([s]) == []
+
+
+def test_spawn_target_through_import_alias_resolves():
+    """`import threading as _threading` (the adapter idiom) must still
+    register the spawn — no THR005, and the worker is a context."""
+    s = src("""\
+        import threading as _threading
+
+        N = 0
+
+        def worker():
+            global N
+            N += 1
+
+        def start():
+            _threading.Thread(target=worker).start()
+            return N
+        """)
+    assert codes(check_threadsafety([s])) == ["THR001"]
+
+
+# --------------------------------------------------- CFG: env-knob census
+
+_README = """\
+# fixture
+
+<!-- corethlint:knob-table:begin -->
+| Knob | Default | Read by |
+|---|---|---|
+| `CORETH_KNOWN` | `\"0\"` | `mpt.x` |
+<!-- corethlint:knob-table:end -->
+"""
+
+
+def _readme(tmp_path, text=_README):
+    p = tmp_path / "README.md"
+    p.write_text(text)
+    return str(p)
+
+
+def test_cfg001_unregistered_read_site(tmp_path):
+    s = src("""\
+        import os
+
+        FLAG = os.environ.get("CORETH_UNLISTED", "0")
+        """)
+    found = check_envknobs([s], readme_path=_readme(tmp_path))
+    assert codes(found) == ["CFG001"]
+    assert found[0].detail == "knob:CORETH_UNLISTED"
+    assert "--write-table" in found[0].message
+
+
+def test_cfg001_registered_read_is_clean(tmp_path):
+    s = src("""\
+        import os
+
+        FLAG = os.environ.get("CORETH_KNOWN", "0")
+        """)
+    assert check_envknobs([s], readme_path=_readme(tmp_path)) == []
+
+
+def test_cfg001_all_read_shapes_are_seen():
+    reads = collect_reads([src("""\
+        import os
+
+        A = os.environ.get("CORETH_A", "1")
+        B = os.getenv("CORETH_B")
+        C = os.environ["CORETH_C"]
+        D = "CORETH_D" in os.environ
+        os.environ.setdefault("CORETH_E", "x")
+        dyn = os.environ.get(A)
+        """)])
+    assert sorted(r.name for r in reads) == [
+        "CORETH_A", "CORETH_B", "CORETH_C", "CORETH_D", "CORETH_E"]
+    by_name = {r.name: r.default for r in reads}
+    assert by_name["CORETH_C"] == "*(required)*"
+    assert by_name["CORETH_D"] == "*(flag)*"
+
+
+def test_cfg002_stale_row_only_on_full_scope(tmp_path):
+    readme = _readme(tmp_path)
+    reader = src("""\
+        import os
+
+        FLAG = os.environ.get("CORETH_KNOWN")
+        """)
+    # partial run: a stale row is not provable
+    assert check_envknobs([src("")], readme_path=readme) == []
+    # full-scope run without the reader: the KNOWN row is stale
+    full = [src("", path="coreth_tpu/__init__.py")]
+    found = check_envknobs(full, readme_path=readme)
+    assert codes(found) == ["CFG002"]
+    assert found[0].detail == "knob:CORETH_KNOWN"
+    # full scope with the reader present: clean
+    assert check_envknobs(full + [reader], readme_path=readme) == []
+
+
+def test_cfg001_hint_when_markers_missing(tmp_path):
+    s = src("""\
+        import os
+
+        FLAG = os.environ.get("CORETH_X")
+        """)
+    found = check_envknobs(
+        [s], readme_path=_readme(tmp_path, "# no markers\n"))
+    assert codes(found) == ["CFG001"]
+    assert "knob-table:begin" in found[0].message
+
+
+def test_write_table_round_trip(tmp_path):
+    readme = _readme(tmp_path)
+    s = src("""\
+        import os
+
+        A = os.environ.get("CORETH_ALPHA", "1")
+        B = os.environ["CORETH_BETA"]
+        """)
+    assert write_table(readme, collect_reads([s]))
+    rows, markers = parse_table(readme)
+    assert markers and sorted(rows) == ["CORETH_ALPHA", "CORETH_BETA"]
+    assert check_envknobs([s], readme_path=readme) == []
+    # prose outside the marker block survives the rewrite
+    assert open(readme).read().startswith("# fixture")
+
+
+def test_write_table_refuses_without_markers(tmp_path):
+    readme = _readme(tmp_path, "# bare\n")
+    assert not write_table(readme, [])
+    assert open(readme).read() == "# bare\n"
+
+
+def test_build_table_merges_defaults_and_modules():
+    reads = collect_reads([
+        src("import os\nA = os.environ.get('CORETH_A', '1')\n",
+            path="coreth_tpu/mpt/x.py"),
+        src("import os\nA = os.environ.get('CORETH_A', '2')\n",
+            path="coreth_tpu/serve/y.py"),
+    ])
+    table = build_table(reads)
+    (row,) = [ln for ln in table.splitlines() if "CORETH_A" in ln]
+    assert "`'1'` / `'2'`" in row
+    assert "`mpt.x`" in row and "`serve.y`" in row

@@ -1,6 +1,6 @@
 """corethlint — AST-based architecture lint for the coreth_tpu tree.
 
-Five passes, all static (no imports of the linted code, safe to run
+Seven passes, all static (no imports of the linted code, safe to run
 anywhere, no JAX/device access):
 
 - **layers** (LAY001/LAY002): the package DAG declared in
@@ -24,6 +24,17 @@ anywhere, no JAX/device access):
   "C"`` declarations parsed out of ``native/*.cc`` — unbound/unknown
   symbols, arity mismatches, width/pointer-ness mismatches, and
   missing ``restype`` (the default-``c_int`` truncation bug class).
+- **thread safety** (THR001-THR005): a thread-entry graph is built
+  from the tree's actual spawn sites (``threading.Thread``, the
+  compile-pool ``submit``s, ``http.server`` handlers, declared
+  callback entries) and every module-global / instance attribute
+  written from ≥2 thread contexts must be lock-guarded at each
+  mutation site, an arm-once global, or carry a ``# corethlint:
+  shared <why>`` justification.
+- **env-knob census** (CFG001/CFG002): every literal ``CORETH_*``
+  environ read must have a row in the README knob table (regenerate
+  with ``python -m tools.lint.envknobs --write-table``); stale rows
+  fail on full-tree runs.
 
 Findings can be suppressed inline with ``# noqa: <CODE> — <reason>``
 (reason mandatory) or via ``tools/lint/baseline.txt`` for accepted
@@ -36,11 +47,13 @@ from tools.lint.determinism import check_determinism  # noqa: F401
 from tools.lint.jitpurity import check_jit_purity  # noqa: F401
 from tools.lint.excepts import check_excepts  # noqa: F401
 from tools.lint.nativeabi import check_nativeabi  # noqa: F401
+from tools.lint.threadsafety import check_threadsafety  # noqa: F401
+from tools.lint.envknobs import check_envknobs  # noqa: F401
 from tools.lint.baseline import load_baseline, split_findings  # noqa: F401
 
 
 def run_all(paths, config, baseline=frozenset()):
-    """Run all five passes; returns (new, baselined, stale_keys)."""
+    """Run all seven passes; returns (new, baselined, stale_keys)."""
     from tools.lint.core import _display_path
     sources = collect_sources(paths)
     findings = []
@@ -49,6 +62,8 @@ def run_all(paths, config, baseline=frozenset()):
     findings += check_jit_purity(sources)
     findings += check_excepts(sources)
     findings += check_nativeabi(sources)
+    findings += check_threadsafety(sources)
+    findings += check_envknobs(sources)
     by_path = {s.path: s for s in sources}
     findings = [f for f in findings if not is_suppressed(f, by_path)]
     return split_findings(findings, baseline,

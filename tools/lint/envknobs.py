@@ -1,0 +1,248 @@
+"""Env-knob census — every ``CORETH_*`` read site must be in the README.
+
+The tree grew ~50 ``CORETH_*`` environment knobs documented only by
+grep.  This pass makes the README's knob table (between the
+``<!-- corethlint:knob-table:begin/end -->`` markers) the registry:
+
+- **CFG001** — a ``os.environ.get("CORETH_X")`` / ``os.getenv`` /
+  ``os.environ["CORETH_X"]`` / ``"CORETH_X" in os.environ`` read site
+  whose knob has no table row.  Fix by regenerating the table:
+  ``python -m tools.lint.envknobs --write-table``.
+- **CFG002** — a table row no read site backs any more (stale docs).
+  Only emitted on a full-tree run — a partial run cannot prove a knob
+  unread (same contract as ABI001's unbound direction).
+
+Only literal ``CORETH_*`` first arguments count; dynamic lookups (the
+forensics env fingerprint iterates a name list) are out of scope.  The
+generator rewrites ONLY the marker block, so the surrounding prose —
+what the knobs mean — stays hand-written; the table carries name,
+default, and reading modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.lint.core import Finding, Source, _REPO_ROOT
+
+BEGIN = "<!-- corethlint:knob-table:begin -->"
+END = "<!-- corethlint:knob-table:end -->"
+
+_ROW_RE = re.compile(r"^\|\s*`?(CORETH_[A-Z0-9_]+)`?\s*\|")
+
+# the read shapes used across the tree (structural match on the dotted
+# callee/value; the tree imports `os`, never `from os import environ`)
+_GET_CALLS = {"os.environ.get", "os.getenv", "os.environ.setdefault"}
+_ENV_NAMES = {"os.environ"}
+
+
+def _dotted(expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _dotted(expr.value)
+        return f"{base}.{expr.attr}" if base else None
+    return None
+
+
+def _module_display(path: str) -> str:
+    parts = path.replace("\\", "/").split("/")
+    if "coreth_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("coreth_tpu")
+        parts = parts[idx + 1:]
+    else:
+        parts = parts[-1:]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "coreth_tpu"
+
+
+class KnobRead:
+    __slots__ = ("name", "default", "path", "line", "module")
+
+    def __init__(self, name, default, path, line):
+        self.name = name
+        self.default = default
+        self.path = path
+        self.line = line
+        self.module = _module_display(path)
+
+
+def _literal_knob(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("CORETH_"):
+        return node.value
+    return None
+
+
+def collect_reads(sources: Sequence[Source]) -> List[KnobRead]:
+    reads: List[KnobRead] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                if _dotted(node.func) not in _GET_CALLS or not node.args:
+                    continue
+                name = _literal_knob(node.args[0])
+                if name is None:
+                    continue
+                if len(node.args) > 1:
+                    try:
+                        default = f"`{ast.unparse(node.args[1])}`"
+                    except Exception:  # noqa: BLE001 — display-only default rendering
+                        default = "`?`"
+                else:
+                    default = "*(unset)*"
+                reads.append(KnobRead(name, default, src.path,
+                                      node.lineno))
+            elif isinstance(node, ast.Subscript):
+                if _dotted(node.value) in _ENV_NAMES:
+                    name = _literal_knob(node.slice)
+                    if name is not None:
+                        reads.append(KnobRead(name, "*(required)*",
+                                              src.path, node.lineno))
+            elif isinstance(node, ast.Compare):
+                if len(node.ops) == 1 \
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                        and len(node.comparators) == 1 \
+                        and _dotted(node.comparators[0]) in _ENV_NAMES:
+                    name = _literal_knob(node.left)
+                    if name is not None:
+                        reads.append(KnobRead(name, "*(flag)*",
+                                              src.path, node.lineno))
+    return reads
+
+
+def default_readme() -> str:
+    return os.path.join(_REPO_ROOT, "README.md")
+
+
+def parse_table(readme_path: str) -> Tuple[Dict[str, int], bool]:
+    """{knob -> row line} from the marker block; (table, markers_found)."""
+    try:
+        with open(readme_path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return {}, False
+    rows: Dict[str, int] = {}
+    inside = False
+    found = False
+    for i, line in enumerate(lines, 1):
+        if BEGIN in line:
+            inside = True
+            found = True
+            continue
+        if END in line:
+            inside = False
+            continue
+        if inside:
+            m = _ROW_RE.match(line.strip())
+            if m:
+                rows.setdefault(m.group(1), i)
+    return rows, found
+
+
+def build_table(reads: Sequence[KnobRead]) -> str:
+    """The markdown rows (header included) for the read sites."""
+    by_name: Dict[str, Dict[str, set]] = {}
+    for r in reads:
+        slot = by_name.setdefault(r.name, {"defaults": set(),
+                                           "modules": set()})
+        slot["defaults"].add(r.default)
+        slot["modules"].add(r.module)
+    out = ["| Knob | Default | Read by |", "|---|---|---|"]
+    for name in sorted(by_name):
+        defaults = " / ".join(sorted(by_name[name]["defaults"]))
+        modules = ", ".join(f"`{m}`"
+                            for m in sorted(by_name[name]["modules"]))
+        out.append(f"| `{name}` | {defaults} | {modules} |")
+    return "\n".join(out)
+
+
+def write_table(readme_path: str, reads: Sequence[KnobRead]) -> bool:
+    """Replace the marker block's contents; False when markers are
+    missing (the section must be placed by hand once)."""
+    with open(readme_path, encoding="utf-8") as fh:
+        text = fh.read()
+    if BEGIN not in text or END not in text:
+        return False
+    head, rest = text.split(BEGIN, 1)
+    _, tail = rest.split(END, 1)
+    new = f"{head}{BEGIN}\n{build_table(reads)}\n{END}{tail}"
+    with open(readme_path, "w", encoding="utf-8") as fh:
+        fh.write(new)
+    return True
+
+
+def _display_readme(readme_path: str) -> str:
+    rel = os.path.relpath(os.path.abspath(readme_path), _REPO_ROOT)
+    return rel.replace(os.sep, "/") if not rel.startswith("..") \
+        else readme_path.replace(os.sep, "/")
+
+
+def check_envknobs(sources: Sequence[Source],
+                   readme_path: Optional[str] = None) -> List[Finding]:
+    readme = readme_path or default_readme()
+    reads = collect_reads(sources)
+    table, markers = parse_table(readme)
+    findings: List[Finding] = []
+    seen_names = set()
+    for r in reads:
+        seen_names.add(r.name)
+        if r.name not in table:
+            hint = ("run 'python -m tools.lint.envknobs --write-table'"
+                    if markers else
+                    f"add the '{BEGIN}' block to the README first")
+            findings.append(Finding(
+                r.path, r.line, "CFG001",
+                f"env knob '{r.name}' read here but missing from the "
+                f"README knob table — {hint}", f"knob:{r.name}"))
+    # stale rows are only provable when the whole tree was scanned
+    full_scope = any(s.path.endswith("coreth_tpu/__init__.py")
+                     for s in sources)
+    if full_scope:
+        for name, line in sorted(table.items()):
+            if name not in seen_names:
+                findings.append(Finding(
+                    _display_readme(readme), line, "CFG002",
+                    f"knob table row '{name}' has no remaining read "
+                    f"site — regenerate the table",
+                    f"knob:{name}"))
+    return findings
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint.envknobs",
+        description="CORETH_* env-knob census / README table generator.")
+    ap.add_argument("paths", nargs="*", default=["coreth_tpu"])
+    ap.add_argument("--readme", default=default_readme())
+    ap.add_argument("--write-table", action="store_true",
+                    help="regenerate the README knob table in place")
+    args = ap.parse_args(argv)
+    from tools.lint.core import collect_sources
+    sources = collect_sources(args.paths or ["coreth_tpu"])
+    reads = collect_reads(sources)
+    if args.write_table:
+        if not write_table(args.readme, reads):
+            print(f"envknobs: markers missing from {args.readme}; add\n"
+                  f"  {BEGIN}\n  {END}\nwhere the table belongs")
+            return 2
+        print(f"envknobs: wrote {len({r.name for r in reads})} knobs "
+              f"to {args.readme}")
+        return 0
+    findings = check_envknobs(sources, args.readme)
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        print(f.render())
+    print(f"envknobs: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

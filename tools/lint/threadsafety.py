@@ -1,0 +1,1132 @@
+"""Thread-discipline pass — shared state needs a lock, a handoff, or a reason.
+
+Since PR 6 the serving path is genuinely concurrent: a streaming run
+spawns feed, prefetch, compile-pool, flat-exporter, forensics-drain and
+telemetry threads, yet nothing statically checked which state they
+share.  This pass builds a **thread-entry graph** from the repo's actual
+spawn sites and enforces a mutation discipline on everything reachable
+from more than one thread:
+
+1. **Entries.**  ``threading.Thread(target=...)`` / ``threading.Timer``,
+   executor ``.submit(...)`` calls (the adapter compile pool), and
+   ``http.server`` / ``socketserver`` handler classes (every handler
+   method runs on a server thread).  A function whose ``def`` line
+   carries ``# corethlint: thread <desc>`` is registered as an entry
+   too — the escape hatch for callback indirection the resolver cannot
+   see through (e.g. a render callable handed to the telemetry server).
+2. **Closures.**  Best-effort intra-repo call resolution (module
+   functions, ``self`` methods, one level of typed instance attributes
+   from ``self.x = ClassName(...)``, local aliases, factory functions
+   returning a local closure/lambda) is walked from every entry.  The
+   *main* context is the closure of every function no resolved call
+   site reaches — tests and drivers may call any of those directly.
+3. **Shared state.**  Module globals and instance attributes whose
+   accesses span >= 2 contexts with at least one write.  Mutations via
+   *method calls* (``queue.Queue.put``, ``EventRing.append``, dict/list
+   mutators) are deliberately out of scope: bounded handoff objects ARE
+   the blessed discipline, and their internals lock themselves.
+4. **Discipline.**  Every *suspect* mutation site (one that can execute
+   on a spawned thread, or a read-modify-write racing a spawned reader)
+   must be (a) inside ``with <lock>:`` — a ``threading.Lock/RLock/
+   Condition`` attribute or a lock-ish name (``*lock``, ``*_mu``,
+   ``*mutex``, ``*cond``); (b) the arm-once module-global pattern
+   (``G = None`` default, assigned under ``if G is None:`` — the
+   metrics/faults/trace/recorder idiom); or (c) justified in place with
+   ``# corethlint: shared <why>`` on the mutation line or on the
+   variable's definition line (module-level global statement, or the
+   ``__init__`` assignment for attributes).
+
+Codes:
+
+- **THR001** — unguarded mutation of a shared module global.
+- **THR002** — unguarded mutation of a shared instance attribute.
+- **THR003** — lock-discipline hole: the same variable is lock-guarded
+  at other mutation sites but bare here (stronger signal than
+  THR001/2 — somebody already decided this needs a lock).
+- **THR004** — mutation sites of one variable guarded by *different*
+  locks (mutual exclusion in name only).
+- **THR005** — spawn site whose target the resolver cannot identify;
+  annotate the line with ``# corethlint: thread <what runs here>``.
+
+The pass is intentionally conservative where resolution fails: an
+unresolved call simply ends the closure walk.  It is a lint for the
+disciplines this tree actually uses, not a race prover — the dynamic
+half of the story is ThreadSanitizer (``make -C native
+sanitize-thread`` + tests/test_tsan.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.lint.core import Finding, Source
+
+MAIN = "main"
+
+_MARKER_RE = re.compile(
+    r"#\s*corethlint:\s*(?P<kind>shared|thread)\b"
+    r"(?:\s*[—–:-]*\s*(?P<why>\S.*))?")
+
+_THREAD_FACTORIES = {"threading.Thread", "threading.Timer"}
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition", "threading.Semaphore",
+                   "threading.BoundedSemaphore"}
+_LOCKISH_SUFFIXES = ("lock", "_mu", "mutex", "cond")
+_HANDLER_BASES = {
+    "http.server.BaseHTTPRequestHandler",
+    "http.server.SimpleHTTPRequestHandler",
+    "socketserver.BaseRequestHandler",
+    "socketserver.StreamRequestHandler",
+    "socketserver.DatagramRequestHandler",
+}
+
+
+def _walk_skip(node):
+    """ast.walk that does NOT descend into nested function/class/lambda
+    definitions — their bodies belong to other analysis scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _marker(src: Source, lineno: int, kind: str) -> Optional[str]:
+    """The ``# corethlint: <kind> <why>`` rationale on a line, on the
+    closing line of a multi-line simple statement, or on a pure-comment
+    line immediately above (rationales rarely fit inline), or None.  A
+    marker without a rationale does not count — same contract as noqa."""
+    lines = {lineno}
+    end = src.stmt_end(lineno)
+    if end:
+        lines.add(end)
+    if lineno > 1 and src.line(lineno - 1).lstrip().startswith("#"):
+        lines.add(lineno - 1)
+    for ln in sorted(lines):
+        m = _MARKER_RE.search(src.line(ln))
+        if m and m.group("kind") == kind and m.group("why"):
+            return m.group("why")
+    return None
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name; fixture trees resolve relative to the last
+    ``coreth_tpu`` component like core.package_of does."""
+    parts = path.replace("\\", "/").split("/")
+    if "coreth_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("coreth_tpu")
+        parts = parts[idx:]
+    else:
+        parts = parts[-1:]
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _Ext:
+    """A name resolved to something outside the scanned sources."""
+    __slots__ = ("dotted",)
+
+    def __init__(self, dotted: str):
+        self.dotted = dotted
+
+
+class _Func:
+    __slots__ = ("qual", "node", "mod", "cls", "parent", "nested",
+                 "nested_classes", "is_lambda")
+
+    def __init__(self, qual, node, mod, cls, parent):
+        self.qual = qual
+        self.node = node
+        self.mod = mod
+        self.cls = cls            # owning _Cls for methods, else None
+        self.parent = parent      # enclosing _Func, for nested defs
+        self.nested: Dict[str, "_Func"] = {}
+        self.nested_classes: Dict[str, "_Cls"] = {}
+        self.is_lambda = isinstance(node, ast.Lambda)
+
+    @property
+    def short(self) -> str:
+        return self.qual.split("::", 1)[-1]
+
+
+class _Cls:
+    __slots__ = ("qual", "node", "mod", "base_exprs", "bases",
+                 "methods", "attr_types", "attr_ext", "lock_attrs",
+                 "attr_def_lines")
+
+    def __init__(self, qual, node, mod):
+        self.qual = qual
+        self.node = node
+        self.mod = mod
+        self.base_exprs = list(node.bases)
+        self.bases: List[object] = []          # _Cls | _Ext, resolved later
+        self.methods: Dict[str, _Func] = {}
+        self.attr_types: Dict[str, "_Cls"] = {}
+        self.attr_ext: Dict[str, str] = {}     # attr -> external dotted type
+        self.lock_attrs: Set[str] = set()
+        self.attr_def_lines: Dict[str, List[int]] = {}
+
+    @property
+    def short(self) -> str:
+        return self.qual.split("::", 1)[-1]
+
+
+class _Mod:
+    __slots__ = ("src", "name", "imports", "funcs", "classes",
+                 "globals_defined", "globals_none", "global_lines",
+                 "module_locks")
+
+    def __init__(self, src: Source):
+        self.src = src
+        self.name = _module_name(src.path)
+        self.imports: Dict[str, str] = {}
+        self.funcs: Dict[str, _Func] = {}
+        self.classes: Dict[str, _Cls] = {}
+        self.globals_defined: Set[str] = set()
+        self.globals_none: Set[str] = set()
+        self.global_lines: Dict[str, int] = {}
+        self.module_locks: Set[str] = set()
+
+
+class _Access:
+    __slots__ = ("fn", "line", "write", "rmw", "lock", "armonce",
+                 "assigns_none")
+
+    def __init__(self, fn, line, write, rmw=False, lock=None,
+                 armonce=False, assigns_none=False):
+        self.fn = fn
+        self.line = line
+        self.write = write
+        self.rmw = rmw
+        self.lock = lock          # lock identity string when held
+        self.armonce = armonce
+        self.assigns_none = assigns_none
+
+
+class _Var:
+    __slots__ = ("key", "kind", "mod", "cls", "name", "accesses")
+
+    def __init__(self, key, kind, mod, cls, name):
+        self.key = key
+        self.kind = kind          # "global" | "attr"
+        self.mod = mod
+        self.cls = cls
+        self.name = name
+        self.accesses: List[_Access] = []
+
+    @property
+    def display(self) -> str:
+        if self.kind == "global":
+            return f"{self.mod.name}.{self.name}"
+        return f"{self.cls.mod.name}.{self.cls.short}.{self.name}"
+
+    def def_sites(self) -> List[Tuple[Source, int]]:
+        if self.kind == "global":
+            ln = self.mod.global_lines.get(self.name)
+            return [(self.mod.src, ln)] if ln else []
+        return [(self.cls.mod.src, ln)
+                for ln in self.cls.attr_def_lines.get(self.name, [])]
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — display-only; any repr beats crashing the lint
+        return "<expr>"
+
+
+class _Analyzer:
+    def __init__(self, sources: Sequence[Source]):
+        self.sources = sources
+        self.mods: List[_Mod] = []
+        self.mods_by_name: Dict[str, _Mod] = {}
+        self.funcs: List[_Func] = []
+        self.func_of_node: Dict[int, _Func] = {}
+        self.all_classes: List[_Cls] = []
+        self.findings: List[Finding] = []
+        # entry id -> (root _Func or list of _Funcs, human label)
+        self.entries: Dict[str, Tuple[List[_Func], str]] = {}
+        self.edges: Dict[str, List[str]] = {}
+        self.funcs_by_qual: Dict[str, _Func] = {}
+        self.contexts: Dict[str, Set[str]] = {}
+        self.vars: Dict[Tuple, _Var] = {}
+        self._alias_cache: Dict[int, Dict[str, _Cls]] = {}
+
+    # ------------------------------------------------------------ index
+    def index(self) -> None:
+        for src in self.sources:
+            mod = _Mod(src)
+            self.mods.append(mod)
+            self.mods_by_name[mod.name] = mod
+            self._index_module(mod)
+        for cls in self.all_classes:
+            cls.bases = [b for b in
+                         (self._resolve_base(cls, e) for e in cls.base_exprs)
+                         if b is not None]
+        for cls in self.all_classes:
+            self._index_attr_types(cls)
+
+    def _index_module(self, mod: _Mod) -> None:
+        body = mod.src.tree.body
+
+        def walk_stmts(stmts, cls: Optional[_Cls], fn: Optional[_Func],
+                       top: bool):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                    self._index_import(mod, stmt)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    self._index_func(mod, stmt, cls, fn)
+                elif isinstance(stmt, ast.ClassDef):
+                    self._index_class(mod, stmt, cls, fn)
+                elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    if top:
+                        self._index_global(mod, stmt)
+                    walk_stmts([], cls, fn, top)
+                elif isinstance(stmt, (ast.If, ast.Try, ast.For,
+                                       ast.While, ast.With)):
+                    for field in ("body", "orelse", "finalbody",
+                                  "handlers"):
+                        sub = getattr(stmt, field, None) or []
+                        for s in sub:
+                            if isinstance(s, ast.ExceptHandler):
+                                walk_stmts(s.body, cls, fn, top)
+                            else:
+                                walk_stmts([s], cls, fn, top)
+
+        walk_stmts(body, None, None, True)
+
+    def _index_import(self, mod: _Mod, stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                mod.imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        else:
+            base = stmt.module or ""
+            if stmt.level:  # relative import -> anchor at our package
+                pkg = mod.name.rsplit(".", stmt.level)[0] \
+                    if mod.name.count(".") >= stmt.level else mod.name
+                base = f"{pkg}.{base}" if base else pkg
+            for a in stmt.names:
+                if a.name == "*":
+                    continue
+                mod.imports[a.asname or a.name] = f"{base}.{a.name}"
+
+    def _index_global(self, mod: _Mod, stmt) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            mod.globals_defined.add(t.id)
+            mod.global_lines.setdefault(t.id, stmt.lineno)
+            if isinstance(value, ast.Constant) and value.value is None:
+                mod.globals_none.add(t.id)
+            if isinstance(value, ast.Call) and self._dotted_of(
+                    mod, value.func) in _LOCK_FACTORIES:
+                mod.module_locks.add(t.id)
+
+    def _index_func(self, mod, node, cls, parent) -> _Func:
+        if cls is not None and parent is None:
+            qual = f"{mod.name}::{cls.short}.{node.name}"
+        elif parent is not None:
+            qual = f"{parent.qual}.<locals>.{node.name}"
+        else:
+            qual = f"{mod.name}::{node.name}"
+        fn = _Func(qual, node, mod, cls, parent)
+        self.funcs.append(fn)
+        self.funcs_by_qual[qual] = fn
+        self.func_of_node[id(node)] = fn
+        if parent is not None:
+            parent.nested[node.name] = fn
+        elif cls is not None:
+            cls.methods[node.name] = fn
+        else:
+            mod.funcs[node.name] = fn
+        self._index_body(mod, node.body, cls if parent is None else None,
+                         fn)
+        return fn
+
+    def _index_body(self, mod, stmts, cls, fn) -> None:
+        """Index nested defs/classes/lambdas inside a function body."""
+        self._index_nested(mod, stmts, fn)
+
+    def _index_nested(self, mod, stmts, fn: _Func) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                # function-local imports (the tree's cycle-breaking
+                # idiom) join the module map — module-wide scope is an
+                # acceptable over-approximation for resolution
+                self._index_import(mod, stmt)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(stmt) not in self.func_of_node:
+                    self._index_func(mod, stmt, fn.cls, fn)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                if id(stmt) not in self._class_nodes():
+                    self._index_class(mod, stmt, None, fn)
+                continue
+            for expr in ast.walk(stmt):
+                if isinstance(expr, ast.Lambda) \
+                        and id(expr) not in self.func_of_node:
+                    lam = _Func(f"{fn.qual}.<locals>.<lambda>", expr,
+                                mod, fn.cls, fn)
+                    self.funcs.append(lam)
+                    self.func_of_node[id(expr)] = lam
+                    self.funcs_by_qual.setdefault(lam.qual, lam)
+            sub = []
+            for field in ("body", "orelse", "finalbody"):
+                sub.extend(getattr(stmt, field, None) or [])
+            for h in getattr(stmt, "handlers", None) or []:
+                sub.extend(h.body)
+            if sub:
+                self._index_nested(mod, sub, fn)
+
+    def _class_nodes(self) -> Set[int]:
+        return {id(c.node) for c in self.all_classes}
+
+    def _index_class(self, mod, node, outer_cls, fn) -> _Cls:
+        if fn is not None:
+            qual = f"{fn.qual}.<locals>.{node.name}"
+        elif outer_cls is not None:
+            qual = f"{outer_cls.qual}.{node.name}"
+        else:
+            qual = f"{mod.name}::{node.name}"
+        cls = _Cls(qual, node, mod)
+        self.all_classes.append(cls)
+        if fn is not None:
+            fn.nested_classes[node.name] = cls
+        else:
+            mod.classes[node.name] = cls
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_func(mod, stmt, cls, None)
+        return cls
+
+    def _dotted_of(self, mod: _Mod, expr) -> Optional[str]:
+        """Dotted name of an expression through the import map —
+        ``_threading.Thread`` -> ``threading.Thread``."""
+        if isinstance(expr, ast.Name):
+            return mod.imports.get(expr.id, expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._dotted_of(mod, expr.value)
+            return f"{base}.{expr.attr}" if base else None
+        return None
+
+    def _resolve_base(self, cls: _Cls, expr):
+        mod = cls.mod
+        if isinstance(expr, ast.Name):
+            hit = mod.classes.get(expr.id)
+            if hit is not None:
+                return hit
+        dotted = self._dotted_of(mod, expr)
+        if dotted is None:
+            return None
+        got = self._resolve_dotted(dotted)
+        if isinstance(got, (_Cls, _Ext)):
+            return got
+        return _Ext(dotted)
+
+    def _resolve_dotted(self, dotted: str):
+        if dotted in self.mods_by_name:
+            return self.mods_by_name[dotted]
+        head, _, last = dotted.rpartition(".")
+        m = self.mods_by_name.get(head)
+        if m is not None:
+            if last in m.funcs:
+                return m.funcs[last]
+            if last in m.classes:
+                return m.classes[last]
+            return None
+        root = dotted.split(".", 1)[0]
+        if root == "coreth_tpu" or root in self.mods_by_name:
+            return None
+        return _Ext(dotted)
+
+    def _index_attr_types(self, cls: _Cls) -> None:
+        for meth in cls.methods.values():
+            for stmt in ast.walk(meth.node):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if meth.node.name == "__init__":
+                        cls.attr_def_lines.setdefault(
+                            t.attr, []).append(stmt.lineno)
+                    v = stmt.value
+                    if not isinstance(v, ast.Call):
+                        continue
+                    dotted = self._dotted_of(cls.mod, v.func)
+                    if dotted in _LOCK_FACTORIES:
+                        cls.lock_attrs.add(t.attr)
+                        continue
+                    got = None
+                    if isinstance(v.func, ast.Name):
+                        got = cls.mod.classes.get(v.func.id)
+                        f = meth
+                        while got is None and f is not None:
+                            got = f.nested_classes.get(v.func.id)
+                            f = f.parent
+                    if got is None and dotted is not None:
+                        hit = self._resolve_dotted(dotted)
+                        if isinstance(hit, _Cls):
+                            got = hit
+                        elif isinstance(hit, _Ext):
+                            cls.attr_ext.setdefault(t.attr, hit.dotted)
+                    if got is not None:
+                        cls.attr_types.setdefault(t.attr, got)
+
+    # -------------------------------------------------------- resolution
+    def _aliases(self, fn: _Func) -> Tuple[Dict[str, object], Set[str]]:
+        """(alias types, fresh names).  Alias types map local names to
+        the _Cls/_Ext their value is an instance of; *fresh* names were
+        constructed in this very function — a thread-confined object
+        whose attribute traffic is private until published."""
+        got = self._alias_cache.get(id(fn.node))
+        if got is not None:
+            return got
+        aliases: Dict[str, object] = {}
+        fresh: Set[str] = set()
+        body = fn.node.body if not fn.is_lambda else []
+        stmts = [s for s in self._own_stmts(body)]
+        for _ in range(2):  # two passes settle x = self.a; y = x chains
+            for stmt in stmts:
+                if not isinstance(stmt, ast.Assign) \
+                        or len(stmt.targets) != 1 \
+                        or not isinstance(stmt.targets[0], ast.Name):
+                    continue
+                name, v = stmt.targets[0].id, stmt.value
+                t = self._instance_type(fn, v, aliases)
+                if t is not None:
+                    aliases[name] = t
+                    if isinstance(v, ast.Name) and v.id in fresh:
+                        fresh.add(name)
+                elif isinstance(v, ast.Call):
+                    callee = self._resolve_expr(fn, v.func, aliases)
+                    if isinstance(callee, (_Cls, _Ext)):
+                        aliases[name] = callee
+                        fresh.add(name)
+        self._alias_cache[id(fn.node)] = (aliases, fresh)
+        return aliases, fresh
+
+    def _own_stmts(self, stmts):
+        """Statements of a body, recursing into compound statements but
+        NOT into nested function/class definitions."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield stmt
+            sub = []
+            for field in ("body", "orelse", "finalbody"):
+                sub.extend(getattr(stmt, field, None) or [])
+            for h in getattr(stmt, "handlers", None) or []:
+                sub.extend(h.body)
+            if sub:
+                yield from self._own_stmts(sub)
+
+    def _instance_type(self, fn: _Func, expr,
+                       aliases: Dict[str, object]) -> Optional[_Cls]:
+        """The repo class an expression's VALUE is an instance of."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fn.cls is not None:
+                return fn.cls
+            got = aliases.get(expr.id)
+            return got if isinstance(got, _Cls) else None
+        if isinstance(expr, ast.Attribute):
+            base = self._instance_type(fn, expr.value, aliases)
+            if base is not None:
+                return self._attr_type(base, expr.attr)
+        return None
+
+    def _ext_instance_type(self, fn: _Func, expr,
+                           aliases: Dict[str, object]) -> Optional[str]:
+        """Dotted EXTERNAL type of an instance expression, when known
+        (``self._httpd`` after ``self._httpd = ThreadingHTTPServer(...)``,
+        or a local constructed from an external class)."""
+        if isinstance(expr, ast.Name):
+            got = aliases.get(expr.id)
+            return got.dotted if isinstance(got, _Ext) else None
+        if isinstance(expr, ast.Attribute):
+            base = self._instance_type(fn, expr.value, aliases)
+            if base is not None:
+                seen, stack = set(), [base]
+                while stack:
+                    c = stack.pop()
+                    if id(c) in seen:
+                        continue
+                    seen.add(id(c))
+                    if expr.attr in c.attr_ext:
+                        return c.attr_ext[expr.attr]
+                    stack.extend(b for b in c.bases
+                                 if isinstance(b, _Cls))
+        return None
+
+    def _attr_type(self, cls: _Cls, attr: str) -> Optional[_Cls]:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            if attr in c.attr_types:
+                return c.attr_types[attr]
+            stack.extend(b for b in c.bases if isinstance(b, _Cls))
+        return None
+
+    def _method(self, cls: _Cls, name: str):
+        """_Func, _Ext (inherited from an external base), or None."""
+        seen = set()
+        stack = [cls]
+        external = False
+        while stack:
+            c = stack.pop()
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            if name in c.methods:
+                return c.methods[name]
+            for b in c.bases:
+                if isinstance(b, _Cls):
+                    stack.append(b)
+                else:
+                    external = True
+        return _Ext(f"{cls.qual}.{name}") if external else None
+
+    def _resolve_expr(self, fn: _Func, expr, aliases=None):
+        """Resolve an expression naming a callable: _Func | _Cls |
+        _Ext | None (unknown)."""
+        if aliases is None:
+            aliases, _ = self._aliases(fn)
+        mod = fn.mod
+        if isinstance(expr, ast.Lambda):
+            return self.func_of_node.get(id(expr))
+        if isinstance(expr, ast.Name):
+            f = fn
+            while f is not None:
+                if expr.id in f.nested:
+                    return f.nested[expr.id]
+                if expr.id in f.nested_classes:
+                    return f.nested_classes[expr.id]
+                f = f.parent
+            if expr.id in mod.funcs:
+                return mod.funcs[expr.id]
+            if expr.id in mod.classes:
+                return mod.classes[expr.id]
+            if expr.id in mod.imports:
+                return self._resolve_dotted(mod.imports[expr.id])
+            return None
+        if isinstance(expr, ast.Attribute):
+            inst = self._instance_type(fn, expr.value, aliases)
+            if inst is not None:
+                return self._method(inst, expr.attr)
+            ext = self._ext_instance_type(fn, expr.value, aliases)
+            if ext is not None:
+                return _Ext(f"{ext}.{expr.attr}")
+            base = self._resolve_expr(fn, expr.value, aliases)
+            if isinstance(base, _Mod):
+                if expr.attr in base.funcs:
+                    return base.funcs[expr.attr]
+                if expr.attr in base.classes:
+                    return base.classes[expr.attr]
+                sub = self.mods_by_name.get(f"{base.name}.{expr.attr}")
+                if sub is not None:
+                    return sub
+                return None
+            if isinstance(base, _Cls):
+                return self._method(base, expr.attr)
+            if isinstance(base, _Ext):
+                return _Ext(f"{base.dotted}.{expr.attr}")
+            return None
+        return None
+
+    def _resolve_spawn_target(self, fn: _Func, expr):
+        """Like _resolve_expr, plus: a call to a factory returning a
+        local closure/lambda resolves to that closure, and
+        ``functools.partial(f, ...)`` resolves to f."""
+        got = self._resolve_expr(fn, expr)
+        if got is not None:
+            return got
+        if isinstance(expr, ast.Call):
+            callee = self._resolve_expr(fn, expr.func)
+            if isinstance(callee, _Ext) \
+                    and callee.dotted.endswith("partial") and expr.args:
+                return self._resolve_spawn_target(fn, expr.args[0])
+            if isinstance(callee, _Func):
+                for node in ast.walk(callee.node):
+                    if isinstance(node, ast.Return) and node.value:
+                        inner = self._resolve_expr(callee, node.value)
+                        if isinstance(inner, _Func):
+                            return inner
+        return None
+
+    # ----------------------------------------------------------- spawns
+    def discover_entries(self) -> None:
+        # handler classes: everything they define runs on server threads
+        for cls in self.all_classes:
+            if self._is_handler(cls) and cls.methods:
+                eid = f"handler:{cls.short}"
+                self.entries[eid] = (list(cls.methods.values()),
+                                     f"handler:{cls.short}")
+        # def-line annotations: declared thread contexts
+        for fn in self.funcs:
+            if fn.is_lambda:
+                continue
+            why = _marker(fn.mod.src, fn.node.lineno, "thread")
+            if why:
+                self.entries[f"declared:{why}"] = ([fn], f"thread:{why}")
+        # spawn calls
+        for fn in self.funcs:
+            for call in self._own_calls(fn):
+                self._check_spawn(fn, call)
+        # module-level spawn calls (rare but legal)
+        for mod in self.mods:
+            pseudo = _Func(f"{mod.name}::<module>", mod.src.tree, mod,
+                           None, None)
+            for stmt in mod.src.tree.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call):
+                        self._check_spawn(pseudo, node)
+
+    def _own_calls(self, fn: _Func):
+        roots = [fn.node.body] if fn.is_lambda else fn.node.body
+        for root in roots:
+            for node in _walk_skip(root):
+                if isinstance(node, ast.Call):
+                    yield node
+
+    def _is_handler(self, cls: _Cls) -> bool:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            for b in c.bases:
+                if isinstance(b, _Cls):
+                    stack.append(b)
+                elif isinstance(b, _Ext) and (
+                        b.dotted in _HANDLER_BASES
+                        or b.dotted.endswith("RequestHandler")):
+                    return True
+        return False
+
+    def _check_spawn(self, fn: _Func, call: ast.Call) -> None:
+        callee = self._resolve_expr(fn, call.func)
+        target = None
+        label = None
+        is_spawn = False
+        if isinstance(callee, _Ext) and callee.dotted in _THREAD_FACTORIES:
+            is_spawn = True
+            for kw in call.keywords:
+                if kw.arg == "target" or kw.arg == "function":
+                    target = kw.value
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    label = kw.value.value
+            if target is None and callee.dotted.endswith("Timer") \
+                    and len(call.args) >= 2:
+                target = call.args[1]
+            if target is None:
+                return  # bare Thread() (a subclass would be its own run)
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "submit" and call.args:
+            is_spawn = True
+            target = call.args[0]
+            label = "pool"
+        if not is_spawn:
+            return
+        got = self._resolve_spawn_target(fn, target)
+        if isinstance(got, _Func):
+            eid = got.qual
+            self.entries.setdefault(
+                eid, ([got], f"thread:{label or got.short}"))
+            return
+        if isinstance(got, (_Ext, _Cls)):
+            return  # external target (serve_forever etc.) — handler
+                    # classes carry the in-repo side of that concurrency
+        if _marker(fn.mod.src, call.lineno, "thread"):
+            return
+        self.findings.append(Finding(
+            fn.mod.src.path, call.lineno, "THR005",
+            f"cannot resolve spawn target '{_unparse(target)}' — "
+            f"annotate with '# corethlint: thread <what runs here>'",
+            f"spawn:{_unparse(target)}"))
+
+    # ----------------------------------------------------------- graph
+    def build_graph(self) -> None:
+        for fn in self.funcs:
+            out = []
+            for call in self._own_calls(fn):
+                got = self._resolve_expr(fn, call.func)
+                if isinstance(got, _Func):
+                    out.append(got.qual)
+                elif isinstance(got, _Cls):
+                    init = got.methods.get("__init__")
+                    if init is not None:
+                        out.append(init.qual)
+            self.edges[fn.qual] = out
+
+        incoming: Set[str] = set()
+        for srcq, outs in self.edges.items():
+            incoming.update(outs)
+        entry_quals = {f.qual for fns, _ in self.entries.values()
+                       for f in fns}
+
+        ctx: Dict[str, Set[str]] = {q: set() for q in self.funcs_by_qual}
+        for eid, (fns, _) in self.entries.items():
+            stack = [f.qual for f in fns]
+            while stack:
+                q = stack.pop()
+                if eid in ctx.setdefault(q, set()):
+                    continue
+                ctx[q].add(eid)
+                stack.extend(self.edges.get(q, []))
+        main_roots = [
+            fn.qual for fn in self.funcs
+            if fn.qual not in incoming
+            and fn.qual not in entry_quals
+            and not fn.is_lambda
+            and ".<locals>." not in fn.qual]
+        stack = list(main_roots)
+        while stack:
+            q = stack.pop()
+            if MAIN in ctx.setdefault(q, set()):
+                continue
+            ctx[q].add(MAIN)
+            stack.extend(self.edges.get(q, []))
+        self.contexts = ctx
+
+    # --------------------------------------------------------- accesses
+    def collect_accesses(self) -> None:
+        for fn in self.funcs:
+            if not self.contexts.get(fn.qual):
+                continue
+            self._collect_fn(fn)
+
+    def _var(self, kind, mod, cls, name) -> _Var:
+        key = (kind, mod.name if kind == "global" else cls.qual, name)
+        v = self.vars.get(key)
+        if v is None:
+            v = _Var(key, kind, mod, cls, name)
+            self.vars[key] = v
+        return v
+
+    def _collect_fn(self, fn: _Func) -> None:
+        if fn.is_lambda:
+            self._visit_expr_reads(fn, fn.node.body, None)
+            return
+        globals_declared: Set[str] = set()
+        locals_: Set[str] = set()
+        for stmt in self._own_stmts(fn.node.body):
+            if isinstance(stmt, ast.Global):
+                globals_declared.update(stmt.names)
+        args = fn.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            locals_.add(a.arg)
+        for stmt in fn.node.body:
+            for node in _walk_skip(stmt):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Store) \
+                        and node.id not in globals_declared:
+                    locals_.add(node.id)
+        state = {"locks": [], "armonce": set()}
+        self._visit_block(fn, fn.node.body, globals_declared, locals_,
+                          state)
+
+    def _visit_block(self, fn, stmts, gdecl, locals_, state) -> None:
+        block_armed: List[str] = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            # the early-return arm-once shape: `if G is not None:
+            # return ...` guards every later write in this block (the
+            # canonical crypto.native.load() idiom)
+            if isinstance(stmt, ast.If) and not stmt.orelse \
+                    and stmt.body \
+                    and isinstance(stmt.body[-1], (ast.Return, ast.Raise,
+                                                   ast.Break,
+                                                   ast.Continue)):
+                armed = self._not_none_checked(stmt.test)
+                if armed and armed not in state["armonce"]:
+                    state["armonce"].add(armed)
+                    block_armed.append(armed)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in stmt.items:
+                    lid = self._lock_id(fn, item.context_expr)
+                    if lid is not None:
+                        state["locks"].append(lid)
+                        pushed += 1
+                self._visit_block(fn, stmt.body, gdecl, locals_, state)
+                for _ in range(pushed):
+                    state["locks"].pop()
+                continue
+            if isinstance(stmt, ast.If):
+                armed = self._none_checked(stmt.test)
+                if armed:
+                    state["armonce"].add(armed)
+                self._visit_block(fn, stmt.body, gdecl, locals_, state)
+                if armed:
+                    state["armonce"].discard(armed)
+                self._visit_block(fn, stmt.orelse, gdecl, locals_, state)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._visit_block(fn, stmt.body, gdecl, locals_, state)
+                self._visit_block(fn, stmt.orelse, gdecl, locals_, state)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._visit_block(fn, stmt.body, gdecl, locals_, state)
+                for h in stmt.handlers:
+                    self._visit_block(fn, h.body, gdecl, locals_, state)
+                self._visit_block(fn, stmt.orelse, gdecl, locals_, state)
+                self._visit_block(fn, stmt.finalbody, gdecl, locals_,
+                                  state)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                rmw = isinstance(stmt, ast.AugAssign)
+                for t in targets:
+                    self._record_store(fn, t, stmt, rmw, gdecl, locals_,
+                                       state)
+                if stmt.value is not None:
+                    self._visit_expr_reads(fn, stmt.value,
+                                           (gdecl, locals_))
+                if rmw:  # x += 1 also reads x
+                    self._visit_expr_reads(fn, stmt.target,
+                                           (gdecl, locals_), force=True)
+                continue
+            self._visit_expr_reads(fn, stmt, (gdecl, locals_))
+        for name in block_armed:
+            state["armonce"].discard(name)
+
+    def _record_store(self, fn, target, stmt, rmw, gdecl, locals_,
+                      state) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._record_store(fn, el, stmt, rmw, gdecl, locals_,
+                                   state)
+            return
+        assigns_none = (isinstance(getattr(stmt, "value", None),
+                                   ast.Constant)
+                        and stmt.value.value is None)
+        lock = state["locks"][-1] if state["locks"] else None
+        if isinstance(target, ast.Name):
+            name = target.id
+            if name in gdecl:
+                var = self._var("global", fn.mod, None, name)
+                var.accesses.append(_Access(
+                    fn, stmt.lineno, True, rmw, lock,
+                    name in state["armonce"], assigns_none))
+            return
+        if isinstance(target, ast.Attribute):
+            if self._through_fresh(fn, target.value):
+                return  # constructed in this function: thread-confined
+            owner = self._owner_class(fn, target.value)
+            if owner is not None:
+                var = self._var("attr", owner.mod, owner, target.attr)
+                var.accesses.append(_Access(
+                    fn, stmt.lineno, True, rmw, lock, False,
+                    assigns_none))
+                return
+            # module attribute store: mod.G = x
+            got = self._resolve_expr(fn, target.value)
+            if isinstance(got, _Mod):
+                var = self._var("global", got, None, target.attr)
+                var.accesses.append(_Access(
+                    fn, stmt.lineno, True, rmw, lock, False,
+                    assigns_none))
+
+    def _owner_class(self, fn: _Func, expr) -> Optional[_Cls]:
+        aliases, _ = self._aliases(fn)
+        return self._instance_type(fn, expr, aliases)
+
+    def _through_fresh(self, fn: _Func, expr) -> bool:
+        """True when the instance expression roots at a local that was
+        constructed inside this function (thread-confined object)."""
+        while isinstance(expr, ast.Attribute):
+            expr = expr.value
+        if isinstance(expr, ast.Name) and expr.id != "self":
+            _, fresh = self._aliases(fn)
+            return expr.id in fresh
+        return False
+
+    def _visit_expr_reads(self, fn, node, scope, force=False) -> None:
+        gdecl, locals_ = scope if scope else (set(), set())
+        for n in _walk_skip(node):
+            if isinstance(n, ast.Name) and (
+                    isinstance(n.ctx, ast.Load) or force):
+                if n.id in fn.mod.globals_defined and (
+                        n.id in gdecl or n.id not in locals_):
+                    var = self._var("global", fn.mod, None, n.id)
+                    var.accesses.append(_Access(fn, n.lineno, False))
+            elif isinstance(n, ast.Attribute) and (
+                    isinstance(n.ctx, ast.Load) or force):
+                if self._through_fresh(fn, n.value):
+                    continue
+                owner = self._owner_class(fn, n.value)
+                if owner is not None:
+                    var = self._var("attr", owner.mod, owner, n.attr)
+                    var.accesses.append(_Access(fn, n.lineno, False))
+
+    def _lock_id(self, fn: _Func, expr) -> Optional[str]:
+        """Identity string when the with-item is lock-ish, else None."""
+        terminal = None
+        if isinstance(expr, ast.Name):
+            terminal = expr.id
+            if terminal in fn.mod.module_locks:
+                return _unparse(expr)
+        elif isinstance(expr, ast.Attribute):
+            terminal = expr.attr
+            if isinstance(expr.value, ast.Name):
+                owner = self._owner_class(fn, expr.value)
+                if owner is not None and terminal in self._lock_attrs(
+                        owner):
+                    return _unparse(expr)
+        if terminal is None:
+            return None
+        low = terminal.lower()
+        if low in ("mu", "cond") or low.endswith(_LOCKISH_SUFFIXES):
+            return _unparse(expr)
+        return None
+
+    def _lock_attrs(self, cls: _Cls) -> Set[str]:
+        out = set(cls.lock_attrs)
+        for b in cls.bases:
+            if isinstance(b, _Cls):
+                out |= self._lock_attrs(b)
+        return out
+
+    @staticmethod
+    def _none_compared(test, op) -> Optional[str]:
+        if isinstance(test, ast.Compare) \
+                and isinstance(test.left, ast.Name) \
+                and len(test.ops) == 1 \
+                and isinstance(test.ops[0], op) \
+                and len(test.comparators) == 1 \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value is None:
+            return test.left.id
+        return None
+
+    @classmethod
+    def _none_checked(cls, test) -> Optional[str]:
+        """Name tested ``is None`` (the arm-once guard)."""
+        return cls._none_compared(test, ast.Is)
+
+    @classmethod
+    def _not_none_checked(cls, test) -> Optional[str]:
+        """Name tested ``is not None`` (the early-return guard)."""
+        return cls._none_compared(test, ast.IsNot)
+
+    # ------------------------------------------------------- discipline
+    def check_vars(self) -> None:
+        labels = {eid: lbl for eid, (_, lbl) in self.entries.items()}
+        labels[MAIN] = MAIN
+        for var in self.vars.values():
+            ctxs: Set[str] = set()
+            for a in var.accesses:
+                ctxs |= self.contexts.get(a.fn.qual, set())
+            writes = [a for a in var.accesses if a.write]
+            if len(ctxs) < 2 or not writes:
+                continue
+            if any(_marker(src, ln, "shared")
+                   for src, ln in var.def_sites()):
+                continue
+            spawned = ctxs - {MAIN}
+            suspect = []
+            for w in writes:
+                if var.kind == "attr" \
+                        and getattr(w.fn.node, "name", "") == "__init__":
+                    continue  # under construction: not yet published
+                wctx = self.contexts.get(w.fn.qual, set())
+                if (wctx & spawned) or (w.rmw and spawned):
+                    suspect.append(w)
+            if not suspect:
+                continue
+            # arm-once module-global: None default, every suspect site
+            # either None-guarded or a None reset (disarm)
+            if var.kind == "global" \
+                    and var.name in var.mod.globals_none \
+                    and all(w.armonce or w.assigns_none
+                            for w in suspect):
+                continue
+            guarded = [w for w in suspect if w.lock is not None]
+            bare = [w for w in suspect if w.lock is None]
+            lock_ids = {w.lock for w in guarded}
+            # also credit locks held at NON-suspect (main-side) writes:
+            # consistent discipline is judged across every site
+            all_lock_ids = lock_ids | {
+                w.lock for w in writes if w.lock is not None}
+            ctx_note = ", ".join(sorted(
+                labels.get(c, c) for c in ctxs))
+            for w in bare:
+                if _marker(w.fn.mod.src, w.line, "shared"):
+                    continue
+                if all_lock_ids:
+                    code, what = "THR003", (
+                        f"'{var.display}' is lock-guarded elsewhere "
+                        f"({', '.join(sorted(all_lock_ids))}) but bare "
+                        f"here")
+                elif var.kind == "global":
+                    code, what = "THR001", (
+                        f"unguarded mutation of shared module global "
+                        f"'{var.display}'")
+                else:
+                    code, what = "THR002", (
+                        f"unguarded mutation of shared attribute "
+                        f"'{var.display}'")
+                detail = (f"global:{var.display}"
+                          if var.kind == "global"
+                          else f"attr:{var.cls.qual}.{var.name}")
+                self.findings.append(Finding(
+                    w.fn.mod.src.path, w.line, code,
+                    f"{what} (touched from: {ctx_note}) — hold a lock "
+                    f"or justify with '# corethlint: shared <why>'",
+                    detail))
+            if len(all_lock_ids) > 1 and guarded:
+                w = guarded[-1]
+                detail = (f"global:{var.display}"
+                          if var.kind == "global"
+                          else f"attr:{var.cls.qual}.{var.name}")
+                self.findings.append(Finding(
+                    w.fn.mod.src.path, w.line, "THR004",
+                    f"mutations of '{var.display}' guarded by "
+                    f"DIFFERENT locks "
+                    f"({', '.join(sorted(all_lock_ids))}) — mutual "
+                    f"exclusion in name only",
+                    f"mixedlock:{detail}"))
+
+
+def check_threadsafety(sources: Sequence[Source]) -> List[Finding]:
+    an = _Analyzer(sources)
+    an.index()
+    an.discover_entries()
+    an.build_graph()
+    an.collect_accesses()
+    an.check_vars()
+    return an.findings
