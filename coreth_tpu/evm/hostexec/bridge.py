@@ -47,16 +47,30 @@ PT_DIVERGE = faults.declare(
     "native/oracle_divergence",
     "armed differential oracle reports a native/interpreter divergence")
 
-# The supervisor observing native-scope faults (replay/supervisor.py
-# BackendSupervisor; set by ReplayEngine construction).  Module-level
-# by the same argument as the native session itself: one process, one
-# native library — a backend sick for one engine is sick for all.
+# Fallback supervisor for native-scope faults (replay/supervisor.py
+# BackendSupervisor).  The PRIMARY resolution is per-engine: the
+# engine stamps its supervisor onto its Database
+# (``db.fault_observer``) and ``_observer_for`` reads it back through
+# ``evm.statedb.db`` — so N engines in one process (cluster workers in
+# a test, per-worker supervisors) keep independent strike/demotion
+# ladders instead of sharing one module-global.  The module global
+# remains as the escape hatch for EVMs built without an engine
+# Database and for tests that install a bare observer.
 _OBSERVER = None
 
 
 def set_fault_observer(observer) -> None:
     global _OBSERVER
     _OBSERVER = observer
+
+
+def _observer_for(evm):
+    """The supervisor for THIS evm's engine, else the process global.
+    Per-engine scope rides the Database the engine and every StateDB
+    copy share (statedb.copy() carries .db by reference)."""
+    db = getattr(getattr(evm, "statedb", None), "db", None)
+    obs = getattr(db, "fault_observer", None)
+    return obs if obs is not None else _OBSERVER
 
 
 def counters() -> Dict[str, int]:
@@ -124,7 +138,7 @@ def try_call(evm, caller: bytes, addr: bytes, input_: bytes, gas: int,
     """Native execution of one root call; None -> interpreter path."""
     if _mode() != "native":
         return None
-    obs = _OBSERVER
+    obs = _observer_for(evm)
     if obs is not None and not obs.allows("native"):
         # supervisor demoted the native engine: the interpreter serves
         # until the cooldown lapses (then the next call is the probe)

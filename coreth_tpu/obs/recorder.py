@@ -109,6 +109,11 @@ TR_DEMOTE = declare_trigger(
     "supervisor/hard_demote",
     "a backend was hard-demoted for being WRONG, not slow "
     "(replay/supervisor.py strike(hard=True))")
+TR_BOUNDARY = declare_trigger(
+    "cluster/boundary_mismatch",
+    "cluster aggregator rejected this worker's boundary root and "
+    "demanded its evidence before re-assigning the lane "
+    "(serve/cluster/worker.py _send_bundles)")
 
 
 # THE module global every instrumentation site checks (None = off)

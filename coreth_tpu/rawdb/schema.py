@@ -159,19 +159,33 @@ def read_last_flushed_root(kv: KVStore):
     return raw[:32], int.from_bytes(raw[32:], "big")
 
 
+def replay_checkpoint_key(worker: Optional[str] = None) -> bytes:
+    """The checkpoint-record key, optionally scoped to one cluster
+    lane.  A single-engine store keeps the bare legacy key; a cluster
+    worker writing lane ``w`` records under ``ReplayCheckpoint/w`` can
+    share a store (or a copied seed of one) with other lanes without
+    the records clobbering each other — and a REPLACEMENT worker
+    assigned the same lane resumes from the victim's record by lane
+    id, not by process identity."""
+    if worker is None:
+        return REPLAY_CHECKPOINT_KEY
+    return REPLAY_CHECKPOINT_KEY + b"/" + worker.encode()
+
+
 def write_replay_checkpoint(kv: KVStore, number: int, block_hash: bytes,
-                            root: bytes, header_rlp: bytes) -> None:
+                            root: bytes, header_rlp: bytes,
+                            worker: Optional[str] = None) -> None:
     """The replay-resume record (replay/checkpoint.py): last committed
     block number/hash, the state root the engine trie sits on, and the
     full header RLP (the resumed engine's parent_header — AP4 fee
     validation needs block_gas_cost/time from the REAL parent)."""
-    kv.put(REPLAY_CHECKPOINT_KEY, rlp.encode([
+    kv.put(replay_checkpoint_key(worker), rlp.encode([
         rlp.encode_uint(number), block_hash, root, header_rlp]))
 
 
-def read_replay_checkpoint(kv: KVStore):
+def read_replay_checkpoint(kv: KVStore, worker: Optional[str] = None):
     """(number, block_hash, root, header_rlp) or None."""
-    raw = kv.get(REPLAY_CHECKPOINT_KEY)
+    raw = kv.get(replay_checkpoint_key(worker))
     if raw is None:
         return None
     number, block_hash, root, header_rlp = rlp.decode(raw)

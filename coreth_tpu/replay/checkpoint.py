@@ -54,9 +54,12 @@ class Checkpoint:
     header: Header
 
 
-def load_checkpoint(kv) -> Optional[Checkpoint]:
-    """The durable checkpoint record, or None on a fresh store."""
-    rec = schema.read_replay_checkpoint(kv)
+def load_checkpoint(kv, worker: Optional[str] = None
+                    ) -> Optional[Checkpoint]:
+    """The durable checkpoint record, or None on a fresh store.
+    ``worker`` selects a lane-scoped record (cluster stores hold one
+    record per lane under ``ReplayCheckpoint/<lane>``)."""
+    rec = schema.read_replay_checkpoint(kv, worker)
     if rec is None:
         return None
     number, block_hash, root, header_rlp = rec
@@ -64,7 +67,8 @@ def load_checkpoint(kv) -> Optional[Checkpoint]:
                       header=Header.decode(header_rlp))
 
 
-def resume_engine(config, db, kv, engine_cls=None, **engine_kw):
+def resume_engine(config, db, kv, engine_cls=None, worker=None,
+                  **engine_kw):
     """(engine, checkpoint) resumed from ``kv``'s record, or
     (None, None) when no checkpoint exists (caller starts from
     genesis).  ``db`` must be backed by the same store the crashed run
@@ -75,7 +79,7 @@ def resume_engine(config, db, kv, engine_cls=None, **engine_kw):
     may have written newer entries before the crash — their number
     stamps exclude them), so the resumed engine starts with a warm
     flat layer instead of re-walking the trie cold."""
-    ckpt = load_checkpoint(kv)
+    ckpt = load_checkpoint(kv, worker)
     if ckpt is None:
         return None, None
     if engine_cls is None:
@@ -116,12 +120,17 @@ class CheckpointManager:
     """
 
     def __init__(self, engine, kv, every: int,
-                 background: Optional[bool] = None):
+                 background: Optional[bool] = None,
+                 worker: Optional[str] = None):
         if every <= 0:
             raise ValueError("checkpoint interval must be positive")
         self.engine = engine
         self.kv = kv
         self.every = every
+        # lane scope: records land under ReplayCheckpoint/<worker> so
+        # N cluster lanes can checkpoint into copies of one seed store
+        # without clobbering; None keeps the legacy unscoped key
+        self.worker = worker
         self.written = 0
         self.last_number: Optional[int] = None
         self._since = 0
@@ -143,7 +152,7 @@ class CheckpointManager:
             seed_root = engine.commit()
             flat.mark_preexisting_exported()
             self.exporter = FlatExporter(flat, engine.db, kv,
-                                         seed_root)
+                                         seed_root, worker=worker)
             self.exporter.on_record = self._on_record
             self.exporter.start()
 
@@ -199,7 +208,7 @@ class CheckpointManager:
             # quarantined: the held generation blocks the exporter, so
             # no durable record exists — correctly, since a
             # quarantined tip is not finalized)
-            return load_checkpoint(self.kv)
+            return load_checkpoint(self.kv, self.worker)
         t0 = time.monotonic_ns()
         try:
             with obs.span("checkpoint/write_sync"):
@@ -222,7 +231,8 @@ class CheckpointManager:
         self.kv.flush()
         faults.fire(PT_CRASH_GAP)
         schema.write_replay_checkpoint(
-            self.kv, header.number, header.hash(), root, header.encode())
+            self.kv, header.number, header.hash(), root, header.encode(),
+            worker=self.worker)
         self.kv.flush()
         self.written += 1
         self.last_number = header.number

@@ -817,11 +817,13 @@ class ReplayEngine:
         })
         from coreth_tpu.replay.supervisor import BackendSupervisor
         self.supervisor = BackendSupervisor(self)
-        # the hostexec bridge consults the newest engine's supervisor
-        # for native-scope routing (module-level by the same argument
-        # as the native session itself: one process, one native lib)
-        from coreth_tpu.evm.hostexec import bridge as _hx_bridge
-        _hx_bridge.set_fault_observer(self.supervisor)
+        # the hostexec bridge resolves its fault observer PER ENGINE
+        # through the Database every StateDB of this engine shares
+        # (bridge._observer_for) — N engines in one process (cluster
+        # workers, per-worker supervisors in a test harness) keep
+        # independent native demotion ladders instead of the last
+        # constructor winning a module global
+        self.db.fault_observer = self.supervisor
 
     # ---------------------------------------------------------------- index
     def _flat_view(self):

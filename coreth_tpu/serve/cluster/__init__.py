@@ -1,0 +1,38 @@
+"""corethcluster: multi-process sharded serving over the streaming
+pipeline.
+
+A coordinator range-partitions the chain feed across worker
+subprocesses (each running the existing ``StreamingPipeline`` over a
+contiguous block range from its own seeded store), federates their
+``/report``/``/metrics`` into one cluster view, verifies every
+boundary root against the successor lane's seed root, and — on worker
+death or a root mismatch — re-assigns the failed range to a healthy
+worker resuming from the lane's last ``ReplayCheckpoint/<lane>``
+record.  See README "Distributed serving".
+"""
+
+from coreth_tpu.serve.cluster.bootstrap import (
+    LaneSeed, bootstrap_stores, open_store, partition_ranges,
+    write_seed_record,
+)
+from coreth_tpu.serve.cluster.coordinator import (
+    ClusterCoordinator, LaneState, WorkerHandle, plan_reassignments,
+)
+from coreth_tpu.serve.cluster.protocol import (
+    MAX_FRAME, ProtocolError, VERBS, decode_frame, encode_frame,
+    recv_msg, send_msg,
+)
+
+# NOTE: coreth_tpu.serve.cluster.worker is deliberately NOT imported
+# here — it is the `python -m` entry point workers run under, and
+# importing it from the package __init__ would double-execute it
+# through runpy.  Import it directly where needed.
+
+__all__ = [
+    "LaneSeed", "bootstrap_stores", "open_store", "partition_ranges",
+    "write_seed_record",
+    "ClusterCoordinator", "LaneState", "WorkerHandle",
+    "plan_reassignments",
+    "MAX_FRAME", "ProtocolError", "VERBS", "decode_frame",
+    "encode_frame", "recv_msg", "send_msg",
+]

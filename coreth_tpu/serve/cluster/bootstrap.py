@@ -1,0 +1,137 @@
+"""Lane stores: partitioning and seed bootstrap for the cluster.
+
+A worker can only execute its contiguous block range if it owns the
+chain state at the range's start — and the recovery protocol
+(coordinator re-assigning a failed range) requires that state to be a
+*resumable checkpoint record*, not an in-memory engine.  So every
+lane, including lane 0, starts life the same way: ``resume_engine``
+from a lane-scoped ``ReplayCheckpoint/<lane>`` record in its own
+disk-backed store.
+
+``bootstrap_stores`` produces those stores with ONE sequential pass:
+a disk-backed engine replays the chain, and at each lane boundary it
+flushes the commit pipeline, persists the trie nodes, writes the
+lane's scoped record (the PR-10 write order: nodes durable before the
+record), and snapshots the append-only KV log into the lane's
+directory.  Total cost = one full replay + one file copy per lane —
+the warm-start path a real serving cluster gets from state sync
+(ROADMAP direction 5); the bench times only the parallel phase.
+
+The boundary roots fall out for free: lane ``i``'s seed root IS the
+root lane ``i-1`` must finish on — the aggregator's verification
+chain — and the headers pin them independently (``generate_chain``
+executed every block, so ``blocks[start-1].header.root`` is the
+single-engine truth).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+
+@dataclass
+class LaneSeed:
+    """One lane's assignment coordinates: the store seeded at block
+    ``start`` (its scoped checkpoint record included), covering the
+    half-open block-number range ``(start, end]``."""
+
+    lane: str
+    start: int
+    end: int
+    root: bytes
+    db_dir: str
+
+
+def partition_ranges(n_blocks: int, n_lanes: int
+                     ) -> List[Tuple[int, int]]:
+    """Contiguous ``(start, end]`` block-number ranges covering
+    ``1..n_blocks``; earlier lanes absorb the remainder so sizes
+    differ by at most one."""
+    if n_lanes <= 0:
+        raise ValueError("need at least one lane")
+    n_lanes = min(n_lanes, n_blocks)
+    base, extra = divmod(n_blocks, n_lanes)
+    ranges = []
+    start = 0
+    for i in range(n_lanes):
+        end = start + base + (1 if i < extra else 0)
+        ranges.append((start, end))
+        start = end
+    return ranges
+
+
+def open_store(db_dir: str, create: bool = False):
+    """(kv, db) over ``db_dir``'s append-only chain.db — the
+    disk-backed Database shape every checkpoint/resume test uses
+    (FileDB + PersistentNodeDict/PersistentCodeDict)."""
+    from coreth_tpu.rawdb.kv import FileDB
+    from coreth_tpu.rawdb.state_manager import (
+        PersistentCodeDict, PersistentNodeDict,
+    )
+    from coreth_tpu.state import Database
+    if create:
+        os.makedirs(db_dir, exist_ok=True)
+    kv = FileDB(os.path.join(db_dir, "chain.db"))
+    db = Database(node_db=PersistentNodeDict(kv),
+                  code_db=PersistentCodeDict(kv))
+    return kv, db
+
+
+def write_seed_record(engine, kv, lane: str) -> bytes:
+    """Persist the engine's current committed state as ``lane``'s
+    resumable record (nodes -> kv -> record, the crash-consistency
+    write order).  Returns the recorded root."""
+    from coreth_tpu.rawdb import schema
+    engine.commit_pipe.flush()
+    root = engine.commit()
+    node_db = engine.db.node_db
+    if hasattr(node_db, "flush"):
+        node_db.flush()
+    kv.flush()
+    header = engine.parent_header
+    schema.write_replay_checkpoint(
+        kv, header.number, header.hash(), root, header.encode(),
+        worker=lane)
+    kv.flush()
+    return root
+
+
+def bootstrap_stores(config, genesis, blocks, ranges, base_dir: str,
+                     lane_prefix: str = "lane",
+                     engine_kw: Optional[dict] = None) -> List[LaneSeed]:
+    """Seed one store per range with a single sequential replay (see
+    module docstring).  ``blocks[j]`` must carry block number ``j+1``
+    (the generate_chain invariant every harness chain satisfies)."""
+    from coreth_tpu.replay import ReplayEngine
+    engine_kw = engine_kw or {}
+    seed_dir = os.path.join(base_dir, "_bootstrap")
+    kv, db = open_store(seed_dir, create=True)
+    seeds: List[LaneSeed] = []
+    try:
+        gblock = genesis.to_block(db)
+        eng = ReplayEngine(config, db, gblock.root,
+                           parent_header=gblock.header, **engine_kw)
+        done = 0
+        for i, (start, end) in enumerate(ranges):
+            if start > done:
+                eng.replay(blocks[done:start])
+                done = start
+            lane = f"{lane_prefix}{i}"
+            root = write_seed_record(eng, kv, lane)
+            want = gblock.header.root if start == 0 \
+                else blocks[start - 1].header.root
+            assert root == want, (
+                f"bootstrap root diverged at block {start}: "
+                f"{root.hex()} != {want.hex()}")
+            lane_dir = os.path.join(base_dir, lane)
+            os.makedirs(lane_dir, exist_ok=True)
+            shutil.copyfile(os.path.join(seed_dir, "chain.db"),
+                            os.path.join(lane_dir, "chain.db"))
+            seeds.append(LaneSeed(lane=lane, start=start, end=end,
+                                  root=root, db_dir=lane_dir))
+    finally:
+        kv.close()
+    return seeds

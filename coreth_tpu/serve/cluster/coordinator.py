@@ -1,0 +1,605 @@
+"""Cluster coordinator: range assignment, health, and the
+root-verifying aggregator.
+
+The coordinator owns the lane table (one contiguous block range per
+lane, each with a seeded store from ``bootstrap_stores``), a pool of
+worker connections, and the cluster's single source of truth about
+progress.  Control flow is deliberately single-threaded: per-socket
+reader threads do nothing but push decoded frames into one queue
+(the blessed handoff), and the ``run`` loop is the only writer of
+cluster state — assignment, health, verification, and recovery are
+sequential decisions over an ordered message stream.
+
+Verification is the aggregator's job and is structural, not trusted:
+lane ``i``'s reported boundary root must equal lane ``i+1``'s seed
+root (``bootstrap_stores`` recorded the whole chain), and the last
+lane must land on ``expected_tip``.  A mismatch does NOT immediately
+re-assign — the coordinator first demands the offending worker's
+forensics bundles (``drain {bundle: true}``), records the bundle
+paths as evidence, and only then returns the lane to the pending
+pool.  Worker death (process exit, socket EOF) and heartbeat
+silence re-assign directly: the lane's scoped checkpoint record
+(``ReplayCheckpoint/<lane>`` in the lane's own store) is the recovery
+horizon, so the replacement resumes exactly where the victim's last
+durable record closed — the PR-10/11 record-implies-closure protocol
+doing double duty as a handoff protocol.
+
+Fault points (coreth_tpu/faults):
+
+- ``cluster/worker_crash``: the health pass SIGKILLs the first
+  running worker when armed — the injected-kill shape the handoff
+  test and the bench recovery probe use.
+- ``cluster/reassign_race``: fires between picking a replacement
+  worker and sending the assign — the lost-assignment window; the
+  coordinator counts it and re-picks on the next pass instead of
+  leaving the lane orphaned.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time  # noqa: DET003 — control-plane deadlines/heartbeat ages only, never consensus data
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from coreth_tpu import faults, obs
+from coreth_tpu.metrics import Counter, Registry, get_or_register
+from coreth_tpu.obs.server import maybe_start_from_env
+from coreth_tpu.serve.cluster import protocol
+from coreth_tpu.serve.cluster.bootstrap import LaneSeed
+
+PT_WORKER_CRASH = faults.declare(
+    "cluster/worker_crash",
+    "coordinator health pass SIGKILLs a running worker (injected "
+    "worker death; serve/cluster/coordinator.py _health_check)")
+PT_REASSIGN_RACE = faults.declare(
+    "cluster/reassign_race",
+    "fires between picking a replacement worker and sending assign "
+    "(lost-assignment window; serve/cluster/coordinator.py "
+    "_assign_pending)")
+
+_COUNTERS = (
+    "cluster/assigned", "cluster/reassigned", "cluster/worker_crash",
+    "cluster/heartbeat_loss", "cluster/boundary_mismatch",
+    "cluster/reassign_race", "cluster/checkpoint_advance",
+    "cluster/lanes_done",
+)
+
+
+@dataclass
+class LaneState:
+    """One contiguous block range and everything the aggregator knows
+    about it.  ``status`` walks pending -> running -> done, detouring
+    through awaiting_bundle on a root mismatch."""
+
+    lane: str
+    start: int
+    end: int
+    db_dir: str
+    seed_root: bytes
+    status: str = "pending"
+    worker: Optional[str] = None
+    history: List[str] = field(default_factory=list)
+    resumed_from: Optional[int] = None
+    last_checkpoint: Optional[int] = None
+    last_heartbeat: Optional[float] = None
+    committed: int = 0
+    txs: int = 0
+    root: Optional[bytes] = None
+    report: Optional[dict] = None
+    metrics: Optional[dict] = None
+    failures: int = 0
+    bundles: List[str] = field(default_factory=list)
+    recovered_t: Optional[float] = None
+
+
+class WorkerHandle:
+    """One worker connection as the coordinator sees it.  ``proc`` is
+    the spawned subprocess (None for fakes and externally-launched
+    workers); ``closed`` flips when the reader thread sees EOF."""
+
+    def __init__(self, conn=None, proc=None, worker_id: Optional[str] = None):
+        self.id = worker_id
+        self.conn = conn
+        self.proc = proc
+        self.lane: Optional[str] = None
+        self.closed = False
+        self.drained = False
+
+    def send(self, msg: dict) -> None:
+        protocol.send_msg(self.conn, msg)
+
+    def alive(self) -> bool:
+        if self.closed or self.drained:
+            return False
+        if self.proc is not None and self.proc.poll() is not None:
+            return False
+        return True
+
+    def kill(self) -> None:
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        self.closed = True
+
+
+def plan_reassignments(dead_lanes: List[LaneState],
+                       idle_workers: List[WorkerHandle]
+                       ) -> List[Tuple[LaneState, WorkerHandle]]:
+    """Deterministic pairing for a recovery epoch: lanes ordered by
+    range start meet workers ordered by id, one lane per worker.
+    Leftover lanes wait for the next pass — double-booking a worker
+    would serialize on its socket anyway and muddy the lane/worker
+    ownership the health pass depends on."""
+    lanes = sorted(dead_lanes, key=lambda l: l.start)
+    workers = sorted(idle_workers, key=lambda w: w.id or "")
+    return list(zip(lanes, workers))
+
+
+def _default_spawn(worker_id: str, host: str, port: int,
+                   extra_env: Optional[dict] = None):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "coreth_tpu.serve.cluster.worker",
+         "--connect", f"{host}:{port}", "--worker", worker_id],
+        env=env)
+
+
+class ClusterCoordinator:
+    """Assignment + health + aggregation over one lane table.
+
+    ``spawn``/``clock`` are injectable so the timeout and
+    re-assignment policies are unit-testable with fake handles and a
+    stepped clock (tests/test_cluster.py); production uses subprocess
+    workers dialing back over loopback.
+    """
+
+    def __init__(self, seeds: List[LaneSeed], chain_path: str,
+                 config: str = "test",
+                 expected_tip: Optional[bytes] = None,
+                 engine_kw: Optional[dict] = None,
+                 feed_rate: Optional[float] = None,
+                 checkpoint_every: Optional[int] = None,
+                 heartbeat_timeout: Optional[float] = None,
+                 max_failures: int = 3,
+                 spawn: Optional[Callable] = None,
+                 worker_env: Optional[Dict[str, dict]] = None,
+                 clock=time.monotonic,
+                 registry: Optional[Registry] = None):
+        ordered = sorted(seeds, key=lambda s: s.start)
+        self.lanes: Dict[str, LaneState] = {
+            s.lane: LaneState(lane=s.lane, start=s.start, end=s.end,
+                              db_dir=s.db_dir, seed_root=s.root)
+            for s in ordered}
+        # the verification chain: lane i must finish on lane i+1's
+        # seed root; the tail is pinned by expected_tip when given
+        self._expected: Dict[str, bytes] = {}
+        for a, b in zip(ordered, ordered[1:]):
+            self._expected[a.lane] = b.root
+        if expected_tip is not None:
+            self._expected[ordered[-1].lane] = expected_tip
+        self.chain_path = chain_path
+        self.config = config
+        self.engine_kw = engine_kw or {}
+        self.feed_rate = feed_rate
+        self.checkpoint_every = checkpoint_every
+        self.heartbeat_timeout = heartbeat_timeout if heartbeat_timeout \
+            is not None else float(os.environ.get(
+                "CORETH_CLUSTER_HEARTBEAT_TIMEOUT_S", "5"))
+        self.max_failures = max_failures
+        self._spawn = spawn or _default_spawn
+        self._worker_env = worker_env or {}
+        self._clock = clock
+        self._registry = registry if registry is not None else Registry()
+        self._ctr = {name: get_or_register(name, Counter,
+                                           self._registry)
+                     for name in _COUNTERS}
+        self.workers: Dict[str, WorkerHandle] = {}
+        self._procs: Dict[str, object] = {}
+        self._msgs: "queue.Queue" = queue.Queue()
+        # the run loop is the only state writer; the lock exists for
+        # the telemetry report thread reading a consistent view
+        self._mu = threading.Lock()
+        self.events: List[dict] = []
+        self._expect_workers = 0
+        self._t0: Optional[float] = None
+        self._listener: Optional[socket.socket] = None
+        self._telemetry = None
+        self.port: Optional[int] = None
+
+    # --------------------------------------------------------- lifecycle
+    def start(self, n_workers: Optional[int] = None) -> int:
+        """Listen, spawn the worker pool, return the control port.
+        Registration completes when each worker's hello arrives in the
+        run loop — assignment never races the handshake."""
+        n = n_workers if n_workers is not None else int(
+            os.environ.get("CORETH_CLUSTER_WORKERS", "2"))
+        self._expect_workers = n
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.bind(("127.0.0.1", int(
+            os.environ.get("CORETH_CLUSTER_PORT", "0"))))
+        self._listener.listen(max(n, 1))
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop,
+                         name="cluster-accept", daemon=True).start()
+        for i in range(n):
+            wid = f"w{i}"
+            # "*" env applies to every worker; per-id entries layer on
+            # top (the handoff test arms a fault plan in ONE victim)
+            env = dict(self._worker_env.get("*", {}))
+            env.update(self._worker_env.get(wid, {}))
+            proc = self._spawn(wid, "127.0.0.1", self.port,
+                               env or None)
+            if proc is not None:
+                self._procs[wid] = proc
+        return self.port
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed: shutdown
+            handle = WorkerHandle(conn=conn)
+            threading.Thread(target=self._reader, args=(handle,),
+                             name="cluster-reader",
+                             daemon=True).start()
+
+    def _reader(self, handle: WorkerHandle) -> None:
+        buf = bytearray()
+        try:
+            while True:
+                msg = protocol.recv_msg(handle.conn, buf)
+                if msg is None:
+                    break
+                self._msgs.put((handle, msg))
+        except (protocol.ProtocolError, OSError):
+            pass
+        self._msgs.put((handle, None))  # EOF sentinel
+
+    # --------------------------------------------------------- main loop
+    def run(self, deadline_s: Optional[float] = None) -> dict:
+        """Drive the cluster to completion; returns :meth:`summary`.
+        Raises TimeoutError past the deadline and RuntimeError when a
+        lane burns through ``max_failures`` recoveries."""
+        deadline_s = deadline_s if deadline_s is not None else float(
+            os.environ.get("CORETH_CLUSTER_DEADLINE_S", "300"))
+        self._t0 = self._clock()
+        self._telemetry = maybe_start_from_env(
+            registry=self._registry, report=self._cluster_report)
+        try:
+            while not self._done():
+                if self._clock() - self._t0 > deadline_s:
+                    raise TimeoutError(
+                        f"cluster missed deadline {deadline_s}s: "
+                        f"{self._status_line()}")
+                self._drain_messages()
+                self._assign_pending()
+                self._health_check()
+        finally:
+            self._shutdown()
+        return self.summary()
+
+    def _done(self) -> bool:
+        return all(l.status == "done" for l in self.lanes.values())
+
+    def _status_line(self) -> str:
+        return " ".join(f"{l.lane}={l.status}"
+                        for l in self.lanes.values())
+
+    # --------------------------------------------------------- messages
+    def _drain_messages(self, timeout: float = 0.05) -> None:
+        try:
+            handle, msg = self._msgs.get(timeout=timeout)
+        except queue.Empty:
+            return
+        while True:
+            self._dispatch(handle, msg)
+            try:
+                handle, msg = self._msgs.get_nowait()
+            except queue.Empty:
+                return
+
+    def _dispatch(self, handle: WorkerHandle,
+                  msg: Optional[dict]) -> None:
+        if msg is None:
+            handle.closed = True  # EOF; the health pass decides
+            return
+        verb = msg["verb"]
+        if verb == "hello":
+            wid = msg["worker"]
+            handle.id = wid
+            handle.proc = self._procs.get(wid, handle.proc)
+            with self._mu:
+                self.workers[wid] = handle
+            return
+        lane = self.lanes.get(msg.get("lane") or "")
+        if verb == "heartbeat" and lane is not None:
+            with self._mu:
+                lane.last_heartbeat = self._clock()
+                lane.committed = msg.get("committed", 0)
+                lane.txs = msg.get("txs", 0)
+                if (len(lane.history) > 1 and lane.recovered_t is None
+                        and lane.committed > 0):
+                    # first post-recovery progress: the bench's
+                    # recovery-time probe reads this event
+                    lane.recovered_t = self._clock() - self._t0
+                    self.events.append({
+                        "event": "first_commit_after_recovery",
+                        "lane": lane.lane, "t": lane.recovered_t})
+        elif verb == "checkpoint_advance" and lane is not None:
+            with self._mu:
+                lane.last_checkpoint = msg["number"]
+            self._ctr["cluster/checkpoint_advance"].inc()
+        elif verb == "boundary_root" and lane is not None:
+            self._on_boundary(handle, lane, msg)
+        elif verb == "bundle" and lane is not None:
+            with self._mu:
+                lane.bundles.extend(msg.get("paths") or [])
+                lane.status = "pending"
+                lane.worker = None
+            self.events.append({"event": "bundle_received",
+                                "lane": lane.lane,
+                                "worker": msg.get("worker"),
+                                "paths": msg.get("paths") or [],
+                                "t": self._now()})
+        elif verb == "error":
+            self.events.append({"event": "worker_error",
+                                "worker": msg.get("worker"),
+                                "lane": msg.get("lane"),
+                                "reason": msg.get("reason"),
+                                "t": self._now()})
+
+    def _on_boundary(self, handle: WorkerHandle, lane: LaneState,
+                     msg: dict) -> None:
+        root = bytes.fromhex(msg["root"])
+        want = self._expected.get(lane.lane)
+        with self._mu:
+            lane.resumed_from = msg.get("resumed_from")
+            lane.report = msg.get("report")
+            lane.metrics = msg.get("metrics")
+        if want is not None and root != want:
+            self._ctr["cluster/boundary_mismatch"].inc()
+            self.events.append({"event": "boundary_mismatch",
+                                "lane": lane.lane, "worker": handle.id,
+                                "got": root.hex(), "want": want.hex(),
+                                "t": self._now()})
+            with self._mu:
+                lane.failures += 1
+                lane.status = "awaiting_bundle"
+            # evidence before recovery: the worker must surrender its
+            # forensics bundles, then drain (it exits; a mismatching
+            # worker never gets another lane)
+            handle.drained = True
+            handle.lane = None
+            try:
+                handle.send({"verb": "drain", "bundle": True,
+                             "lane": lane.lane,
+                             "reason": f"boundary mismatch on "
+                                       f"{lane.lane}: got "
+                                       f"{root.hex()[:16]}.. want "
+                                       f"{want.hex()[:16]}.."})
+            except OSError:
+                # worker already gone; recover without the evidence
+                with self._mu:
+                    lane.status = "pending"
+                    lane.worker = None
+            return
+        with self._mu:
+            lane.root = root
+            lane.status = "done"
+            lane.worker = None
+            # the boundary report is the authoritative final count —
+            # a short lane can finish between heartbeat ticks, leaving
+            # the heartbeat-fed fields at zero
+            lane.committed = msg.get("blocks", lane.committed)
+            rep = msg.get("report") or {}
+            lane.txs = rep.get("txs", lane.txs)
+        if len(lane.history) > 1 and lane.recovered_t is None:
+            # a completed lane certainly made its first post-recovery
+            # commit; don't let a sub-heartbeat-period remainder hide
+            # the event the bench recovery probe reads
+            lane.recovered_t = self._now()
+            self.events.append({"event": "first_commit_after_recovery",
+                                "lane": lane.lane,
+                                "t": lane.recovered_t})
+        handle.lane = None
+        self._ctr["cluster/lanes_done"].inc()
+
+    # --------------------------------------------------------- policies
+    def _assign_pending(self) -> None:
+        pending = [l for l in self.lanes.values()
+                   if l.status == "pending"]
+        if not pending:
+            return
+        if (len(self.workers) < self._expect_workers
+                and not any(l.history for l in self.lanes.values())
+                and self._now() < self.heartbeat_timeout):
+            # hold the FIRST epoch until the whole spawned pool has
+            # said hello (bounded by the heartbeat grace): assignment
+            # is then a deterministic lanes-by-start x workers-by-id
+            # pairing instead of a hello race.  Recovery epochs never
+            # wait — a shrunken pool is exactly when re-assignment
+            # must go to whoever is left
+            return
+        hopeless = [l for l in pending
+                    if l.failures > self.max_failures]
+        if hopeless:
+            raise RuntimeError(
+                f"lane {hopeless[0].lane} failed "
+                f"{hopeless[0].failures} times; halting cluster")
+        idle = [w for w in self.workers.values()
+                if w.lane is None and w.alive()]
+        for lane, worker in plan_reassignments(pending, idle):
+            # the lost-assignment window: a crash here must not
+            # orphan the lane
+            try:
+                faults.fire(PT_REASSIGN_RACE)
+            except faults.FaultInjected:
+                self._ctr["cluster/reassign_race"].inc()
+                self.events.append({"event": "reassign_race",
+                                    "lane": lane.lane,
+                                    "t": self._now()})
+                continue  # re-pick next pass
+            self._send_assign(lane, worker)
+
+    def _send_assign(self, lane: LaneState,
+                     worker: WorkerHandle) -> None:
+        with obs.span("cluster/assign", flow=lane.start + 1,
+                      lane=lane.lane, worker=worker.id):
+            worker.send({
+                "verb": "assign", "lane": lane.lane,
+                "start": lane.start, "end": lane.end,
+                "db_dir": lane.db_dir, "chain": self.chain_path,
+                "config": self.config, "engine": self.engine_kw,
+                "feed_rate": self.feed_rate,
+                "checkpoint_every": self.checkpoint_every,
+            })
+        with self._mu:
+            lane.status = "running"
+            lane.worker = worker.id
+            lane.history.append(worker.id)
+            # the heartbeat grace period starts at assignment, not at
+            # the worker's first tick — resume + chain decode take time
+            lane.last_heartbeat = self._clock()
+        worker.lane = lane.lane
+        self._ctr["cluster/assigned"].inc()
+        if len(lane.history) > 1:
+            self._ctr["cluster/reassigned"].inc()
+            self.events.append({"event": "reassigned",
+                                "lane": lane.lane,
+                                "worker": worker.id,
+                                "resume_floor": lane.last_checkpoint,
+                                "t": self._now()})
+
+    def _health_check(self) -> None:
+        spec = faults.check(PT_WORKER_CRASH)
+        if spec is not None:
+            running = sorted((w for w in self.workers.values()
+                              if w.lane is not None and w.alive()),
+                             key=lambda w: w.id or "")
+            if running:
+                victim = running[0]
+                self.events.append({"event": "injected_kill",
+                                    "worker": victim.id,
+                                    "lane": victim.lane,
+                                    "t": self._now()})
+                victim.kill()
+        now = self._clock()
+        for worker in sorted(self.workers.values(),
+                             key=lambda w: w.id or ""):
+            if worker.lane is None:
+                continue
+            lane = self.lanes[worker.lane]
+            if not worker.alive():
+                self._ctr["cluster/worker_crash"].inc()
+                self.events.append({"event": "worker_crash",
+                                    "worker": worker.id,
+                                    "lane": lane.lane,
+                                    "resume_floor": lane.last_checkpoint,
+                                    "t": self._now()})
+                self._recover(lane, worker)
+            elif (lane.last_heartbeat is not None
+                  and now - lane.last_heartbeat
+                  > self.heartbeat_timeout):
+                self._ctr["cluster/heartbeat_loss"].inc()
+                self.events.append({"event": "heartbeat_loss",
+                                    "worker": worker.id,
+                                    "lane": lane.lane,
+                                    "silent_s": now - lane.last_heartbeat,
+                                    "t": self._now()})
+                worker.kill()  # fence the silent worker before reassigning its lane
+                self._recover(lane, worker)
+
+    def _recover(self, lane: LaneState, worker: WorkerHandle) -> None:
+        """Return a dead worker's lane to the pending pool.  The
+        lane's scoped checkpoint record in its own store IS the
+        handoff state — nothing to copy, the next assignee resumes
+        from it."""
+        worker.lane = None
+        with self._mu:
+            self.workers.pop(worker.id, None)
+            lane.failures += 1
+            lane.status = "pending"
+            lane.worker = None
+
+    # --------------------------------------------------------- shutdown
+    def _shutdown(self) -> None:
+        for worker in list(self.workers.values()):
+            if worker.alive():
+                try:
+                    worker.send({"verb": "drain", "bundle": False})
+                except OSError:
+                    pass
+        for proc in self._procs.values():
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — best-effort teardown; a wedged worker must not hang the coordinator
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for worker in self.workers.values():
+            if worker.conn is not None:
+                try:
+                    worker.conn.close()
+                except OSError:
+                    pass
+        if self._telemetry is not None:
+            self._telemetry.stop()
+
+    # --------------------------------------------------------- reporting
+    def _now(self) -> float:
+        return (self._clock() - self._t0) if self._t0 is not None \
+            else 0.0
+
+    def _cluster_report(self) -> dict:  # corethlint: thread telemetry-report
+        """Federated /report: the cluster view plus every lane's own
+        StreamReport row (stage breakdowns intact)."""
+        with self._mu:
+            return self.summary()
+
+    def summary(self) -> dict:
+        lanes = sorted(self.lanes.values(), key=lambda l: l.start)
+        verified = all(
+            l.status == "done"
+            and (self._expected.get(l.lane) is None
+                 or l.root == self._expected[l.lane])
+            for l in lanes)
+        return {
+            "lanes": [{
+                "lane": l.lane, "start": l.start, "end": l.end,
+                "status": l.status, "worker": l.worker,
+                "history": list(l.history),
+                "resumed_from": l.resumed_from,
+                "last_checkpoint": l.last_checkpoint,
+                "committed": l.committed, "txs": l.txs,
+                "failures": l.failures,
+                "root": l.root.hex() if l.root else None,
+                "seed_root": l.seed_root.hex(),
+                "bundles": list(l.bundles),
+                "report": l.report, "metrics": l.metrics,
+            } for l in lanes],
+            "verified": verified,
+            "final_root": lanes[-1].root.hex()
+            if lanes and lanes[-1].root else None,
+            "blocks": sum(l.committed for l in lanes),
+            "txs": sum(l.txs for l in lanes),
+            "events": list(self.events),
+            "counters": self._registry.snapshot(),
+            "wall_s": self._now(),
+        }

@@ -1,0 +1,110 @@
+"""Length-prefixed JSON control protocol between coordinator and
+workers.
+
+One frame = 4-byte big-endian payload length + a UTF-8 JSON object.
+Every message carries a ``verb`` (the dispatch key) and, from workers,
+a ``worker`` id.  The codec is split from the socket helpers so the
+framing edge cases — truncation, oversized frames, unknown verbs — are
+unit-testable on plain bytes (tests/test_cluster.py).
+
+Verbs (the whole vocabulary; anything else is a protocol error):
+
+  coordinator -> worker
+    assign    {lane, start, end, db_dir, chain, ...engine/feed knobs}
+    drain     {bundle: bool}  — finish up; bundle=True demands the
+              worker's forensics bundles first (root-mismatch path)
+
+  worker -> coordinator
+    hello     {worker, pid}
+    heartbeat {worker, lane, committed, txs}
+    checkpoint_advance {worker, lane, number}   — a durable record
+    boundary_root {worker, lane, root, resumed_from, report, metrics}
+    bundle    {worker, lane, paths}
+    error     {worker, reason}
+
+Values that must survive JSON round-trips as bytes (roots, hashes)
+travel hex-encoded; the payload stays printable and the frame length
+bounds decompression-free parsing.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+_LEN = struct.Struct(">I")
+
+# A control message is coordination metadata (ids, block numbers, hex
+# roots, counter snapshots) — far below this.  Anything larger is a
+# corrupt or hostile frame and must be rejected before allocation.
+MAX_FRAME = 8 << 20
+
+VERBS = frozenset({
+    "assign", "drain",
+    "hello", "heartbeat", "checkpoint_advance", "boundary_root",
+    "bundle", "error",
+})
+
+
+class ProtocolError(Exception):
+    """A frame that can never become a valid message (oversized,
+    non-JSON, missing/unknown verb, torn mid-frame EOF)."""
+
+
+def encode_frame(msg: dict) -> bytes:
+    """One wire frame for ``msg``; validates the verb on the way out
+    so a coordinator bug surfaces at the sender, not as a peer's
+    ProtocolError."""
+    verb = msg.get("verb")
+    if verb not in VERBS:
+        raise ProtocolError(f"unknown verb {verb!r}")
+    payload = json.dumps(msg, separators=(",", ":")).encode()
+    if len(payload) > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {len(payload)} bytes")
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_frame(buf: bytes) -> Tuple[Optional[dict], bytes]:
+    """(message, remainder) from the head of ``buf``; (None, buf) while
+    the frame is still incomplete (truncation is not an error — more
+    bytes may arrive).  Raises ProtocolError for frames that can never
+    become valid."""
+    if len(buf) < _LEN.size:
+        return None, buf
+    (n,) = _LEN.unpack_from(buf)
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {n} bytes")
+    if len(buf) < _LEN.size + n:
+        return None, buf
+    raw, rest = buf[_LEN.size:_LEN.size + n], buf[_LEN.size + n:]
+    try:
+        msg = json.loads(raw.decode())
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ProtocolError(f"bad frame payload: {exc}") from exc
+    if not isinstance(msg, dict) or msg.get("verb") not in VERBS:
+        raise ProtocolError(
+            f"unknown verb {msg.get('verb') if isinstance(msg, dict) else msg!r}")
+    return msg, rest
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    sock.sendall(encode_frame(msg))
+
+
+def recv_msg(sock: socket.socket, buf: bytearray) -> Optional[dict]:
+    """Next message from ``sock``, consuming ``buf`` (the caller-owned
+    reassembly buffer) first.  None on clean EOF at a frame boundary;
+    ProtocolError on EOF mid-frame (a torn peer)."""
+    while True:
+        msg, rest = decode_frame(bytes(buf))
+        if msg is not None:
+            del buf[:len(buf) - len(rest)]
+            return msg
+        chunk = sock.recv(65536)
+        if not chunk:
+            if buf:
+                raise ProtocolError("EOF mid-frame")
+            return None
+        buf.extend(chunk)

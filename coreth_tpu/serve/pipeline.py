@@ -144,7 +144,8 @@ class StreamingPipeline:
                  registry=None,
                  quarantine: bool = True,
                  quarantine_limit: int = 8,
-                 checkpoint_every: Optional[int] = None):
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_worker: Optional[str] = None):
         faults.arm_from_env()  # CORETH_FAULT_PLAN (idempotent)
         obs.arm_from_env()     # CORETH_TRACE=1 (idempotent)
         forensics.arm_from_env()  # CORETH_FORENSICS=1 (idempotent)
@@ -170,8 +171,12 @@ class StreamingPipeline:
         ckpt_kv = getattr(engine.db.node_db, "kv", None)
         if checkpoint_every > 0 and ckpt_kv is not None:
             from coreth_tpu.replay.checkpoint import CheckpointManager
+            # checkpoint_worker scopes the record key to a cluster
+            # lane (serve/cluster): N lanes checkpoint without
+            # clobbering, and a replacement worker resumes by lane id
             self._ckpt = CheckpointManager(engine, ckpt_kv,
-                                           checkpoint_every)
+                                           checkpoint_every,
+                                           worker=checkpoint_worker)
         self._expect_number: Optional[int] = None
         self._q_feed: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._q_exec: "queue.Queue" = queue.Queue(maxsize=self.depth)

@@ -85,10 +85,14 @@ class FlatExporter:
 
     DURABLE_RETRIES = 3
 
-    def __init__(self, flat: FlatStore, db, kv, start_root: bytes):
+    def __init__(self, flat: FlatStore, db, kv, start_root: bytes,
+                 worker: Optional[str] = None):
         self.flat = flat
         self.db = db
         self.kv = kv
+        # lane scope for the checkpoint record (cluster workers write
+        # ReplayCheckpoint/<lane>); None = the legacy unscoped key
+        self.worker = worker
         # shadow account trie + lazily-opened per-contract storage
         # tries over the SAME node store the engine commits into, so
         # the start root's closure is readable.  The fold itself runs
@@ -265,7 +269,7 @@ class FlatExporter:
             schema.write_flat_meta(self.kv, gen.number, gen.root)
             schema.write_replay_checkpoint(
                 self.kv, gen.number, gen.block_hash, gen.root,
-                gen.header.encode())
+                gen.header.encode(), worker=self.worker)
             self.kv.flush()
             with self._mu:
                 self.records += 1

@@ -1,0 +1,388 @@
+"""serve/cluster unit layer: framing, heartbeat policy, and the
+coordinator's recovery decisions — all without subprocesses.
+
+Three surfaces:
+
+1. the control protocol codec (protocol.py): truncation is not an
+   error, oversized/unparseable/unknown-verb frames are, and the
+   socket helpers reassemble split frames and distinguish clean EOF
+   from a torn peer;
+2. the worker's HeartbeatSender with an armed ``cluster/
+   heartbeat_loss`` fault: sends are DROPPED while the worker stays
+   alive, and durable checkpoint advances piggyback on the next
+   successful tick;
+3. the coordinator's policies with fake WorkerHandles and a stepped
+   clock: heartbeat-timeout detection, dead-worker detection (and the
+   armed ``cluster/worker_crash`` injected kill), deterministic
+   re-assignment ordering when two workers die in the same epoch, the
+   ``cluster/reassign_race`` lost-assignment window, and the
+   boundary-mismatch -> demand-bundle -> re-assign walk.
+
+The two-process integration of the same machinery lives in
+tests/test_cluster_handoff.py.
+"""
+
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from coreth_tpu import faults
+from coreth_tpu.faults import FaultPlan, FaultSpec
+from coreth_tpu.serve.cluster import protocol
+from coreth_tpu.serve.cluster.bootstrap import (
+    LaneSeed, partition_ranges,
+)
+from coreth_tpu.serve.cluster.coordinator import (
+    PT_REASSIGN_RACE, PT_WORKER_CRASH, ClusterCoordinator,
+    WorkerHandle, plan_reassignments,
+)
+from coreth_tpu.serve.cluster.worker import (
+    PT_BOUNDARY_MISMATCH, PT_HEARTBEAT_LOSS, HeartbeatSender,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# --------------------------------------------------------------- framing
+
+def test_frame_roundtrip_and_truncation():
+    msg = {"verb": "heartbeat", "worker": "w0", "lane": "lane1",
+           "committed": 7, "txs": 42}
+    wire = protocol.encode_frame(msg)
+    # every strict prefix is "incomplete", never an error
+    for cut in range(len(wire)):
+        got, rest = protocol.decode_frame(wire[:cut])
+        assert got is None and rest == wire[:cut]
+    got, rest = protocol.decode_frame(wire + b"tail")
+    assert got == msg and rest == b"tail"
+
+
+def test_frame_oversized_rejected_before_allocation():
+    import struct
+    huge = struct.pack(">I", protocol.MAX_FRAME + 1)
+    with pytest.raises(protocol.ProtocolError, match="too large"):
+        protocol.decode_frame(huge)
+    big = {"verb": "assign", "pad": "x" * (protocol.MAX_FRAME + 1)}
+    with pytest.raises(protocol.ProtocolError, match="too large"):
+        protocol.encode_frame(big)
+
+
+def test_frame_unknown_verb_and_garbage_rejected():
+    import json
+    import struct
+    with pytest.raises(protocol.ProtocolError, match="unknown verb"):
+        protocol.encode_frame({"verb": "exfiltrate"})
+    with pytest.raises(protocol.ProtocolError, match="unknown verb"):
+        protocol.encode_frame({"no": "verb"})
+
+    def frame(payload: bytes) -> bytes:
+        return struct.pack(">I", len(payload)) + payload
+
+    bad_verb = json.dumps({"verb": "exfiltrate"}).encode()
+    with pytest.raises(protocol.ProtocolError, match="unknown verb"):
+        protocol.decode_frame(frame(bad_verb))
+    with pytest.raises(protocol.ProtocolError, match="unknown verb"):
+        protocol.decode_frame(frame(json.dumps([1, 2]).encode()))
+    with pytest.raises(protocol.ProtocolError, match="bad frame"):
+        protocol.decode_frame(frame(b"{not json"))
+    with pytest.raises(protocol.ProtocolError, match="bad frame"):
+        protocol.decode_frame(frame(b"\xff\xfe\x00"))
+
+
+def test_recv_reassembles_split_frames_and_flags_torn_eof():
+    a, b = socket.socketpair()
+    try:
+        wire = protocol.encode_frame({"verb": "hello", "worker": "w0",
+                                      "pid": 1})
+        wire += protocol.encode_frame({"verb": "error", "worker": "w0",
+                                       "reason": "x"})
+        # drip the two frames over arbitrary chunk boundaries
+        for i in range(0, len(wire), 3):
+            a.sendall(wire[i:i + 3])
+        buf = bytearray()
+        assert protocol.recv_msg(b, buf)["verb"] == "hello"
+        assert protocol.recv_msg(b, buf)["verb"] == "error"
+        # half a frame, then EOF: a torn peer, not a clean close
+        a.sendall(protocol.encode_frame(
+            {"verb": "drain", "bundle": False})[:5])
+        a.close()
+        with pytest.raises(protocol.ProtocolError, match="EOF mid-frame"):
+            protocol.recv_msg(b, buf)
+    finally:
+        b.close()
+
+
+def test_recv_clean_eof_is_none():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(protocol.encode_frame({"verb": "drain",
+                                         "bundle": False}))
+        a.close()
+        buf = bytearray()
+        assert protocol.recv_msg(b, buf)["verb"] == "drain"
+        assert protocol.recv_msg(b, buf) is None
+    finally:
+        b.close()
+
+
+# ------------------------------------------------------------ heartbeats
+
+def test_heartbeat_loss_fault_drops_sends():
+    sent = []
+    hb = HeartbeatSender(lambda m: sent.append(m), "w0", "lane0",
+                         period=0.01, progress=lambda: (3, 30))
+    assert hb.tick() and len(sent) == 1
+    # armed: the next two ticks vanish from the wire, the worker lives
+    faults.arm(FaultPlan({"cluster/heartbeat_loss":
+                          FaultSpec(times=2)}))
+    assert not hb.tick() and not hb.tick()
+    assert hb.dropped == 2 and len(sent) == 1
+    assert faults.fired(PT_HEARTBEAT_LOSS) == 2
+    # plan exhausted: heartbeats flow again
+    assert hb.tick()
+    assert len(sent) == 2 and sent[-1]["committed"] == 3
+
+
+def test_heartbeat_emits_checkpoint_advance_once_per_record():
+    sent = []
+    record = [None]
+    hb = HeartbeatSender(lambda m: sent.append(m), "w0", "lane0",
+                         period=0.01, record=lambda: record[0])
+    hb.tick()
+    assert [m["verb"] for m in sent] == ["heartbeat"]
+    record[0] = 4
+    hb.tick()
+    hb.tick()  # same record: no duplicate advance
+    assert [m["verb"] for m in sent] == [
+        "heartbeat", "heartbeat", "checkpoint_advance", "heartbeat"]
+    assert sent[2] == {"verb": "checkpoint_advance", "worker": "w0",
+                       "lane": "lane0", "number": 4}
+
+
+# ---------------------------------------------------------- coordinator
+
+class FakeWorker(WorkerHandle):
+    """A WorkerHandle with the socket replaced by a recorded outbox."""
+
+    def __init__(self, worker_id):
+        super().__init__(worker_id=worker_id)
+        self.outbox = []
+        self.dead = False
+        self.killed = False
+
+    def send(self, msg):
+        self.outbox.append(msg)
+
+    def alive(self):
+        return not (self.dead or self.closed or self.drained)
+
+    def kill(self):
+        self.killed = True
+        self.dead = True
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _coord(n_lanes=2, **kw):
+    ranges = partition_ranges(12, n_lanes)
+    seeds = [LaneSeed(lane=f"lane{i}", start=s, end=e,
+                      root=bytes([i]) * 32, db_dir=f"/tmp/lane{i}")
+             for i, (s, e) in enumerate(ranges)]
+    clock = FakeClock()
+    coord = ClusterCoordinator(
+        seeds, "/tmp/chain.rlp", expected_tip=b"\xaa" * 32,
+        spawn=lambda *a, **k: None, clock=clock,
+        heartbeat_timeout=5.0, **kw)
+    coord._t0 = clock.t
+    return coord, clock
+
+
+def _register(coord, *workers):
+    for w in workers:
+        coord.workers[w.id] = w
+
+
+def test_partition_ranges_cover_and_order():
+    assert partition_ranges(12, 2) == [(0, 6), (6, 12)]
+    assert partition_ranges(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert partition_ranges(2, 5) == [(0, 1), (1, 2)]  # lanes capped
+    with pytest.raises(ValueError):
+        partition_ranges(10, 0)
+
+
+def test_assign_prefers_lane_order_and_worker_id_order():
+    coord, _ = _coord(n_lanes=2)
+    w1, w0 = FakeWorker("w1"), FakeWorker("w0")
+    _register(coord, w1, w0)
+    coord._assign_pending()
+    # lane0 (earliest range) -> w0 (lowest id), lane1 -> w1
+    assert [m["lane"] for m in w0.outbox] == ["lane0"]
+    assert [m["lane"] for m in w1.outbox] == ["lane1"]
+    assert coord.lanes["lane0"].status == "running"
+    assert w0.outbox[0]["start"] == 0 and w0.outbox[0]["end"] == 6
+
+
+def test_heartbeat_timeout_reassigns():
+    coord, clock = _coord(n_lanes=1)
+    w0, w1 = FakeWorker("w0"), FakeWorker("w1")
+    _register(coord, w0, w1)
+    coord._assign_pending()
+    assert w0.lane == "lane0"
+    # silence under the grace period: nothing happens
+    clock.t = 4.0
+    coord._health_check()
+    assert coord.lanes["lane0"].status == "running"
+    # past the timeout: the silent worker is fenced and the lane
+    # returns to the pool; the next pass hands it to w1
+    clock.t = 6.0
+    coord._health_check()
+    assert w0.killed
+    assert coord.lanes["lane0"].status == "pending"
+    snap = coord._registry.snapshot()
+    assert snap["cluster/heartbeat_loss"]["count"] == 1
+    coord._assign_pending()
+    assert w1.lane == "lane0"
+    assert coord.lanes["lane0"].history == ["w0", "w1"]
+    assert snap_count(coord, "cluster/reassigned") == 1
+
+
+def snap_count(coord, name):
+    return coord._registry.snapshot()[name]["count"]
+
+
+def test_dead_worker_detected():
+    """cluster/worker_crash: the armed point SIGKILLs (here: flags) a
+    running worker, and the detection path routes the lane back
+    through the pending pool with its failure counted."""
+    coord, _ = _coord(n_lanes=1)
+    w0 = FakeWorker("w0")
+    _register(coord, w0)
+    coord._assign_pending()
+    faults.arm(FaultPlan({"cluster/worker_crash": FaultSpec(times=1)}))
+    coord._health_check()  # injected kill, then detection, same pass
+    assert w0.killed
+    assert faults.fired(PT_WORKER_CRASH) == 1
+    assert coord.lanes["lane0"].status == "pending"
+    assert coord.lanes["lane0"].failures == 1
+    assert snap_count(coord, "cluster/worker_crash") == 1
+    events = [e["event"] for e in coord.events]
+    assert "injected_kill" in events and "worker_crash" in events
+
+
+def test_two_deaths_same_epoch_reassign_deterministically():
+    """The satellite-3 ordering contract: lanes by range start meet
+    workers by id, independent of dict/discovery order."""
+    coord, _ = _coord(n_lanes=2)
+    wb, wa = FakeWorker("wb"), FakeWorker("wa")
+    _register(coord, wb, wa)
+    coord._assign_pending()
+    assert wa.lane == "lane0" and wb.lane == "lane1"
+    # both die in the same epoch
+    wa.dead = wb.dead = True
+    coord._health_check()
+    assert all(l.status == "pending" for l in coord.lanes.values())
+    # two replacements joining in scrambled order
+    wd, wc = FakeWorker("wd"), FakeWorker("wc")
+    _register(coord, wd, wc)
+    coord._assign_pending()
+    assert wc.lane == "lane0" and wd.lane == "lane1"
+    # the pure planner agrees, whatever order the inputs arrive in
+    lanes = [coord.lanes["lane1"], coord.lanes["lane0"]]
+    pairs = plan_reassignments(lanes, [wd, wc])
+    assert [(l.lane, w.id) for l, w in pairs] == [
+        ("lane0", "wc"), ("lane1", "wd")]
+
+
+def test_reassign_race_repicks_next_pass():
+    coord, _ = _coord(n_lanes=1)
+    w0 = FakeWorker("w0")
+    _register(coord, w0)
+    faults.arm(FaultPlan({"cluster/reassign_race":
+                          FaultSpec(times=1)}))
+    coord._assign_pending()
+    # the window fired: no assignment left the coordinator
+    assert w0.outbox == [] and w0.lane is None
+    assert coord.lanes["lane0"].status == "pending"
+    assert faults.fired(PT_REASSIGN_RACE) == 1
+    assert snap_count(coord, "cluster/reassign_race") == 1
+    coord._assign_pending()  # next pass: plan exhausted, lane lands
+    assert w0.lane == "lane0"
+    assert coord.lanes["lane0"].status == "running"
+
+
+def test_boundary_mismatch_corrupts_report():
+    """cluster/boundary_mismatch end-to-end at the unit layer: the
+    armed point hands the worker a site-interpreted spec (the worker
+    xors its reported root), and the aggregator's verification demands
+    the bundle before the lane re-enters the pool."""
+    spec = None
+    with faults.armed(FaultPlan({"cluster/boundary_mismatch":
+                                 FaultSpec(times=1)})):
+        spec = faults.check(PT_BOUNDARY_MISMATCH)
+    assert spec is not None  # the worker-side seam sees the spec
+    true_root = bytes(10) + b"\x01" * 22
+    lied = bytes(b ^ 0xFF for b in true_root)  # the worker's xor
+
+    coord, _ = _coord(n_lanes=2)
+    w0 = FakeWorker("w0")
+    _register(coord, w0)
+    coord._assign_pending()
+    lane = coord.lanes["lane0"]
+    want = coord._expected["lane0"]
+    assert want is not None and lied != want
+    coord._on_boundary(w0, lane, {
+        "verb": "boundary_root", "worker": "w0", "lane": "lane0",
+        "root": lied.hex(), "resumed_from": 0,
+        "report": {"blocks": 6}, "metrics": {}})
+    # evidence first: drain{bundle} went out, lane holds for it
+    assert lane.status == "awaiting_bundle"
+    assert lane.failures == 1
+    assert w0.outbox[-1]["verb"] == "drain" and w0.outbox[-1]["bundle"]
+    assert not w0.alive()  # a lying worker never gets another lane
+    assert snap_count(coord, "cluster/boundary_mismatch") == 1
+    # the bundle arrives: paths recorded, lane back in the pool
+    coord._dispatch(w0, {"verb": "bundle", "worker": "w0",
+                         "lane": "lane0", "paths": ["/tmp/b0.json"]})
+    assert lane.status == "pending"
+    assert lane.bundles == ["/tmp/b0.json"]
+
+
+def test_matching_boundary_root_completes_lane():
+    coord, _ = _coord(n_lanes=2)
+    w0 = FakeWorker("w0")
+    _register(coord, w0)
+    coord._assign_pending()
+    lane = coord.lanes["lane0"]
+    good = coord._expected["lane0"]
+    coord._on_boundary(w0, lane, {
+        "verb": "boundary_root", "worker": "w0", "lane": "lane0",
+        "root": good.hex(), "resumed_from": 0,
+        "report": {"blocks": 6}, "metrics": {}})
+    assert lane.status == "done" and lane.root == good
+    assert w0.lane is None and w0.alive()  # free for the next lane
+    assert snap_count(coord, "cluster/lanes_done") == 1
+
+
+def test_lane_halts_after_max_failures():
+    coord, _ = _coord(n_lanes=1)
+    coord.max_failures = 1
+    coord.lanes["lane0"].failures = 2
+    w0 = FakeWorker("w0")
+    _register(coord, w0)
+    with pytest.raises(RuntimeError, match="halting cluster"):
+        coord._assign_pending()

@@ -118,6 +118,8 @@ def test_declared_points_all_covered():
     import coreth_tpu.replay.checkpoint  # noqa: F401
     import coreth_tpu.replay.commit  # noqa: F401
     import coreth_tpu.replay.engine  # noqa: F401
+    import coreth_tpu.serve.cluster.coordinator  # noqa: F401
+    import coreth_tpu.serve.cluster.worker  # noqa: F401
     import coreth_tpu.serve.pipeline  # noqa: F401
     import coreth_tpu.state.flat.exporter  # noqa: F401
     COVERAGE = {
@@ -154,6 +156,18 @@ def test_declared_points_all_covered():
             "test_forensics::test_bundle_fail_fault_counted_atomic "
             "(+ the serialization shape in "
             "test_bundle_fail_partial_write_cleaned)",
+        "cluster/worker_crash":
+            "test_cluster_handoff::test_cluster_handoff_matrix (+ the "
+            "detection unit in test_cluster::test_dead_worker_detected)",
+        "cluster/heartbeat_loss":
+            "test_cluster::test_heartbeat_loss_fault_drops_sends (+ "
+            "timeout policy in test_heartbeat_timeout_reassigns)",
+        "cluster/boundary_mismatch":
+            "test_cluster_handoff::test_boundary_mismatch_demands_bundle "
+            "(+ the corruption unit in "
+            "test_cluster::test_boundary_mismatch_corrupts_report)",
+        "cluster/reassign_race":
+            "test_cluster::test_reassign_race_repicks_next_pass",
     }
     declared = set(faults.declared())
     covered = set(COVERAGE)

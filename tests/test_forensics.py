@@ -113,6 +113,8 @@ def test_trigger_completeness_gate():
             "test_forensics::test_quarantine_bundle_roundtrip",
         "supervisor/hard_demote":
             "test_forensics::test_hostexec_divergence_bundle_bisects",
+        "cluster/boundary_mismatch":
+            "test_cluster_handoff::test_boundary_mismatch_demands_bundle",
     }
     declared = set(recorder.declared_triggers())
     covered = set(COVERAGE)
@@ -139,7 +141,8 @@ def test_trigger_completeness_gate():
               "commit/root_mismatch": "TR_ROOT",
               "engine/fallback_mismatch": "TR_FALLBACK",
               "serve/quarantine": "TR_QUARANTINE",
-              "supervisor/hard_demote": "TR_DEMOTE"}
+              "supervisor/hard_demote": "TR_DEMOTE",
+              "cluster/boundary_mismatch": "TR_BOUNDARY"}
     unrouted = [name for name, const in consts.items()
                 if const not in blob]
     assert not unrouted, f"declared but unrouted triggers: {unrouted}"
