@@ -339,7 +339,11 @@ def _build_exec(params: MachineParams):
             take_jump = is_jump | jumpi_taken
             bad_jump = take_jump & ~jump_valid
 
-            pre_err = under | over_1024 | undefined | bad_jump | m_oog
+            # INVALID (0xFE) is claimed-but-erring: it must consume
+            # all gas like the interpreter's opInvalid, not fall
+            # through the arm masks as a free no-op
+            pre_err = under | over_1024 | undefined | bad_jump \
+                | m_oog | m(0xFE)
             ok_pre = running & ~pre_err & ~m_host
 
             # ---------------- cheap value families (always compiled)

@@ -15,6 +15,7 @@ from typing import Dict, FrozenSet, Optional, Tuple
 
 import numpy as np
 
+from coreth_tpu.evm import forks
 from coreth_tpu.evm import jump_table as JT
 from coreth_tpu.evm.interpreter import analyze_jumpdests
 from coreth_tpu.params import protocol as P
@@ -22,7 +23,9 @@ from coreth_tpu.params import protocol as P
 # Fork keys the device machine supports: EIP-2929 warm/cold present
 # (AP2+); AP2 keeps refunds disabled, AP3+ re-enables the reduced
 # EIP-3529 schedule (jump_table.py new_ap2_table/new_ap3_table).
-FORKS = ("ap2", "ap3", "durango", "cancun")
+# The ordering and per-fork opcode gating both come from the lattice
+# module (evm/forks.py); semconf SEM005 pins that derivation.
+FORKS = forks.SUPPORTED
 
 _TABLE_FOR_FORK = {
     "ap2": JT.new_ap2_table,
@@ -57,21 +60,16 @@ FEATURE_OPS: Dict[int, str] = {
     0xA0: "log", 0xA1: "log", 0xA2: "log", 0xA3: "log", 0xA4: "log",
 }
 
-_FORK_EXTRA = {
-    "ap3": {0x48},                       # BASEFEE
-    "durango": {0x48, 0x5F},             # + PUSH0
-    "cancun": {0x48, 0x5F, 0x5C, 0x5D, 0x5E},  # + TLOAD TSTORE MCOPY
-}
+# Fork-introduced opcodes the device machine implements beyond the
+# always/feature pools (BASEFEE, PUSH0; TLOAD/TSTORE/MCOPY already sit
+# in FEATURE_OPS).  forks.gate drops whatever a fork does not define
+# yet, so no per-fork subtraction lists can drift.
+DEVICE_GATED = frozenset({0x48, 0x5F})
 
 
 def device_opcodes(fork: str) -> set:
-    ops = set(_ALWAYS) | set(FEATURE_OPS)
-    ops |= _FORK_EXTRA.get(fork, set())
-    if fork in ("ap2", "ap3"):
-        ops -= {0x5F, 0x5C, 0x5D, 0x5E}
-    if fork == "ap2":
-        ops -= {0x48}
-    return ops
+    return set(forks.gate(fork,
+                          set(_ALWAYS) | set(FEATURE_OPS) | DEVICE_GATED))
 
 
 @dataclass(frozen=True)

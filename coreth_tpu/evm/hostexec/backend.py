@@ -17,9 +17,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from coreth_tpu import faults
 from coreth_tpu.evm.device import machine as M
-from coreth_tpu.evm.hostexec.eligibility import (
-    REFUND_FORKS, native_optable,
-)
+from coreth_tpu.evm.forks import REFUND_FORKS
+from coreth_tpu.evm.hostexec.eligibility import native_optable
 
 # Injection point: the session returns an error rc mid-call (the ABI's
 # failure mode for a corrupted session).  Armed plans raise here; the

@@ -23,12 +23,15 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+from coreth_tpu.evm import forks
 from coreth_tpu.evm.census import opcode_census
 from coreth_tpu.evm.device.tables import FORKS, op_tables
 
-# Opcodes compiled into native/evm.cc's run_frame (keep in lockstep
-# with build_replay_optable there; tests/test_hostexec.py pins the
-# workload contracts against this set).
+# Opcodes compiled into native/evm.cc's run_frame that every supported
+# fork defines (keep in lockstep with build_replay_optable there;
+# tests/test_hostexec.py pins the workload contracts against this set,
+# and semconf SEM003 pins that each member is defined in EVERY fork's
+# jump table — fork-introduced ops belong in NATIVE_GATED instead).
 NATIVE_BASE = frozenset(
     list(range(0x00, 0x0C))        # STOP..SIGNEXTEND
     + list(range(0x10, 0x1E))      # LT..SAR
@@ -41,21 +44,19 @@ NATIVE_BASE = frozenset(
     + [0xF1, 0xF3, 0xFA, 0xFD, 0xFE]  # CALL RETURN STATICCALL REVERT INVALID
 )
 
-_FORK_EXTRA = {
-    "ap2": frozenset(),
-    "ap3": frozenset([0x48]),                  # BASEFEE
-    "durango": frozenset([0x48, 0x5F]),        # + PUSH0
-    "cancun": frozenset([0x48, 0x5F]),
-}
+# Fork-introduced opcodes the compiled engine implements; the lattice
+# (evm/forks.py) decides which are live per fork — the PR-3 bug class
+# (PUSH0 executing pre-durango) cannot be re-introduced by editing one
+# set here.
+NATIVE_GATED = frozenset({0x48, 0x5F})         # BASEFEE PUSH0
 
-# forks whose SSTORE tracks the EIP-3529 refund schedule (AP2 keeps
-# refunds disabled; jump_table.new_ap2_table with_refunds=False)
-REFUND_FORKS = ("ap3", "durango", "cancun")
+_FORK_EXTRA = {f: forks.extra_for(f, NATIVE_GATED)
+               for f in forks.SUPPORTED}
 
-# forks that pre-warm the coinbase at tx start (EIP-3651; mirrors
-# statedb.prepare's rules.is_durango branch) — serial-path warm seeds
-# derive from this, not from a scattered literal
-COINBASE_WARM_FORKS = ("durango", "cancun")
+# Derived fork-constant tuples (evm/forks.py feature flags; SEM005
+# rejects hand-maintained literal redefinitions of these names).
+REFUND_FORKS = forks.REFUND_FORKS
+COINBASE_WARM_FORKS = forks.COINBASE_WARM_FORKS
 
 
 def native_opcodes(fork: str) -> frozenset:

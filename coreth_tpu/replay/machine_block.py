@@ -622,10 +622,8 @@ class MachineBlockExecutor:
         say) demotes THAT block to the legacy OCC path and the run
         continues; consensus failures raise like every other path."""
         from coreth_tpu.evm.device.adapter import TxResult
+        from coreth_tpu.evm.forks import COINBASE_WARM_FORKS
         from coreth_tpu.evm.hostexec.backend import HostExecBackend
-        from coreth_tpu.evm.hostexec.eligibility import (
-            COINBASE_WARM_FORKS,
-        )
         e = self.e
 
         def resolver(contract: bytes, key: bytes) -> bytes:

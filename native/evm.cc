@@ -678,13 +678,20 @@ FrameRes run_frame(Exec& X, const uint8_t* caller,
         stack.push_back(from_be(h)); break; }
       case 0x30: USE(G_QUICK);                             // ADDRESS
         stack.push_back(addr_word((const uint8_t*)self_addr.data()));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
         ++pc; continue;
       case 0x32: USE(G_QUICK);                             // ORIGIN
-        stack.push_back(addr_word(X.origin)); ++pc; continue;
+        stack.push_back(addr_word(X.origin));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x33: USE(G_QUICK);                             // CALLER
-        stack.push_back(addr_word(caller)); ++pc; continue;
+        stack.push_back(addr_word(caller));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x34: USE(G_QUICK);                             // CALLVALUE
-        stack.push_back(value); ++pc; continue;
+        stack.push_back(value);
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x35: { NEED(1); USE(G_FASTEST);                // CALLDATALOAD
         U256 offv = stack.back();
         uint8_t word[32] = {0};
@@ -696,7 +703,9 @@ FrameRes run_frame(Exec& X, const uint8_t* caller,
         }
         stack.back() = from_be(word); break; }
       case 0x36: USE(G_QUICK);                             // CALLDATASIZE
-        stack.push_back(u256_from64(inlen)); ++pc; continue;
+        stack.push_back(u256_from64(inlen));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x37: { NEED(3); USE(G_FASTEST);                // CALLDATACOPY
         U256 dstv = stack.back(); stack.pop_back();
         U256 srcv = stack.back(); stack.pop_back();
@@ -718,7 +727,9 @@ FrameRes run_frame(Exec& X, const uint8_t* caller,
         }
         break; }
       case 0x38: USE(G_QUICK);                             // CODESIZE
-        stack.push_back(u256_from64(code.size())); ++pc; continue;
+        stack.push_back(u256_from64(code.size()));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x39: { NEED(3); USE(G_FASTEST);                // CODECOPY
         U256 dstv = stack.back(); stack.pop_back();
         U256 srcv = stack.back(); stack.pop_back();
@@ -740,9 +751,13 @@ FrameRes run_frame(Exec& X, const uint8_t* caller,
         }
         break; }
       case 0x3A: USE(G_QUICK);                             // GASPRICE
-        stack.push_back(X.gasprice); ++pc; continue;
+        stack.push_back(X.gasprice);
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x3D: USE(G_QUICK);                             // RETURNDATASIZE
-        stack.push_back(u256_from64(retdata.size())); ++pc; continue;
+        stack.push_back(u256_from64(retdata.size()));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x3E: { NEED(3); USE(G_FASTEST);                // RETURNDATACOPY
         U256 dstv = stack.back(); stack.pop_back();
         U256 srcv = stack.back(); stack.pop_back();
@@ -767,19 +782,33 @@ FrameRes run_frame(Exec& X, const uint8_t* caller,
         if (len) std::memcpy(mem.data() + dst, retdata.data() + src, len);
         break; }
       case 0x41: USE(G_QUICK);                             // COINBASE
-        stack.push_back(addr_word(X.env->coinbase)); ++pc; continue;
+        stack.push_back(addr_word(X.env->coinbase));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x42: USE(G_QUICK);
-        stack.push_back(u256_from64(X.env->timestamp)); ++pc; continue;
+        stack.push_back(u256_from64(X.env->timestamp));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x43: USE(G_QUICK);
-        stack.push_back(u256_from64(X.env->number)); ++pc; continue;
+        stack.push_back(u256_from64(X.env->number));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x44: USE(G_QUICK);                             // DIFFICULTY
-        stack.push_back(u256_from64(X.env->difficulty)); ++pc; continue;
+        stack.push_back(u256_from64(X.env->difficulty));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x45: USE(G_QUICK);
-        stack.push_back(u256_from64(X.env->gaslimit)); ++pc; continue;
+        stack.push_back(u256_from64(X.env->gaslimit));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x46: USE(G_QUICK);
-        stack.push_back(u256_from64(X.env->chain_id)); ++pc; continue;
+        stack.push_back(u256_from64(X.env->chain_id));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x48: USE(G_QUICK);
-        stack.push_back(X.env->basefee); ++pc; continue;
+        stack.push_back(X.env->basefee);
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x50: NEED(1); USE(G_QUICK); stack.pop_back();
         ++pc; continue;
       case 0x51: { NEED(1); USE(G_FASTEST);                // MLOAD
@@ -887,13 +916,20 @@ FrameRes run_frame(Exec& X, const uint8_t* caller,
         }
         break; }
       case 0x58: USE(G_QUICK);
-        stack.push_back(u256_from64(pc)); ++pc; continue;
+        stack.push_back(u256_from64(pc));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x59: USE(G_QUICK);
-        stack.push_back(u256_from64(mem.size())); ++pc; continue;
+        stack.push_back(u256_from64(mem.size()));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x5A: USE(G_QUICK);
-        stack.push_back(u256_from64((uint64_t)gas)); ++pc; continue;
+        stack.push_back(u256_from64((uint64_t)gas));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
       case 0x5B: USE(G_JUMPDEST); ++pc; continue;
       case 0x5F: USE(G_QUICK); stack.push_back(U256());
+        if (stack.size() > 1024) { res.gas = 0; return res; }
         ++pc; continue;                                    // PUSH0
       case 0xF1: case 0xFA: {                              // CALL STATICCALL
         unsigned nargs = op == 0xF1 ? 7 : 6;

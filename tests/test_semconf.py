@@ -1,0 +1,369 @@
+"""semconf (SEM001-SEM005) — per-code fixture tests plus the census
+coverage extension.
+
+Every SEM code gets a firing fixture AND a passing one.  Fixtures are
+synthetic claim modules (suffix-matched paths) and a minimal
+``evm.cc`` written into a tmp ``native_dir`` — the comparison truth is
+always the REAL jump table / fork lattice, so the fixtures are small
+claim sets over well-known opcodes (0x01 ADD, 0x02 MUL, 0x58 PC).
+
+The PR-3 regression lives here: a synthetic eligibility module that
+claims PUSH0 (0x5F) ungated — the compiled-but-ungated fork-gate bug
+class — must fire SEM003.  Pure static analysis — no jax, no device,
+no native library load.
+"""
+
+import os
+import textwrap
+
+from tools.lint.core import Source, collect_sources
+from tools.lint.semconf import (
+    MATRIX_BEGIN, MATRIX_END, check_semconf, extract_native,
+    tree_claims,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ELIG_PATH = "coreth_tpu/evm/hostexec/eligibility.py"
+TABLES_PATH = "coreth_tpu/evm/device/tables.py"
+SPEC_PATH = "coreth_tpu/evm/device/specialize.py"
+JT_PATH = "coreth_tpu/evm/jump_table.py"
+
+
+def src(snippet: str, path: str) -> Source:
+    return Source(path, textwrap.dedent(snippet))
+
+
+def details(findings):
+    return {f.detail for f in findings}
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def elig(base="frozenset({0x01, 0x58})", gated="frozenset()"):
+    return src(f"""\
+        NATIVE_BASE = {base}
+        NATIVE_GATED = {gated}
+        _FORK_EXTRA = {{f: forks.extra_for(f, NATIVE_GATED)
+                        for f in forks.SUPPORTED}}
+        """, ELIG_PATH)
+
+
+TABLES_OK = src("""\
+    _ALWAYS = frozenset({0x01, 0x58})
+    FEATURE_OPS = {0x20: "keccak"}
+    DEVICE_GATED = frozenset({0x48, 0x5F})
+    """, TABLES_PATH)
+
+SPEC_OK = src("SPEC_OPCODES = frozenset({0x01, 0x58})\n", SPEC_PATH)
+
+
+def cc(arm_01="", arm_58=None, extra_arms="", consts=None,
+       gate=True, replay="0x01, 0x58"):
+    """A minimal evm.cc the extractor fully understands.  Defaults
+    are truth-conformant for ADD (0x01) and PC (0x58)."""
+    if not arm_01:
+        arm_01 = """\
+      case 0x01: {
+        NEED(2);
+        USE(G_FASTEST);
+        w256 a = stack.back(); stack.pop_back();
+        w256 b = stack.back(); stack.pop_back();
+        stack.push_back(a + b);
+        ++pc; continue;
+      }"""
+    if arm_58 is None:
+        arm_58 = """\
+      case 0x58: {
+        USE(G_QUICK);
+        stack.push_back(from_u64(pc));
+        if (stack.size() > 1024) { res.gas = 0; return res; }
+        ++pc; continue;
+      }"""
+    if consts is None:
+        consts = "constexpr uint64_t G_FASTEST = 3, G_QUICK = 2;"
+    gate_lines = """\
+    if (cls == OP_UNDEF) { res.status = ST_ERR; return res; }
+    if (cls == OP_HOSTONLY) { res.status = ST_HOST; return res; }""" \
+        if gate else ""
+    return f"""\
+#include <cstdint>
+
+{consts}
+
+Result run_frame(Frame &f) {{
+  for (;;) {{
+    uint8_t op = code[pc];
+    uint8_t cls = optable[op];
+{gate_lines}
+    switch (op) {{
+{arm_01}
+{arm_58}
+{extra_arms}
+      default: {{
+        res.status = ST_ERR;
+        return res;
+      }}
+    }}
+  }}
+}}
+
+void build_replay_optable(uint8_t *t) {{
+  static const int ops[] = {{{replay}}};
+  (void)ops;
+}}
+"""
+
+
+def run(sources, tmp_path, cc_text=None):
+    """check_semconf against an isolated native_dir; the matrix check
+    is disabled via a nonexistent readme."""
+    if cc_text is not None:
+        (tmp_path / "evm.cc").write_text(cc_text)
+    return check_semconf(sources, native_dir=str(tmp_path),
+                         readme_path=str(tmp_path / "no-readme.md"))
+
+
+# ------------------------------------------------------ passing cases
+
+def test_conformant_fixture_is_clean(tmp_path):
+    out = run([elig(), TABLES_OK, SPEC_OK], tmp_path, cc())
+    assert out == [], "\n".join(f.render() for f in out)
+
+
+def test_tree_semconf_clean():
+    """The real tree carries zero semconf findings (baseline EMPTY)."""
+    sources = collect_sources([os.path.join(REPO, "coreth_tpu")])
+    out = check_semconf(sources)
+    assert out == [], "\n".join(f.render() for f in out)
+
+
+# ------------------------------------- SEM003: the PR-3 fork-gate class
+
+def test_ungated_push0_fires_sem003(tmp_path):
+    """Regression for the PR-3 bug class: PUSH0 claimed in the ungated
+    base pool executes on pre-durango forks where it is undefined."""
+    bad = elig(base="frozenset({0x01, 0x58, 0x5F})")
+    out = run([bad], tmp_path)
+    assert codes(out) == ["SEM003"]
+    assert "native:gate:0x5f" in details(out)
+    assert "NATIVE_BASE" in out[0].message
+
+
+def test_missing_dispatch_gate_fires_sem003(tmp_path):
+    out = run([elig()], tmp_path, cc(gate=False))
+    assert "native:gate-missing" in details(out)
+    assert all(f.code == "SEM003" for f in out
+               if f.detail == "native:gate-missing")
+
+
+# --------------------------------------------- SEM001: coverage drift
+
+def test_undefined_claim_fires_sem001(tmp_path):
+    # 0x0c is undefined on every fork and not fork-introduced
+    out = run([elig(base="frozenset({0x01, 0x0c})")], tmp_path)
+    assert codes(out) == ["SEM001"]
+    assert "native:undefined:0x0c" in details(out)
+
+
+def test_claimed_but_uncompiled_fires_sem001(tmp_path):
+    out = run([elig(base="frozenset({0x01, 0x02, 0x58})")],
+              tmp_path, cc())
+    assert "native:uncompiled:0x02" in details(out)
+
+
+def test_compiled_but_unclaimed_fires_sem001(tmp_path):
+    extra = """\
+      case 0x02: {
+        NEED(2);
+        w256 a = stack.back(); stack.pop_back();
+        w256 b = stack.back(); stack.pop_back();
+        stack.push_back(a * b);
+        ++pc; continue;
+      }"""
+    out = run([elig()], tmp_path,
+              cc(extra_arms=extra, replay="0x01, 0x02, 0x58"))
+    assert "native:unclaimed:0x02" in details(out)
+
+
+def test_replay_optable_drift_fires_sem001(tmp_path):
+    out = run([elig()], tmp_path, cc(replay="0x01"))
+    assert "native:replay-drift" in details(out)
+
+
+def test_specialize_outside_device_fires_sem001(tmp_path):
+    spec = src("SPEC_OPCODES = frozenset({0x01, 0x30})\n", SPEC_PATH)
+    out = run([elig(), TABLES_OK, spec], tmp_path)
+    assert "specialize:not-device:0x30" in details(out)
+
+
+# ----------------------------------------------- SEM002: gas constants
+
+def test_gas_twin_mismatch_fires_sem002(tmp_path):
+    wrong = "constexpr uint64_t G_FASTEST = 3, G_QUICK = 7;"
+    out = run([elig()], tmp_path, cc(consts=wrong))
+    assert "gasconst:G_QUICK" in details(out)
+    # the wrong constant also flows into PC's per-op charge
+    assert any(d.startswith("opgas:0x58:") for d in details(out))
+
+
+def test_unmapped_gas_constant_fires_sem002(tmp_path):
+    consts = ("constexpr uint64_t G_FASTEST = 3, G_QUICK = 2;\n"
+              "constexpr uint64_t G_BOGUS = 7;")
+    out = run([elig()], tmp_path, cc(consts=consts))
+    assert details(out) == {"gasconst-unmapped:G_BOGUS"}
+    assert codes(out) == ["SEM002"]
+
+
+# ------------------------------------------------- SEM004: stack arity
+
+def test_arity_mismatch_fires_sem004(tmp_path):
+    arm = """\
+      case 0x01: {
+        NEED(1);
+        USE(G_FASTEST);
+        w256 a = stack.back(); stack.pop_back();
+        stack.push_back(a);
+        ++pc; continue;
+      }"""
+    out = run([elig()], tmp_path, cc(arm_01=arm))
+    assert "arity-pops:0x01" in details(out)
+    assert all(f.code == "SEM004" for f in out)
+
+
+def test_missing_overflow_guard_fires_sem004(tmp_path):
+    arm = """\
+      case 0x58: {
+        USE(G_QUICK);
+        stack.push_back(from_u64(pc));
+        ++pc; continue;
+      }"""
+    out = run([elig()], tmp_path, cc(arm_58=arm))
+    assert details(out) == {"overflow-guard:0x58"}
+    assert codes(out) == ["SEM004"]
+
+
+def test_wrong_guard_limit_fires_sem004(tmp_path):
+    arm = """\
+      case 0x58: {
+        USE(G_QUICK);
+        stack.push_back(from_u64(pc));
+        if (stack.size() > 512) { res.gas = 0; return res; }
+        ++pc; continue;
+      }"""
+    out = run([elig()], tmp_path, cc(arm_58=arm))
+    assert details(out) == {"overflow-limit:0x58"}
+
+
+# --------------------------------------------- SEM005: fork-set truth
+
+def test_literal_fork_set_fires_sem005(tmp_path):
+    stray = src('REFUND_FORKS = ("durango", "cancun")\n',
+                "coreth_tpu/evm/device/runner.py")
+    out = run([stray], tmp_path)
+    assert details(out) == {"literal:REFUND_FORKS"}
+    assert codes(out) == ["SEM005"]
+
+
+def test_lattice_derived_fork_set_passes(tmp_path):
+    derived = src("REFUND_FORKS = forks.REFUND_FORKS\n",
+                  "coreth_tpu/evm/device/runner.py")
+    assert run([derived], tmp_path) == []
+
+
+def test_builder_refund_drift_fires_sem005(tmp_path):
+    jt = src("""\
+        def new_ap2_table():
+            return _table(with_refunds=False)
+
+        def new_ap3_table():
+            t = new_ap2_table()
+            return _extend(t)
+        """, JT_PATH)
+    out = run([jt], tmp_path)
+    assert "refunds:ap3" in details(out)
+    assert all(f.code == "SEM005" for f in out)
+
+
+def test_builder_refund_conformant_passes(tmp_path):
+    jt = src("""\
+        def new_ap2_table():
+            return _table(with_refunds=False)
+
+        def new_ap3_table():
+            t = _extend(new_ap2_table(), with_refunds=True)
+            return t
+        """, JT_PATH)
+    assert run([jt], tmp_path) == []
+
+
+# --------------------------------------------- SEM005: README matrix
+
+def test_matrix_missing_fires_sem005(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text("# no markers here\n")
+    out = check_semconf([elig(), TABLES_OK, SPEC_OK],
+                        native_dir=str(tmp_path),
+                        readme_path=str(readme))
+    assert "matrix-missing" in details(out)
+
+
+def test_matrix_stale_fires_sem005(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text(f"{MATRIX_BEGIN}\n| junk |\n{MATRIX_END}\n")
+    out = check_semconf([elig(), TABLES_OK, SPEC_OK],
+                        native_dir=str(tmp_path),
+                        readme_path=str(readme))
+    assert "matrix-stale" in details(out)
+
+
+# ------------------------------------------ extraction sanity (real cc)
+
+def test_real_native_surface_extracts_cleanly():
+    with open(os.path.join(REPO, "native", "evm.cc"),
+              encoding="utf-8") as fh:
+        surf = extract_native(fh.read())
+    assert not surf.errors, surf.errors
+    assert surf.gate_ok
+    assert surf.replay == frozenset(surf.ops)
+    # the arms the fuzzer leans on hardest
+    add = surf.ops[0x01]
+    assert (add.pops, add.pushes, add.gas_value) == (2, 1, 3)
+    pc = surf.ops[0x58]
+    assert (pc.pops, pc.pushes) == (0, 1) and pc.guarded
+
+
+# ------------------------------- census extension: workload coverage
+
+def _static_ops(code: bytes):
+    """Opcodes statically present (PUSH data skipped, the jumpdest
+    walk from core/vm/analysis.go)."""
+    out, i = set(), 0
+    while i < len(code):
+        op = code[i]
+        out.add(op)
+        i += 1 + (op - 0x5F if 0x60 <= op <= 0x7F else 0)
+    return out
+
+
+def test_workload_opcodes_within_verified_claims():
+    """Every workload contract's opcode set must sit inside each
+    backend's semconf-verified claim set — the set the lint proves
+    conformant, not a hand list."""
+    from coreth_tpu.workloads.erc20 import TOKEN_RUNTIME
+    from coreth_tpu.workloads.hot_contract import HOT_RUNTIME
+    from coreth_tpu.workloads.swap import POOL_RUNTIME
+    claims = tree_claims()
+    assert set(claims) == {"native", "device", "specialize"}
+    for name, code in (("erc20", TOKEN_RUNTIME),
+                       ("swap", POOL_RUNTIME),
+                       ("hot_contract", HOT_RUNTIME)):
+        used = _static_ops(bytes(code))
+        for backend, per_fork in claims.items():
+            for fork in ("durango", "cancun"):
+                missing = used - per_fork[fork]
+                assert not missing, (
+                    f"{name} uses {sorted(hex(o) for o in missing)} "
+                    f"outside the {backend} claim set at {fork}")

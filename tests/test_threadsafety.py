@@ -539,6 +539,32 @@ def test_cfg001_all_read_shapes_are_seen():
     assert by_name["CORETH_D"] == "*(flag)*"
 
 
+def test_cfg001_pop_and_del_are_consume_reads(tmp_path):
+    """pop/del observe the knob before clearing it (the worker-handoff
+    shape) — they count as read sites and need table rows."""
+    s = src("""\
+        import os
+
+        HANDOFF = os.environ.pop("CORETH_POPPED", None)
+        os.environ.pop("CORETH_POPPED_BARE")
+        del os.environ["CORETH_DELETED"]
+        """)
+    reads = collect_reads([s])
+    by_name = {r.name: r.default for r in reads}
+    assert by_name["CORETH_POPPED"] == "`None`"
+    assert by_name["CORETH_POPPED_BARE"] == "*(cleared)*"
+    assert by_name["CORETH_DELETED"] == "*(cleared)*"
+    found = check_envknobs([s], readme_path=_readme(tmp_path))
+    assert codes(found) == ["CFG001", "CFG001", "CFG001"]
+    # subscript STORES are writes, not reads — no knob row required
+    w = src("""\
+        import os
+
+        os.environ["CORETH_WRITTEN"] = "1"
+        """)
+    assert collect_reads([w]) == []
+
+
 def test_cfg002_stale_row_only_on_full_scope(tmp_path):
     readme = _readme(tmp_path)
     reader = src("""\

@@ -1,7 +1,8 @@
 """corethlint — AST-based architecture lint for the coreth_tpu tree.
 
-Seven passes, all static (no imports of the linted code, safe to run
-anywhere, no JAX/device access):
+Eight passes, all static (no imports of the linted code — except
+semconf, which imports the pure-Python fork lattice and jump tables
+as its comparison truth; still no JAX/device access anywhere):
 
 - **layers** (LAY001/LAY002): the package DAG declared in
   ``tools/lint/layers.toml`` (the Python twin of the reference's
@@ -35,6 +36,13 @@ anywhere, no JAX/device access):
   environ read must have a row in the README knob table (regenerate
   with ``python -m tools.lint.envknobs --write-table``); stale rows
   fail on full-tree runs.
+- **semantic conformance** (SEM001-SEM005): the four EVM
+  implementations' per-fork opcode claims, gas constants, stack
+  arities and fork gates are extracted (C text parse of
+  ``native/evm.cc``, restricted AST evaluation of the Python claim
+  modules) and cross-checked against the jump-table truth and the
+  ``evm/forks.py`` lattice (regenerate the README matrix with
+  ``python -m tools.lint.semconf --write-matrix``).
 
 Findings can be suppressed inline with ``# noqa: <CODE> — <reason>``
 (reason mandatory) or via ``tools/lint/baseline.txt`` for accepted
@@ -49,11 +57,12 @@ from tools.lint.excepts import check_excepts  # noqa: F401
 from tools.lint.nativeabi import check_nativeabi  # noqa: F401
 from tools.lint.threadsafety import check_threadsafety  # noqa: F401
 from tools.lint.envknobs import check_envknobs  # noqa: F401
+from tools.lint.semconf import check_semconf  # noqa: F401
 from tools.lint.baseline import load_baseline, split_findings  # noqa: F401
 
 
 def run_all(paths, config, baseline=frozenset()):
-    """Run all seven passes; returns (new, baselined, stale_keys)."""
+    """Run all eight passes; returns (new, baselined, stale_keys)."""
     from tools.lint.core import _display_path
     sources = collect_sources(paths)
     findings = []
@@ -64,6 +73,7 @@ def run_all(paths, config, baseline=frozenset()):
     findings += check_nativeabi(sources)
     findings += check_threadsafety(sources)
     findings += check_envknobs(sources)
+    findings += check_semconf(sources)
     by_path = {s.path: s for s in sources}
     findings = [f for f in findings if not is_suppressed(f, by_path)]
     return split_findings(findings, baseline,

@@ -8,6 +8,7 @@ too).  Exit 2: configuration problem (unparseable file, bad layer map).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -33,6 +34,9 @@ def main(argv=None) -> int:
                     help="report baselined findings as failures too")
     ap.add_argument("--write-baseline", action="store_true",
                     help="append every new finding's key to the baseline")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON object on stdout "
+                         "(machine consumers: CI annotations, editors)")
     args = ap.parse_args(argv)
 
     paths = args.paths or ["coreth_tpu"]
@@ -55,13 +59,23 @@ def main(argv=None) -> int:
     new, baselined, stale = run_all(paths, config, baseline)
     new.sort(key=lambda f: (f.path, f.line, f.code))
 
-    for f in new:
-        print(f.render())
-    for key in stale:
-        print(f"corethlint: stale baseline entry (no longer matches): {key}",
-              file=sys.stderr)
-    print(f"corethlint: {len(new)} finding(s), {len(baselined)} baselined, "
-          f"{len(stale)} stale baseline entr(ies)")
+    if args.json:
+        def row(f):
+            return {"path": f.path, "line": f.line, "code": f.code,
+                    "message": f.message, "key": f.baseline_key}
+        print(json.dumps({
+            "findings": [row(f) for f in new],
+            "baselined": [row(f) for f in baselined],
+            "stale": list(stale),
+        }, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        for key in stale:
+            print(f"corethlint: stale baseline entry (no longer matches): "
+                  f"{key}", file=sys.stderr)
+        print(f"corethlint: {len(new)} finding(s), {len(baselined)} "
+              f"baselined, {len(stale)} stale baseline entr(ies)")
 
     if args.write_baseline and new:
         with open(args.baseline, "a", encoding="utf-8") as fh:

@@ -126,6 +126,55 @@ def nested_package_of(path: str) -> Optional[str]:
     return "/".join(rest[:-1])
 
 
+# Shared parsed-source cache: all eight passes (and every run_all /
+# standalone-tool invocation in one process — the test suite runs the
+# full-tree gate several times) reuse one ast.parse per (path, mtime,
+# size).  Source objects are treated as immutable by the passes.
+_SOURCE_CACHE: dict = {}
+_TEXT_CACHE: dict = {}
+
+
+def _stat_key(abspath: str):
+    try:
+        st = os.stat(abspath)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+def _load_source(path: str) -> Source:
+    ab = os.path.abspath(path)
+    key = _stat_key(ab)
+    cached = _SOURCE_CACHE.get(ab)
+    if cached is not None and key is not None and cached[0] == key:
+        return cached[1]
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        src = Source(_display_path(path), text)
+    except SyntaxError as e:
+        raise SystemExit(f"corethlint: cannot parse {path}: {e}")
+    if key is not None:
+        _SOURCE_CACHE[ab] = (key, src)
+    return src
+
+
+def cached_text(path: str) -> str:
+    """Raw file text through the same mtime/size-keyed cache (the
+    non-Python inputs: native/*.cc for the ABI and semconf passes,
+    README.md for the census tables)."""
+    ab = os.path.abspath(path)
+    key = _stat_key(ab)
+    cached = _TEXT_CACHE.get(ab)
+    if cached is not None and key is not None and cached[0] == key:
+        return cached[1]
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    if key is not None:
+        _TEXT_CACHE[ab] = (key, text)
+    return text
+
+
 def collect_sources(paths: Sequence[str]) -> List[Source]:
     files = []
     for p in paths:
@@ -136,15 +185,7 @@ def collect_sources(paths: Sequence[str]) -> List[Source]:
             dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
             files.extend(os.path.join(dirpath, f)
                          for f in sorted(filenames) if f.endswith(".py"))
-    sources = []
-    for f in files:
-        with open(f, encoding="utf-8") as fh:
-            text = fh.read()
-        try:
-            sources.append(Source(_display_path(f), text))
-        except SyntaxError as e:
-            raise SystemExit(f"corethlint: cannot parse {f}: {e}")
-    return sources
+    return [_load_source(f) for f in files]
 
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(

@@ -5,8 +5,11 @@ grep.  This pass makes the README's knob table (between the
 ``<!-- corethlint:knob-table:begin/end -->`` markers) the registry:
 
 - **CFG001** — a ``os.environ.get("CORETH_X")`` / ``os.getenv`` /
-  ``os.environ["CORETH_X"]`` / ``"CORETH_X" in os.environ`` read site
-  whose knob has no table row.  Fix by regenerating the table:
+  ``os.environ["CORETH_X"]`` / ``"CORETH_X" in os.environ`` /
+  ``os.environ.pop("CORETH_X")`` / ``del os.environ["CORETH_X"]``
+  read site whose knob has no table row (pop/del still observe the
+  knob before clearing it — a consume-read, the shape the worker
+  handoff uses).  Fix by regenerating the table:
   ``python -m tools.lint.envknobs --write-table``.
 - **CFG002** — a table row no read site backs any more (stale docs).
   Only emitted on a full-tree run — a partial run cannot prove a knob
@@ -35,7 +38,8 @@ _ROW_RE = re.compile(r"^\|\s*`?(CORETH_[A-Z0-9_]+)`?\s*\|")
 
 # the read shapes used across the tree (structural match on the dotted
 # callee/value; the tree imports `os`, never `from os import environ`)
-_GET_CALLS = {"os.environ.get", "os.getenv", "os.environ.setdefault"}
+_GET_CALLS = {"os.environ.get", "os.getenv", "os.environ.setdefault",
+              "os.environ.pop"}
 _ENV_NAMES = {"os.environ"}
 
 
@@ -95,15 +99,24 @@ def collect_reads(sources: Sequence[Source]) -> List[KnobRead]:
                         default = f"`{ast.unparse(node.args[1])}`"
                     except Exception:  # noqa: BLE001 — display-only default rendering
                         default = "`?`"
+                elif _dotted(node.func) == "os.environ.pop":
+                    default = "*(cleared)*"
                 else:
                     default = "*(unset)*"
                 reads.append(KnobRead(name, default, src.path,
                                       node.lineno))
             elif isinstance(node, ast.Subscript):
-                if _dotted(node.value) in _ENV_NAMES:
+                if _dotted(node.value) in _ENV_NAMES \
+                        and not isinstance(node.ctx, ast.Store):
                     name = _literal_knob(node.slice)
                     if name is not None:
-                        reads.append(KnobRead(name, "*(required)*",
+                        # `del os.environ[...]` consumes the knob, the
+                        # same read-then-clear shape as .pop(); a
+                        # Store target is a write, not a read
+                        default = ("*(cleared)*"
+                                   if isinstance(node.ctx, ast.Del)
+                                   else "*(required)*")
+                        reads.append(KnobRead(name, default,
                                               src.path, node.lineno))
             elif isinstance(node, ast.Compare):
                 if len(node.ops) == 1 \

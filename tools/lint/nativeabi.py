@@ -369,10 +369,9 @@ def collect_c_exports(
                        if f.endswith(".cc"))
     except OSError:
         return {}
-    texts = {}
-    for fn in files:
-        with open(os.path.join(native_dir, fn), encoding="utf-8") as fh:
-            texts[fn] = fh.read()
+    from tools.lint.core import cached_text
+    texts = {fn: cached_text(os.path.join(native_dir, fn))
+             for fn in files}
     # callback typedefs (full signatures) are shared across
     # translation units
     typedefs: Dict[str, Tuple] = {}

@@ -1,0 +1,1121 @@
+"""corethlint pass 8: cross-implementation semantic conformance (SEM).
+
+Four implementations execute EVM semantics in this tree — the Python
+jump tables (evm/jump_table.py), the compiled engine (native/evm.cc
+run_frame), the device machine's derived tables (evm/device/tables.py)
+and the specialize tracer (evm/device/specialize.py).  Each carries a
+CLAIM about what it executes per fork.  This pass extracts every claim
+statically (C text parse for the switch, restricted AST evaluation for
+the Python sets — the linted code is never imported) and cross-checks
+them against the jump-table-derived truth:
+
+- **SEM001** coverage drift: a backend claims an opcode the fork's
+  jump table leaves undefined, eligibility advertises an opcode the
+  compiled switch cannot execute (it would HOST-escape on first
+  contact), a compiled arm is never claimed, or build_replay_optable
+  disagrees with the switch.
+- **SEM002** gas-constant drift: a C++ ``constexpr`` gas twin
+  disagrees with params/protocol.py / the jump-table tier values, a
+  gas-looking constant has no declared twin, or a compiled arm's
+  constant ``USE(...)`` charge disagrees with the jump-table entry.
+- **SEM003** fork-gate drift (the PR-3 PUSH0/BASEFEE class): a
+  fork-introduced opcode is claimed at a fork that does not define it
+  (compiled-but-ungated), or the per-fork dispatch gate in run_frame
+  is missing.
+- **SEM004** stack-arity drift: a compiled arm's pops/pushes (NEED +
+  pop_back/push_back deltas) disagree with the jump-table arity, a
+  net-pushing arm lacks the stack-overflow guard, or a guard uses a
+  limit other than params STACK_LIMIT.
+- **SEM005** fork-set drift: evm/forks.py's INTRODUCED lattice
+  diverges from the consecutive jump-table diffs, a builder's
+  ``with_refunds`` flag disagrees with the lattice feature, the
+  statedb warm-coinbase branch gates on the wrong fork, a module
+  outside evm/forks.py hand-maintains a literal REFUND_FORKS /
+  COINBASE_WARM_FORKS / _FORK_EXTRA, or the README conformance
+  matrix is stale (regenerate: ``python -m tools.lint.semconf
+  --write-matrix``).
+
+Unlike the other passes this one IMPORTS two modules of the linted
+tree — evm/forks.py and evm/jump_table.py (+ params) — because they
+ARE the truth being compared against.  Both are pure Python and
+import-light (no numpy/JAX/device access), which forks.py's docstring
+pins as a contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from tools.lint.core import Finding, Source, cached_text
+from tools.lint.nativeabi import (DEFAULT_NATIVE_DIR, _match_paren,
+                                  _strip_c_comments)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_README = os.path.join(_REPO_ROOT, "README.md")
+
+# suffix-matched so fixture trees in tmp dirs lint identically
+_ELIG_SUFFIX = "coreth_tpu/evm/hostexec/eligibility.py"
+_TABLES_SUFFIX = "coreth_tpu/evm/device/tables.py"
+_SPEC_SUFFIX = "coreth_tpu/evm/device/specialize.py"
+_JT_SUFFIX = "coreth_tpu/evm/jump_table.py"
+_STATEDB_SUFFIX = "coreth_tpu/state/statedb.py"
+
+MATRIX_BEGIN = "<!-- semconf:conformance:begin -->"
+MATRIX_END = "<!-- semconf:conformance:end -->"
+
+
+# --------------------------------------------------------------- truth
+
+def _import_truth():
+    """The jump-table truth + fork lattice, or None when the package
+    is not importable (semconf then has nothing to compare against)."""
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    try:
+        from coreth_tpu.evm import forks as fx
+        from coreth_tpu.evm import jump_table as JT
+        from coreth_tpu.params import protocol as P
+    except ImportError:
+        return None
+    builders = {"ap2": JT.new_ap2_table, "ap3": JT.new_ap3_table,
+                "durango": JT.new_durango_table,
+                "cancun": JT.new_cancun_table}
+    missing = [f for f in fx.SUPPORTED if f not in builders]
+    if missing:
+        return None
+    tables = {f: builders[f]() for f in fx.SUPPORTED}
+    defined = {f: frozenset(op for op in range(256)
+                            if tables[f][op] is not None)
+               for f in fx.SUPPORTED}
+    stack_limit = int(P.STACK_LIMIT)
+
+    def row(fork: str, op: int) -> Optional[Tuple[int, int, int]]:
+        """(constant_gas, pops, pushes) or None if undefined."""
+        e = tables[fork][op]
+        if e is None:
+            return None
+        pushes = e.min_stack + stack_limit - e.max_stack
+        return (int(e.constant_gas), int(e.min_stack), int(pushes))
+
+    gas_twins = {
+        "G_QUICK": JT.QUICK, "G_FASTEST": JT.FASTEST, "G_FAST": JT.FAST,
+        "G_MID": JT.MID, "G_SLOW": JT.SLOW,
+        "G_KECCAK": P.KECCAK256_GAS,
+        "G_KECCAK_WORD": P.KECCAK256_WORD_GAS,
+        "G_MEM": P.MEMORY_GAS, "G_COPY": P.COPY_GAS,
+        "G_LOG": P.LOG_GAS, "G_LOGTOPIC": P.LOG_TOPIC_GAS,
+        "G_LOGDATA": P.LOG_DATA_GAS, "G_JUMPDEST": P.JUMPDEST_GAS,
+        "G_EXP": P.EXP_GAS, "G_EXPBYTE": P.EXP_BYTE_EIP158,
+        "COLD_SLOAD": P.COLD_SLOAD_COST_EIP2929,
+        "WARM_READ": P.WARM_STORAGE_READ_COST_EIP2929,
+        "SSTORE_SET": P.SSTORE_SET_GAS_EIP2200,
+        "SSTORE_RESET": P.SSTORE_RESET_GAS_EIP2200,
+        "SSTORE_SENTRY": P.SSTORE_SENTRY_GAS_EIP2200,
+        "SSTORE_CLEARS_REFUND": P.SSTORE_CLEARS_SCHEDULE_REFUND_EIP3529,
+        "COLD_ACCOUNT": P.COLD_ACCOUNT_ACCESS_COST_EIP2929,
+        "QUAD_DIV": P.QUAD_COEFF_DIV,
+    }
+    return {"fx": fx, "defined": defined, "row": row,
+            "gas_twins": {k: int(v) for k, v in gas_twins.items()},
+            "stack_limit": stack_limit}
+
+
+# ----------------------------------------- restricted AST evaluation
+
+class _EvalError(Exception):
+    pass
+
+
+class _Opaque:
+    """Sentinel for module bindings semconf cannot evaluate."""
+
+
+_OPAQUE = _Opaque()
+
+_BUILTIN_CALLS = {"range": range, "list": list, "set": set,
+                  "frozenset": frozenset, "sorted": sorted,
+                  "tuple": tuple, "dict": dict}
+
+
+def _ev(node: ast.AST, env: dict, modules: tuple):
+    """Evaluate the literal/set-algebra subset the claim modules use.
+
+    Anything outside the whitelist raises _EvalError and the binding
+    becomes opaque — extraction failure is reported, never guessed."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            v = env[node.id]
+            if v is _OPAQUE:
+                raise _EvalError(node.id)
+            return v
+        raise _EvalError(node.id)
+    if isinstance(node, ast.Attribute):
+        base = _ev(node.value, env, modules)
+        if base not in modules or node.attr.startswith("_"):
+            raise _EvalError(node.attr)
+        try:
+            return getattr(base, node.attr)
+        except AttributeError:
+            raise _EvalError(node.attr)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_ev(e, env, modules) for e in node.elts]
+        return tuple(vals) if isinstance(node, ast.Tuple) else vals
+    if isinstance(node, ast.Set):
+        return {_ev(e, env, modules) for e in node.elts}
+    if isinstance(node, ast.Dict):
+        return {_ev(k, env, modules): _ev(v, env, modules)
+                for k, v in zip(node.keys, node.values)}
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_ev(node.operand, env, modules)
+    if isinstance(node, ast.BinOp):
+        a = _ev(node.left, env, modules)
+        b = _ev(node.right, env, modules)
+        if isinstance(node.op, ast.Add):
+            return a + b
+        if isinstance(node.op, ast.Sub):
+            return a - b
+        if isinstance(node.op, ast.Mult):
+            return a * b
+        if isinstance(node.op, ast.BitOr):
+            return a | b
+        if isinstance(node.op, ast.BitAnd):
+            return a & b
+        raise _EvalError(type(node.op).__name__)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name):
+            fn = _BUILTIN_CALLS.get(node.func.id)
+            if fn is None:
+                raise _EvalError(node.func.id)
+        elif isinstance(node.func, ast.Attribute):
+            # only module-attribute calls on the injected truth
+            # modules (forks.gate, forks.extra_for, ...)
+            owner = _ev(node.func.value, env, modules)
+            if owner not in modules or node.func.attr.startswith("_"):
+                raise _EvalError(node.func.attr)
+            fn = getattr(owner, node.func.attr, None)
+            if not callable(fn):
+                raise _EvalError(node.func.attr)
+        else:
+            raise _EvalError("call")
+        args = [_ev(a, env, modules) for a in node.args]
+        kwargs = {k.arg: _ev(k.value, env, modules)
+                  for k in node.keywords if k.arg}
+        return fn(*args, **kwargs)
+    if isinstance(node, (ast.SetComp, ast.ListComp, ast.GeneratorExp,
+                         ast.DictComp)):
+        if len(node.generators) != 1:
+            raise _EvalError("nested comprehension")
+        gen = node.generators[0]
+        if not isinstance(gen.target, ast.Name):
+            raise _EvalError("comprehension target")
+        items = []
+        for item in _ev(gen.iter, env, modules):
+            sub = dict(env)
+            sub[gen.target.id] = item
+            if not all(_ev(c, sub, modules) for c in gen.ifs):
+                continue
+            if isinstance(node, ast.DictComp):
+                items.append((_ev(node.key, sub, modules),
+                              _ev(node.value, sub, modules)))
+            else:
+                items.append(_ev(node.elt, sub, modules))
+        if isinstance(node, ast.DictComp):
+            return dict(items)
+        if isinstance(node, ast.SetComp):
+            return set(items)
+        return items
+    raise _EvalError(type(node).__name__)
+
+
+def _module_bindings(src: Source, modules: tuple):
+    """Evaluate top-level Assign/AnnAssign/AugAssign chains in order.
+    Returns ({name: value-or-_OPAQUE}, {name: first lineno})."""
+    env: dict = {m.__name__.rsplit(".", 1)[-1]: m for m in modules}
+    lines: Dict[str, int] = {}
+    for stmt in src.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            name, value = stmt.target.id, stmt.value
+        elif isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, ast.Name):
+            name = stmt.target.id
+            lines.setdefault(name, stmt.lineno)
+            old = env.get(name)
+            if old is None or old is _OPAQUE:
+                env[name] = _OPAQUE
+                continue
+            try:
+                rhs = _ev(stmt.value, env, modules)
+                if isinstance(stmt.op, ast.BitOr):
+                    env[name] = old | rhs
+                elif isinstance(stmt.op, ast.Add):
+                    env[name] = old + rhs
+                else:
+                    env[name] = _OPAQUE
+            except (_EvalError, TypeError):
+                env[name] = _OPAQUE
+            continue
+        else:
+            continue
+        lines.setdefault(name, stmt.lineno)
+        try:
+            env[name] = _ev(value, env, modules)
+        except _EvalError:
+            env[name] = _OPAQUE
+    return env, lines
+
+
+# ----------------------------------------------------- C extraction
+
+@dataclass(frozen=True)
+class NativeOp:
+    """Facts extracted from one compiled opcode's switch arm."""
+    op: int
+    line: int
+    pops: Optional[int]       # None == unextractable
+    pushes: Optional[int]
+    gas_name: Optional[str]   # single-identifier USE arg, if any
+    gas_value: Optional[int]  # resolved constant charge; None == dynamic
+    guarded: bool
+    guard_limit: Optional[int]
+
+
+@dataclass
+class NativeSurface:
+    """Everything semconf (and the differential fuzzer) reads out of
+    native/evm.cc."""
+    ops: Dict[int, NativeOp] = field(default_factory=dict)
+    gas_constants: Dict[str, int] = field(default_factory=dict)
+    gas_lines: Dict[str, int] = field(default_factory=dict)
+    replay: Optional[FrozenSet[int]] = None
+    gate_ok: bool = False
+    errors: List[Tuple[int, str]] = field(default_factory=list)
+
+
+_GUARD_RE = re.compile(r"stack\.size\(\)\s*>\s*(\d+)")
+_NARGS_RE = re.compile(r"\b\w+\s*=\s*op\s*==\s*(0x[0-9A-Fa-f]+)"
+                       r"\s*\?\s*(\d+)\s*:\s*(\d+)")
+_CONDPOP_RE = re.compile(r"if\s*\(op\s*==\s*(0x[0-9A-Fa-f]+)\)")
+_RANGE_RE = re.compile(r"if\s*\(op\s*>=\s*(0x[0-9A-Fa-f]+)\s*&&"
+                       r"\s*op\s*<=\s*(0x[0-9A-Fa-f]+)\)\s*\{")
+_NBASE_RE = re.compile(r"=\s*op\s*-\s*(0x[0-9A-Fa-f]+)\s*;")
+_LABEL_RE = re.compile(r"\bcase\s+(0x[0-9A-Fa-f]{1,2})\s*:"
+                       r"|(?<![\w])default\s*:")
+_GASCONST_RE = re.compile(r"constexpr\s+\w+\s+([^;]+);")
+_GAS_NAME_RE = re.compile(r"^(G_|SSTORE_|COLD_|WARM_|QUAD_)")
+_IDENT_RE = re.compile(r"^[A-Za-z_]\w*$")
+
+
+def _match_brace(text: str, open_idx: int) -> int:
+    """Index just past the '}' matching the '{' at open_idx, or -1."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        c = text[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return -1
+
+
+def _arith(expr: str, n: int) -> Optional[int]:
+    """Evaluate a NEED() argument like ``2``, ``n``, ``n + 1``."""
+    try:
+        node = ast.parse(expr.strip(), mode="eval").body
+    except SyntaxError:
+        return None
+
+    def go(nd):
+        if isinstance(nd, ast.Constant) and isinstance(nd.value, int):
+            return nd.value
+        if isinstance(nd, ast.Name) and nd.id == "n":
+            return n
+        if isinstance(nd, ast.BinOp):
+            a, b = go(nd.left), go(nd.right)
+            if a is None or b is None:
+                return None
+            if isinstance(nd.op, ast.Add):
+                return a + b
+            if isinstance(nd.op, ast.Sub):
+                return a - b
+            if isinstance(nd.op, ast.Mult):
+                return a * b
+        return None
+    return go(node)
+
+
+def _first_use_arg(text: str) -> Optional[str]:
+    i = text.find("USE(")
+    if i < 0:
+        return None
+    end = _match_paren(text, i + 3)
+    return text[i + 4:end - 1] if end > 0 else None
+
+
+def _classify_gas(arg: Optional[str], constants: Dict[str, int]):
+    """(gas_name, gas_value): no USE -> constant 0; a single known
+    identifier or integer literal resolves; anything else is a
+    dynamic/composite charge semconf does not model (the jump-table
+    side then carries it in dynamic_gas)."""
+    if arg is None:
+        return None, 0
+    arg = arg.strip()
+    if re.fullmatch(r"\d+", arg):
+        return None, int(arg)
+    if _IDENT_RE.fullmatch(arg):
+        return arg, constants.get(arg)
+    return None, None
+
+
+def _analyze_plain_arm(ops: Sequence[int], text: str, line: int,
+                       constants: Dict[str, int]) -> List[NativeOp]:
+    need = None
+    i = text.find("NEED(")
+    if i >= 0:
+        end = _match_paren(text, i + 4)
+        need = text[i + 5:end - 1].strip() if end > 0 else None
+    nargs = {int(m.group(1), 16): (int(m.group(2)), int(m.group(3)))
+             for m in [_NARGS_RE.search(text)] if m} if "nargs" in text \
+        else {}
+    cond_pops: Dict[int, int] = {}
+    cond_lines = 0
+    for ln in text.splitlines():
+        m = _CONDPOP_RE.search(ln)
+        if m and "pop_back" in ln:
+            cop = int(m.group(1), 16)
+            cond_pops[cop] = cond_pops.get(cop, 0) + ln.count("pop_back")
+            cond_lines += ln.count("pop_back")
+    plain_pops = text.count("stack.pop_back") - cond_lines
+    push_count = text.count("stack.push_back")
+    gm = _GUARD_RE.search(text)
+    gas_name, gas_value = _classify_gas(_first_use_arg(text), constants)
+    out = []
+    for op in ops:
+        if need is None:
+            pops: Optional[int] = 0
+        elif need == "nargs" and nargs:
+            base = next(iter(nargs.values()))
+            pops = base[0] if op in nargs else base[1]
+        else:
+            pops = _arith(need, 0)
+        pushes = None
+        if pops is not None:
+            pushes = pops - (plain_pops + cond_pops.get(op, 0)) \
+                + push_count
+        out.append(NativeOp(op, line, pops, pushes, gas_name, gas_value,
+                            gm is not None,
+                            int(gm.group(1)) if gm else None))
+    return out
+
+
+def _analyze_default_arm(text: str, line: int, offset_line,
+                         constants: Dict[str, int]) -> List[NativeOp]:
+    """The range families (PUSH/DUP/SWAP/LOG): per-family NEED(n)
+    arithmetic, with for-loop pops (LOG topics) multiplied by n."""
+    out = []
+    for m in _RANGE_RE.finditer(text):
+        end = _match_brace(text, m.end() - 1)
+        if end < 0:
+            continue
+        block = text[m.end() - 1:end]
+        lo, hi = int(m.group(1), 16), int(m.group(2), 16)
+        bm = _NBASE_RE.search(block)
+        nbase = int(bm.group(1), 16) if bm else lo
+        need = None
+        i = block.find("NEED(")
+        if i >= 0:
+            pe = _match_paren(block, i + 4)
+            need = block[i + 5:pe - 1].strip() if pe > 0 else None
+        # pops inside for-loop bodies repeat n times (LOG topics)
+        loop_pops = 0
+        loop_text = []
+        for fm in re.finditer(r"for\s*\(", block):
+            pe = _match_paren(block, fm.end() - 1)
+            if pe < 0:
+                continue
+            bo = block.find("{", pe)
+            if bo < 0 or block[pe:bo].strip():
+                continue  # single-statement loop body: no braces
+            be = _match_brace(block, bo)
+            if be > 0:
+                loop_text.append(block[bo:be])
+        for lt in loop_text:
+            loop_pops += lt.count("stack.pop_back")
+        plain_pops = block.count("stack.pop_back") \
+            - sum(lt.count("stack.pop_back") for lt in loop_text)
+        push_count = block.count("stack.push_back")
+        gm = _GUARD_RE.search(block)
+        gas_name, gas_value = _classify_gas(_first_use_arg(block),
+                                            constants)
+        arm_line = offset_line(m.start())
+        for op in range(lo, hi + 1):
+            n = op - nbase
+            pops = 0 if need is None else _arith(need, n)
+            pushes = None
+            if pops is not None:
+                pushes = pops - (plain_pops + loop_pops * n) + push_count
+            out.append(NativeOp(op, arm_line, pops, pushes, gas_name,
+                                gas_value, gm is not None,
+                                int(gm.group(1)) if gm else None))
+    return out
+
+
+def extract_native(text: str) -> NativeSurface:
+    """Parse native/evm.cc: the constexpr gas block, the per-fork
+    dispatch gate, the compiled-opcode switch (pops/pushes/gas/guard
+    per arm) and build_replay_optable."""
+    surf = NativeSurface()
+    clean = _strip_c_comments(text)
+    nl = [m.start() for m in re.finditer(r"\n", clean)]
+
+    def offset_line(off: int) -> int:
+        import bisect
+        return bisect.bisect_right(nl, off - 1) + 1
+
+    for m in _GASCONST_RE.finditer(clean):
+        for part in m.group(1).split(","):
+            mm = re.match(r"\s*(\w+)\s*=\s*(\d+|0x[0-9A-Fa-f]+)\s*$",
+                          part.strip())
+            if mm:
+                surf.gas_constants[mm.group(1)] = int(mm.group(2), 0)
+                surf.gas_lines[mm.group(1)] = offset_line(m.start())
+
+    sw = re.search(r"switch\s*\(op\)\s*\{", clean)
+    if sw is None:
+        surf.errors.append((1, "no `switch (op)` dispatch found"))
+        return surf
+    fn = clean.rfind("run_frame", 0, sw.start())
+    pre = clean[fn if fn >= 0 else 0:sw.start()]
+    surf.gate_ok = "OP_UNDEF" in pre and "OP_HOSTONLY" in pre
+
+    body_end = _match_brace(clean, sw.end() - 1)
+    if body_end < 0:
+        surf.errors.append((offset_line(sw.start()),
+                            "unbalanced switch body"))
+        return surf
+    body = clean[sw.end():body_end - 1]
+    base_off = sw.end()
+
+    depth = 0
+    depths = []
+    for ch in body:
+        depths.append(depth)
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+    labels = [(m.start(), m.end(),
+               int(m.group(1), 16) if m.group(1) else None)
+              for m in _LABEL_RE.finditer(body)
+              if depths[m.start()] == 0]
+    groups: List[List[Tuple[int, int, Optional[int]]]] = []
+    for lab in labels:
+        if groups and not body[groups[-1][-1][1]:lab[0]].strip():
+            groups[-1].append(lab)
+        else:
+            groups.append([lab])
+    for gi, grp in enumerate(groups):
+        start = grp[-1][1]
+        end = groups[gi + 1][0][0] if gi + 1 < len(groups) else len(body)
+        arm = body[start:end]
+        line = offset_line(base_off + grp[0][0])
+        ops = [op for _, _, op in grp if op is not None]
+        if ops:
+            arm_ops = _analyze_plain_arm(ops, arm, line,
+                                         surf.gas_constants)
+        else:
+            arm_ops = _analyze_default_arm(
+                arm, line,
+                lambda off: offset_line(base_off + start + off),
+                surf.gas_constants)
+        for rec in arm_ops:
+            if rec.op in surf.ops:
+                surf.errors.append((rec.line,
+                                    f"opcode 0x{rec.op:02x} has two "
+                                    f"switch arms"))
+            surf.ops[rec.op] = rec
+            if rec.pops is None or rec.pushes is None:
+                surf.errors.append((rec.line,
+                                    f"cannot extract stack arity for "
+                                    f"opcode 0x{rec.op:02x}"))
+
+    rm = re.search(r"build_replay_optable[^{]*\{", clean)
+    if rm is not None:
+        rend = _match_brace(clean, rm.end() - 1)
+        block = clean[rm.end() - 1:rend] if rend > 0 else ""
+        ops: set = set()
+        lm = re.search(r"ops\[\]\s*=\s*\{([^}]*)\}", block)
+        if lm:
+            ops |= {int(t, 16) for t in
+                    re.findall(r"0x[0-9A-Fa-f]{1,2}", lm.group(1))}
+        for fm in re.finditer(r"for\s*\(int\s+op\s*=\s*(0x[0-9A-Fa-f]+)"
+                              r";\s*op\s*<=\s*(0x[0-9A-Fa-f]+)", block):
+            ops |= set(range(int(fm.group(1), 16),
+                             int(fm.group(2), 16) + 1))
+        surf.replay = frozenset(ops)
+    return surf
+
+
+# ------------------------------------------------------ Python claims
+
+@dataclass
+class BackendClaims:
+    backend: str                         # native | device | specialize
+    path: str
+    per_fork: Dict[str, FrozenSet[int]]
+    pools: List[Tuple[str, FrozenSet[int], int]]  # (name, ops, line)
+
+    def origin(self, op: int) -> Tuple[str, int]:
+        for name, ops, line in self.pools:
+            if op in ops:
+                return name, line
+        return self.backend, 1
+
+
+def _src_for(sources: Sequence[Source], suffix: str) -> Optional[Source]:
+    for s in sources:
+        if s.path.endswith(suffix):
+            return s
+    return None
+
+
+def _as_ops(v) -> Optional[FrozenSet[int]]:
+    if isinstance(v, (set, frozenset, list, tuple)) \
+            and all(isinstance(x, int) for x in v):
+        return frozenset(v)
+    return None
+
+
+def _native_claims(src: Source, fx, out: List[Finding]) \
+        -> Optional[BackendClaims]:
+    env, lines = _module_bindings(src, (fx,))
+    base = _as_ops(env.get("NATIVE_BASE"))
+    if base is None:
+        out.append(Finding(src.path, lines.get("NATIVE_BASE", 1),
+                           "SEM001",
+                           "cannot extract NATIVE_BASE opcode set",
+                           "extract:NATIVE_BASE"))
+        return None
+    gated = _as_ops(env.get("NATIVE_GATED")) or frozenset()
+    extra = env.get("_FORK_EXTRA")
+    per_fork = {}
+    for f in fx.SUPPORTED:
+        ex = _as_ops(extra.get(f)) if isinstance(extra, dict) else None
+        if ex is None:
+            ex = fx.extra_for(f, gated)
+        per_fork[f] = base | ex
+    pools = [(n, _as_ops(env.get(n)) or frozenset(), lines.get(n, 1))
+             for n in ("NATIVE_BASE", "NATIVE_GATED")]
+    return BackendClaims("native", src.path, per_fork, pools)
+
+
+def _device_claims(src: Source, fx, out: List[Finding]) \
+        -> Optional[BackendClaims]:
+    env, lines = _module_bindings(src, (fx,))
+    always = _as_ops(env.get("_ALWAYS"))
+    feature = env.get("FEATURE_OPS")
+    gated = _as_ops(env.get("DEVICE_GATED")) or frozenset()
+    feat_ops = _as_ops(list(feature.keys())) \
+        if isinstance(feature, dict) else None
+    if always is None or feat_ops is None:
+        out.append(Finding(src.path, lines.get("_ALWAYS", 1), "SEM001",
+                           "cannot extract device opcode pools "
+                           "(_ALWAYS / FEATURE_OPS)",
+                           "extract:device-pools"))
+        return None
+    pool = always | feat_ops | gated
+    per_fork = {f: frozenset(fx.gate(f, pool)) for f in fx.SUPPORTED}
+    pools = [("_ALWAYS", always, lines.get("_ALWAYS", 1)),
+             ("FEATURE_OPS", feat_ops, lines.get("FEATURE_OPS", 1)),
+             ("DEVICE_GATED", gated, lines.get("DEVICE_GATED", 1))]
+    return BackendClaims("device", src.path, per_fork, pools)
+
+
+def _spec_claims(src: Source, dev: BackendClaims, fx,
+                 out: List[Finding]) -> Optional[BackendClaims]:
+    env, lines = _module_bindings(src, (fx,))
+    spec = _as_ops(env.get("SPEC_OPCODES"))
+    if spec is None:
+        out.append(Finding(src.path, lines.get("SPEC_OPCODES", 1),
+                           "SEM001",
+                           "cannot extract SPEC_OPCODES",
+                           "extract:SPEC_OPCODES"))
+        return None
+    line = lines.get("SPEC_OPCODES", 1)
+    # the tracer's pool must stay inside the device machine's: traced
+    # code otherwise host-escapes (or worse) at run time
+    newest = fx.SUPPORTED[-1]
+    for op in sorted(spec - dev.per_fork[newest]):
+        out.append(Finding(src.path, line, "SEM001",
+                           f"specialize tracer claims 0x{op:02x} which "
+                           f"the device machine does not execute at "
+                           f"{newest}",
+                           f"specialize:not-device:0x{op:02x}"))
+    per_fork = {f: spec & dev.per_fork[f] for f in fx.SUPPORTED}
+    return BackendClaims("specialize", src.path, per_fork,
+                         [("SPEC_OPCODES", spec, line)])
+
+
+# ------------------------------------------------------------- checks
+
+def _check_definedness(claims: List[BackendClaims], truth,
+                       out: List[Finding]) -> None:
+    """SEM001/SEM003: claimed-but-undefined opcodes.  Fork-introduced
+    ones are the PR-3 gate class (SEM003); the rest are plain coverage
+    drift (SEM001)."""
+    fx = truth["fx"]
+    introduced = frozenset().union(*fx.INTRODUCED.values()) \
+        if fx.INTRODUCED else frozenset()
+    for bc in claims:
+        flagged = {}
+        for f in fx.SUPPORTED:
+            for op in bc.per_fork[f] - truth["defined"][f]:
+                flagged.setdefault(op, []).append(f)
+        for op, bad in sorted(flagged.items()):
+            name, line = bc.origin(op)
+            if op in introduced:
+                out.append(Finding(
+                    bc.path, line, "SEM003",
+                    f"{bc.backend} claims fork-introduced opcode "
+                    f"0x{op:02x} (via {name}) at {', '.join(bad)} "
+                    f"where it is undefined — gate it through "
+                    f"evm/forks.py instead",
+                    f"{bc.backend}:gate:0x{op:02x}"))
+            else:
+                out.append(Finding(
+                    bc.path, line, "SEM001",
+                    f"{bc.backend} claims opcode 0x{op:02x} (via "
+                    f"{name}) but the jump table leaves it undefined "
+                    f"at {', '.join(bad)}",
+                    f"{bc.backend}:undefined:0x{op:02x}"))
+
+
+def _check_native_surface(native: Optional[BackendClaims],
+                          surf: NativeSurface, cc_path: str, truth,
+                          out: List[Finding]) -> None:
+    fx = truth["fx"]
+    newest = fx.SUPPORTED[-1]
+    for line, msg in surf.errors:
+        out.append(Finding(cc_path, line, "SEM004",
+                           f"semconf extraction: {msg}",
+                           f"extract:{msg}"))
+    if not surf.gate_ok:
+        out.append(Finding(cc_path, 1, "SEM003",
+                           "run_frame lacks the per-fork dispatch gate "
+                           "(OP_UNDEF/OP_HOSTONLY check before the "
+                           "switch) — fork-introduced opcodes would "
+                           "execute on every fork",
+                           "native:gate-missing"))
+    compiled = frozenset(surf.ops)
+    if native is not None:
+        claimed = native.per_fork[newest]
+        for op in sorted(claimed - compiled):
+            name, line = native.origin(op)
+            out.append(Finding(
+                native.path, line, "SEM001",
+                f"eligibility advertises 0x{op:02x} (via {name}) but "
+                f"native/evm.cc has no switch arm for it — it would "
+                f"HOST-escape on first contact",
+                f"native:uncompiled:0x{op:02x}"))
+        for op in sorted(compiled - claimed):
+            rec = surf.ops[op]
+            out.append(Finding(
+                cc_path, rec.line, "SEM001",
+                f"native/evm.cc compiles 0x{op:02x} but eligibility "
+                f"never claims it — dead arm or census drift",
+                f"native:unclaimed:0x{op:02x}"))
+        if surf.replay is not None and surf.replay != compiled:
+            extra = sorted(surf.replay - compiled)
+            miss = sorted(compiled - surf.replay)
+            desc = "; ".join(
+                s for s in (
+                    "extra " + ", ".join(f"0x{o:02x}" for o in extra)
+                    if extra else "",
+                    "missing " + ", ".join(f"0x{o:02x}" for o in miss)
+                    if miss else "") if s)
+            out.append(Finding(
+                cc_path, 1, "SEM001",
+                f"build_replay_optable disagrees with the compiled "
+                f"switch: {desc}",
+                "native:replay-drift"))
+    # SEM002: constexpr twins
+    twins = truth["gas_twins"]
+    for name, val in sorted(surf.gas_constants.items()):
+        if not _GAS_NAME_RE.match(name):
+            continue
+        line = surf.gas_lines.get(name, 1)
+        if name not in twins:
+            out.append(Finding(
+                cc_path, line, "SEM002",
+                f"C gas constant {name} has no params/protocol.py twin "
+                f"declared in semconf's map — add the mapping",
+                f"gasconst-unmapped:{name}"))
+        elif twins[name] != val:
+            out.append(Finding(
+                cc_path, line, "SEM002",
+                f"C gas constant {name} = {val} but the params twin "
+                f"says {twins[name]}",
+                f"gasconst:{name}"))
+    # SEM002 per-op constant charge + SEM004 arity/guards, for the
+    # forks where the native backend claims each op
+    row = truth["row"]
+    limit = truth["stack_limit"]
+    claimed_any = frozenset().union(
+        *native.per_fork.values()) if native else compiled
+    for op in sorted(compiled):
+        rec = surf.ops[op]
+        rows = [(f, row(f, op)) for f in fx.SUPPORTED
+                if (native.per_fork[f] if native else claimed_any)
+                and op in (native.per_fork[f] if native else claimed_any)
+                and row(f, op) is not None]
+        if not rows:
+            continue
+        if rec.gas_value is not None:
+            for f, (cgas, _, _) in rows:
+                if cgas != rec.gas_value:
+                    out.append(Finding(
+                        cc_path, rec.line, "SEM002",
+                        f"opcode 0x{op:02x} charges {rec.gas_value} "
+                        f"constant gas natively but the {f} jump table "
+                        f"says {cgas}",
+                        f"opgas:0x{op:02x}:{f}"))
+        _, tpops, tpushes = rows[-1][1]
+        if rec.pops is not None and rec.pops != tpops:
+            out.append(Finding(
+                cc_path, rec.line, "SEM004",
+                f"opcode 0x{op:02x} pops {rec.pops} natively but the "
+                f"jump table says {tpops}",
+                f"arity-pops:0x{op:02x}"))
+        if rec.pushes is not None and rec.pushes != tpushes:
+            out.append(Finding(
+                cc_path, rec.line, "SEM004",
+                f"opcode 0x{op:02x} pushes {rec.pushes} natively but "
+                f"the jump table says {tpushes}",
+                f"arity-pushes:0x{op:02x}"))
+        net_push = (rec.pushes or 0) > (rec.pops or 0)
+        if net_push and not rec.guarded:
+            out.append(Finding(
+                cc_path, rec.line, "SEM004",
+                f"opcode 0x{op:02x} grows the stack without a "
+                f"stack-overflow guard — the interpreter errs at "
+                f"{limit}, the native arm would not",
+                f"overflow-guard:0x{op:02x}"))
+        if rec.guarded and rec.guard_limit != limit:
+            out.append(Finding(
+                cc_path, rec.line, "SEM004",
+                f"opcode 0x{op:02x} guards the stack at "
+                f"{rec.guard_limit} but params STACK_LIMIT is {limit}",
+                f"overflow-limit:0x{op:02x}"))
+
+
+def _check_fork_sets(sources: Sequence[Source], truth,
+                     out: List[Finding]) -> None:
+    """SEM005: the lattice itself vs jump-table truth, with_refunds,
+    the statedb warm-coinbase branch, and literal redefinitions."""
+    fx = truth["fx"]
+    defined = truth["defined"]
+    # (a) INTRODUCED vs consecutive jump-table diffs
+    for prev, cur in zip(fx.SUPPORTED, fx.SUPPORTED[1:]):
+        diff = defined[cur] - defined[prev]
+        declared = fx.INTRODUCED.get(cur, frozenset())
+        if diff != declared:
+            out.append(Finding(
+                "coreth_tpu/evm/forks.py", 1, "SEM005",
+                f"INTRODUCED[{cur!r}] = "
+                f"{{{', '.join(f'0x{o:02x}' for o in sorted(declared))}}} "
+                f"but the jump-table diff vs {prev} is "
+                f"{{{', '.join(f'0x{o:02x}' for o in sorted(diff))}}}",
+                f"introduced:{cur}"))
+    # (b) builders' with_refunds vs the lattice feature
+    jt_src = _src_for(sources, _JT_SUFFIX)
+    if jt_src is not None:
+        refunds = _builder_refunds(jt_src)
+        for f in fx.SUPPORTED:
+            want = "eip3529_refunds" in fx.features(f)
+            got = refunds.get(f)
+            if got is not None and got != want:
+                out.append(Finding(
+                    jt_src.path, 1, "SEM005",
+                    f"new_{f}_table builds with with_refunds={got} but "
+                    f"the fork lattice says refunds are "
+                    f"{'on' if want else 'off'} at {f}",
+                    f"refunds:{f}"))
+    # (c) statedb warm-coinbase gate
+    sdb = _src_for(sources, _STATEDB_SUFFIX)
+    if sdb is not None and fx.COINBASE_WARM_FORKS:
+        want = fx.COINBASE_WARM_FORKS[0]
+        got = None
+        got_line = 1
+        for i, ln in enumerate(sdb.lines):
+            m = re.search(r"rules\.is_(\w+)", ln)
+            if m and "coinbase" in "".join(
+                    sdb.lines[i:i + 4]).lower():
+                got, got_line = m.group(1), i + 1
+                break
+        if got is not None and got != want:
+            out.append(Finding(
+                sdb.path, got_line, "SEM005",
+                f"statedb warms the coinbase from rules.is_{got} but "
+                f"the fork lattice introduces warm_coinbase at {want}",
+                "coinbase-warm"))
+    # (d) literal fork-set redefinitions outside the lattice module
+    names = {"REFUND_FORKS", "COINBASE_WARM_FORKS", "_FORK_EXTRA"}
+    for src in sources:
+        if src.path.endswith("coreth_tpu/evm/forks.py"):
+            continue
+        for stmt in src.tree.body:
+            tgt = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tgt = stmt.targets[0].id
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                tgt = stmt.target.id
+            if tgt not in names or stmt.value is None:
+                continue
+            refs_lattice = any(
+                isinstance(nd, ast.Name) and nd.id == "forks"
+                for nd in ast.walk(stmt.value))
+            if not refs_lattice:
+                out.append(Finding(
+                    src.path, stmt.lineno, "SEM005",
+                    f"{tgt} is hand-maintained here as a literal — "
+                    f"derive it from evm/forks.py so the lattice stays "
+                    f"the single source of truth",
+                    f"literal:{tgt}"))
+
+
+def _builder_refunds(src: Source) -> Dict[str, Optional[bool]]:
+    """fork -> with_refunds flag, following the builder-chain (a fork
+    builder without the keyword inherits its base table's setting)."""
+    fns = {s.name: s for s in src.tree.body
+           if isinstance(s, ast.FunctionDef)}
+
+    def resolve(fname: str, seen: tuple) -> Optional[bool]:
+        fn = fns.get(fname)
+        if fn is None or fname in seen:
+            return None
+        val = None
+        base = None
+        for nd in ast.walk(fn):
+            if isinstance(nd, ast.keyword) and nd.arg == "with_refunds" \
+                    and isinstance(nd.value, ast.Constant):
+                val = bool(nd.value.value)
+            if isinstance(nd, ast.Call) and isinstance(nd.func, ast.Name) \
+                    and nd.func.id.startswith("new_") \
+                    and nd.func.id.endswith("_table") \
+                    and nd.func.id != fname:
+                base = nd.func.id
+        if val is not None:
+            return val
+        return resolve(base, seen + (fname,)) if base else None
+
+    out = {}
+    for fn in fns:
+        m = re.fullmatch(r"new_(\w+)_table", fn)
+        if m:
+            out[m.group(1)] = resolve(fn, ())
+    return out
+
+
+# ------------------------------------------------- conformance matrix
+
+def render_matrix(claims: List[BackendClaims], truth) -> str:
+    fx = truth["fx"]
+    by = {bc.backend: bc for bc in claims}
+    head = ["fork", "jump table"]
+    order = [b for b in ("native", "device", "specialize") if b in by]
+    head += order
+    rows = [head, ["---"] * len(head)]
+    for f in fx.SUPPORTED:
+        ndef = len(truth["defined"][f])
+        row = [f, f"{ndef} ops"]
+        for b in order:
+            n = len(by[b].per_fork[f])
+            row.append(f"{n} ({100 * n // ndef}%)")
+        rows.append(row)
+    lines = ["| " + " | ".join(r) + " |" for r in rows]
+    return "\n".join(
+        [MATRIX_BEGIN,
+         "<!-- generated by `python -m tools.lint.semconf "
+         "--write-matrix` — do not edit by hand -->"]
+        + lines + [MATRIX_END])
+
+
+def _check_matrix(claims: List[BackendClaims], truth, readme_path: str,
+                  out: List[Finding]) -> None:
+    if len(claims) < 3 or not os.path.isfile(readme_path):
+        return
+    text = cached_text(readme_path)
+    if MATRIX_BEGIN not in text or MATRIX_END not in text:
+        out.append(Finding("README.md", 1, "SEM005",
+                           "README lacks the semconf conformance-matrix "
+                           "markers — run `python -m tools.lint.semconf "
+                           "--write-matrix`",
+                           "matrix-missing"))
+        return
+    start = text.index(MATRIX_BEGIN)
+    end = text.index(MATRIX_END) + len(MATRIX_END)
+    current = text[start:end]
+    if current.strip() != render_matrix(claims, truth).strip():
+        line = text[:start].count("\n") + 1
+        out.append(Finding("README.md", line, "SEM005",
+                           "README conformance matrix is stale — run "
+                           "`python -m tools.lint.semconf "
+                           "--write-matrix`",
+                           "matrix-stale"))
+
+
+# -------------------------------------------------------- entry point
+
+def check_semconf(sources: Sequence[Source],
+                  native_dir: Optional[str] = None,
+                  readme_path: Optional[str] = None) -> List[Finding]:
+    out: List[Finding] = []
+    truth = _import_truth()
+    if truth is None:
+        return out
+    fx = truth["fx"]
+    claims: List[BackendClaims] = []
+    native = None
+    elig = _src_for(sources, _ELIG_SUFFIX)
+    if elig is not None:
+        native = _native_claims(elig, fx, out)
+        if native is not None:
+            claims.append(native)
+    tab = _src_for(sources, _TABLES_SUFFIX)
+    dev = _device_claims(tab, fx, out) if tab is not None else None
+    if dev is not None:
+        claims.append(dev)
+    spec_src = _src_for(sources, _SPEC_SUFFIX)
+    if spec_src is not None and dev is not None:
+        spec = _spec_claims(spec_src, dev, fx, out)
+        if spec is not None:
+            claims.append(spec)
+    _check_definedness(claims, truth, out)
+    cc_path = os.path.join(native_dir or DEFAULT_NATIVE_DIR, "evm.cc")
+    if os.path.isfile(cc_path):
+        surf = extract_native(cached_text(cc_path))
+        rel = os.path.relpath(os.path.abspath(cc_path), _REPO_ROOT)
+        if rel.startswith(".."):
+            rel = cc_path
+        _check_native_surface(native, surf, rel.replace(os.sep, "/"),
+                              truth, out)
+    _check_fork_sets(sources, truth, out)
+    _check_matrix(claims, truth,
+                  readme_path if readme_path is not None
+                  else DEFAULT_README, out)
+    return out
+
+
+# ------------------------------------- fuzzer / test-facing surfaces
+
+def tree_claims() -> Dict[str, Dict[str, FrozenSet[int]]]:
+    """{backend: {fork: claimed opcodes}} extracted from the REAL
+    tree — the differential fuzzer's coverage target comes from the
+    same extraction the lint pass verifies, never a hand list."""
+    from tools.lint.core import collect_sources
+    truth = _import_truth()
+    if truth is None:
+        raise RuntimeError("semconf: coreth_tpu not importable")
+    fx = truth["fx"]
+    paths = [os.path.join(_REPO_ROOT, p) for p in
+             (_ELIG_SUFFIX, _TABLES_SUFFIX, _SPEC_SUFFIX)]
+    sources = collect_sources([p for p in paths if os.path.isfile(p)])
+    sink: List[Finding] = []
+    out: Dict[str, Dict[str, FrozenSet[int]]] = {}
+    elig = _src_for(sources, _ELIG_SUFFIX)
+    native = _native_claims(elig, fx, sink) if elig else None
+    if native:
+        out["native"] = native.per_fork
+    tab = _src_for(sources, _TABLES_SUFFIX)
+    dev = _device_claims(tab, fx, sink) if tab else None
+    if dev:
+        out["device"] = dev.per_fork
+    spec_src = _src_for(sources, _SPEC_SUFFIX)
+    if spec_src and dev:
+        spec = _spec_claims(spec_src, dev, fx, sink)
+        if spec:
+            out["specialize"] = spec.per_fork
+    return out
+
+
+def native_surface() -> NativeSurface:
+    """Parsed facts from the real native/evm.cc."""
+    return extract_native(
+        cached_text(os.path.join(DEFAULT_NATIVE_DIR, "evm.cc")))
+
+
+def main(argv=None) -> int:
+    import argparse
+    from tools.lint.core import collect_sources
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint.semconf",
+        description="cross-implementation semantic conformance pass")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_REPO_ROOT, "coreth_tpu")])
+    ap.add_argument("--write-matrix", action="store_true",
+                    help="regenerate the README conformance matrix "
+                         "between the semconf markers")
+    args = ap.parse_args(argv)
+    sources = collect_sources(args.paths)
+    if args.write_matrix:
+        truth = _import_truth()
+        if truth is None:
+            print("semconf: coreth_tpu not importable", file=sys.stderr)
+            return 2
+        fx = truth["fx"]
+        sink: List[Finding] = []
+        claims = []
+        elig = _src_for(sources, _ELIG_SUFFIX)
+        native = _native_claims(elig, fx, sink) if elig else None
+        if native:
+            claims.append(native)
+        tab = _src_for(sources, _TABLES_SUFFIX)
+        dev = _device_claims(tab, fx, sink) if tab else None
+        if dev:
+            claims.append(dev)
+        spec_src = _src_for(sources, _SPEC_SUFFIX)
+        if spec_src and dev:
+            spec = _spec_claims(spec_src, dev, fx, sink)
+            if spec:
+                claims.append(spec)
+        if len(claims) < 3:
+            print("semconf: claim modules not found under the given "
+                  "paths", file=sys.stderr)
+            return 2
+        block = render_matrix(claims, truth)
+        text = cached_text(DEFAULT_README)
+        if MATRIX_BEGIN in text and MATRIX_END in text:
+            start = text.index(MATRIX_BEGIN)
+            end = text.index(MATRIX_END) + len(MATRIX_END)
+            text = text[:start] + block + text[end:]
+        else:
+            text = text.rstrip("\n") + "\n\n" + block + "\n"
+        with open(DEFAULT_README, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print("semconf: wrote README conformance matrix")
+        return 0
+    findings = check_semconf(sources)
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        print(f.render())
+    print(f"semconf: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
